@@ -1,0 +1,30 @@
+"""mlapi_tpu — a TPU-native training-and-serving framework.
+
+Re-implements the capabilities of the reference microservice
+(``achbogga/mlAPI``: train a linear classifier on CSV data, persist it,
+serve schema-validated JSON predictions and CSV uploads over HTTP —
+see ``/root/reference/main.py`` and ``Logistic Regression.ipynb``)
+as an idiomatic JAX/XLA framework:
+
+(Modules land incrementally along the SURVEY §7 build plan; at any
+given commit some of the below may not exist yet.)
+
+- ``models``     — functional model zoo (linear, MLP, Wide&Deep, BERT).
+- ``train``      — optax training loops; data-parallel via ``jax.jit`` +
+                   ``NamedSharding`` over a device mesh (gradients
+                   all-reduced over ICI by XLA-inserted collectives).
+- ``parallel``   — mesh construction and canonical PartitionSpec layouts.
+- ``checkpoint`` — versioned, atomic, pickle-free checkpoints
+                   (replaces the reference's ``pickle.load`` handoff,
+                   ``main.py:19``).
+- ``serving``    — an asyncio HTTP/ASGI serving stack with an
+                   inference micro-batcher in front of a jit-compiled
+                   forward pass (replaces FastAPI/uvicorn, which the
+                   reference used off-the-shelf).
+- ``datasets``   — loaders for the config ladder (Iris → MNIST →
+                   Fashion-MNIST → Criteo → SST-2) with deterministic
+                   synthetic fallbacks for air-gapped environments.
+- ``ops``        — Pallas TPU kernels for hot ops.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,42 @@
+"""Dataset loaders for the config ladder.
+
+All loaders return a :class:`SupervisedSplits` of host-side numpy
+arrays plus the string-label vocab. Loaders never hit the network:
+Iris ships with scikit-learn; MNIST-family loaders read local IDX
+files when present and otherwise fall back to a clearly-labelled
+deterministic synthetic generator (this build environment is
+air-gapped); Criteo and SST-2 use synthetic generators sized by
+config. Replaces the reference's in-notebook
+``pd.read_csv(<UCI URL>)`` ingestion (``Logistic Regression.ipynb``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from mlapi_tpu.utils.vocab import LabelVocab
+
+
+@dataclass(frozen=True)
+class SupervisedSplits:
+    """Train/test split of a supervised dataset, labels already encoded."""
+
+    x_train: np.ndarray
+    y_train: np.ndarray  # int32 class ids
+    x_test: np.ndarray
+    y_test: np.ndarray  # int32 class ids
+    vocab: LabelVocab
+    feature_names: tuple[str, ...] = ()
+
+    @property
+    def num_features(self) -> int:
+        return int(np.prod(self.x_train.shape[1:]))
+
+    @property
+    def num_classes(self) -> int:
+        return self.vocab.size
+
+
+from mlapi_tpu.datasets.iris import load_iris  # noqa: E402,F401

@@ -1,0 +1,55 @@
+"""Iris dataset — config 1 of the ladder.
+
+The reference ingests the UCI Iris CSV over HTTPS with explicit column
+names and string labels, then splits 80/20 with
+``train_test_split(test_size=0.20, random_state=1, shuffle=True)``
+(``Logistic Regression.ipynb``, single cell). This loader reproduces
+the same data and the exact same split offline: scikit-learn bundles
+the UCI copy of Iris (including UCI's two errata rows), and we reuse
+sklearn's ``train_test_split`` with the same arguments so held-out
+accuracy is comparable against the reference's published
+0.9666666666666667.
+
+Labels are restored to the UCI string form (``Iris-setosa`` …) because
+that is what the reference's ``/predict`` returns (``main.py:24-27``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mlapi_tpu.datasets import SupervisedSplits
+from mlapi_tpu.utils.vocab import LabelVocab
+
+FEATURE_NAMES = (
+    "sepal_length",
+    "sepal_width",
+    "petal_length",
+    "petal_width",
+)
+
+
+def load_iris(*, test_fraction: float = 0.20, seed: int = 1) -> SupervisedSplits:
+    """Load Iris with the reference's split (150 rows → 120 train / 30 test)."""
+    from sklearn.datasets import load_iris as _sk_load_iris
+    from sklearn.model_selection import train_test_split as _sk_split
+
+    raw = _sk_load_iris()
+    x = raw.data.astype(np.float32)  # [150, 4]
+    # sklearn names are 'setosa' etc.; UCI / the reference use 'Iris-setosa'.
+    labels = np.asarray([f"Iris-{raw.target_names[t]}" for t in raw.target])
+    vocab = LabelVocab.from_labels(labels)
+    y = vocab.encode(labels)
+
+    # Same splitter, same arguments as the reference notebook → same rows.
+    x_train, x_test, y_train, y_test = _sk_split(
+        x, y, test_size=test_fraction, random_state=seed, shuffle=True
+    )
+    return SupervisedSplits(
+        x_train=x_train,
+        y_train=y_train.astype(np.int32),
+        x_test=x_test,
+        y_test=y_test.astype(np.int32),
+        vocab=vocab,
+        feature_names=FEATURE_NAMES,
+    )

@@ -1,0 +1,53 @@
+"""Functional model zoo.
+
+Every model is a lightweight stateless object with two methods:
+
+- ``init(rng) -> params``   — build the parameter pytree.
+- ``apply(params, x) -> logits`` — pure forward pass, safe under
+  ``jax.jit`` / ``jax.grad`` / ``shard_map``.
+
+Models carry no parameters themselves (params are explicit pytrees),
+so the same model object can be used for training, checkpointing, and
+serving, and params can be sharded over a mesh without the model
+object knowing.
+
+Registry: ``get_model(name, **kwargs)`` builds a model by config name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    """Decorator registering a model factory under a config name."""
+
+    def deco(factory):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Build a model by registry name (e.g. ``linear``, ``mlp``)."""
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(**kwargs)
+
+
+def registered_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# Import model modules for their registration side effects.
+from mlapi_tpu.models import linear as _linear  # noqa: E402,F401
+from mlapi_tpu.models.linear import LinearClassifier  # noqa: E402,F401

@@ -1,0 +1,48 @@
+"""Linear (multinomial logistic-regression) classifier.
+
+TPU-native replacement for the reference's pickled sklearn
+``LogisticRegression`` (reference ``main.py:19-22``, trained in
+``Logistic Regression.ipynb``). The forward pass is a single
+``x @ W + b`` — one fused MXU matmul under ``jax.jit`` — and
+probabilities come from ``jax.nn.softmax`` over the same logits, so
+unlike the reference (which runs the matmul twice: ``predict`` at
+``main.py:21`` then ``predict_proba`` at ``main.py:22``) prediction and
+probability share one device call.
+
+Params pytree: ``{"w": [d, k], "b": [k]}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from mlapi_tpu.models import register_model
+
+
+@register_model("linear")
+@dataclass(frozen=True)
+class LinearClassifier:
+    """Multinomial softmax classifier: ``logits = x @ W + b``.
+
+    With ``num_classes=2`` this degenerates to logistic regression
+    (softmax over two logits ≡ sigmoid of their difference).
+    """
+
+    num_features: int
+    num_classes: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, rng: jax.Array) -> dict:
+        """Zero-init params — convex objective, no symmetry to break."""
+        del rng
+        return {
+            "w": jnp.zeros((self.num_features, self.num_classes), self.param_dtype),
+            "b": jnp.zeros((self.num_classes,), self.param_dtype),
+        }
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        """Forward pass: ``[batch, d] -> [batch, k]`` logits."""
+        return x @ params["w"] + params["b"]

@@ -1,0 +1,18 @@
+"""Parallelism: device mesh, canonical shardings, collectives.
+
+The reference has **no** parallelism of any kind (single-process
+FastAPI app, SURVEY §2). This package supplies the TPU-native layer
+the north star demands: a named device ``Mesh`` with ``data`` and
+``model`` axes, ``NamedSharding`` annotations on params/batches, and
+XLA-inserted collectives over ICI (gradient ``psum`` falls out of the
+sharded ``jit`` — no hand-written NCCL/MPI-style transport).
+"""
+
+from mlapi_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    create_mesh,
+    replicate_for_mesh,
+    shard_batch_for_mesh,
+)
+from mlapi_tpu.parallel.layout import SpecLayout  # noqa: F401

@@ -1,0 +1,60 @@
+"""Canonical PartitionSpec layouts per parameter family.
+
+One place that answers "how is this tensor laid out on the mesh" for
+every config in the ladder, so models annotate params by *role* and
+the mesh shape can change without touching model code. (Pattern after
+public TPU sharding idioms — a frozen dataclass of named-axis specs.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from mlapi_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for params and activations."""
+
+    data_axis: str = DATA_AXIS
+    model_axis: str = MODEL_AXIS
+
+    # --- activations -----------------------------------------------------
+    def batch(self) -> P:
+        """Activations: batch dim sharded over data, features replicated."""
+        return P(self.data_axis)
+
+    # --- dense layers ----------------------------------------------------
+    def replicated(self) -> P:
+        """Small params (linear classifier W/b, layernorm scales)."""
+        return P()
+
+    def dense_col(self) -> P:
+        """[in, out] weight, output features sharded over model (TP
+        column-parallel: each chip computes a slice of the outputs)."""
+        return P(None, self.model_axis)
+
+    def dense_row(self) -> P:
+        """[in, out] weight, input features sharded over model (TP
+        row-parallel: follows a col-parallel layer; XLA inserts the
+        psum on the output)."""
+        return P(self.model_axis, None)
+
+    # --- embeddings ------------------------------------------------------
+    def embedding_rows(self) -> P:
+        """[vocab, dim] table sharded over vocab rows — the Criteo
+        layout: each chip owns a shard of the hash space and lookups
+        become an XLA gather + all-to-all."""
+        return P(self.model_axis, None)
+
+    # --- attention -------------------------------------------------------
+    def attn_qkv(self) -> P:
+        """[d_model, heads*head_dim]: heads sharded over model."""
+        return P(None, self.model_axis)
+
+    def attn_out(self) -> P:
+        """[heads*head_dim, d_model]: contraction dim sharded over model."""
+        return P(self.model_axis, None)

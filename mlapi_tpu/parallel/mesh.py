@@ -1,0 +1,69 @@
+"""Device mesh construction and basic sharding helpers.
+
+Idiom (modern JAX, GSPMD): build one logical mesh with named axes,
+annotate arrays with ``NamedSharding``, and let ``jax.jit`` insert the
+collectives. Scales from 1 chip to multi-host pods without changing
+application code; multi-host initialisation is
+``jax.distributed.initialize`` before mesh creation.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(
+    shape: tuple[int, ...] | None = None,
+    axis_names: tuple[str, ...] = (DATA_AXIS, MODEL_AXIS),
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    Defaults to putting every visible device on the ``data`` axis with
+    a trivial ``model`` axis — right for pure data-parallel configs.
+    Pass an explicit ``shape`` (e.g. ``(2, 4)``) for configs that
+    shard params over ``model`` (Criteo embeddings, BERT TP).
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    mesh_devices = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(mesh_devices, axis_names)
+
+
+def replicate_for_mesh(pytree, mesh: Mesh):
+    """Fully replicate every leaf across the mesh (params, opt state)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(pytree, sharding)
+
+
+def shard_batch_for_mesh(pytree, mesh: Mesh, axis: str = DATA_AXIS):
+    """Shard each leaf's leading (batch) dimension over ``axis``.
+
+    Leading dims must be divisible by the axis size — callers pad
+    (the serving batcher pads to bucket sizes for exactly this
+    reason, and to avoid recompilation).
+    """
+    axis_size = mesh.shape[axis]
+
+    def put(leaf):
+        arr = np.asarray(leaf)
+        if arr.shape[0] % axis_size:
+            raise ValueError(
+                f"batch dim {arr.shape[0]} not divisible by mesh axis "
+                f"{axis!r} of size {axis_size}; pad first"
+            )
+        spec = P(axis, *(None,) * (arr.ndim - 1))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, pytree)

@@ -1,0 +1,8 @@
+"""Training loops (optax) — replaces the reference's notebook pipeline."""
+
+from mlapi_tpu.train.loop import (  # noqa: F401
+    TrainResult,
+    evaluate,
+    fit,
+    make_train_step,
+)

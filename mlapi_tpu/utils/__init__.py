@@ -1,0 +1,3 @@
+"""Shared utilities: label vocabulary, structured logging, metrics, registry."""
+
+from mlapi_tpu.utils.vocab import LabelVocab  # noqa: F401

@@ -1,0 +1,62 @@
+"""String-label vocabulary.
+
+The reference trains on the raw UCI Iris CSV whose labels are strings
+(``Iris-setosa`` / ``Iris-versicolor`` / ``Iris-virginica``) and returns
+the string label from ``/predict`` (reference ``main.py:24-27``; label
+origin: the notebook's ``pd.read_csv`` with explicit column names).
+JAX models work on integer class ids, so the vocab — the string↔id
+mapping — is part of the model artifact and travels with every
+checkpoint (see ``mlapi_tpu.checkpoint``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LabelVocab:
+    """Immutable ordered mapping between string class labels and int ids."""
+
+    labels: tuple[str, ...]
+    _index: dict[str, int] = field(init=False, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        if len(set(self.labels)) != len(self.labels):
+            raise ValueError(f"duplicate labels in vocab: {self.labels}")
+        object.__setattr__(
+            self, "_index", {label: i for i, label in enumerate(self.labels)}
+        )
+
+    @classmethod
+    def from_labels(cls, raw_labels) -> "LabelVocab":
+        """Build a vocab from an iterable of (possibly repeated) labels.
+
+        Order is sorted for determinism — the same dataset always yields
+        the same vocab regardless of row order.
+        """
+        return cls(labels=tuple(sorted({str(x) for x in raw_labels})))
+
+    @property
+    def size(self) -> int:
+        return len(self.labels)
+
+    def encode(self, raw_labels) -> np.ndarray:
+        """Map string labels to an int32 id array."""
+        try:
+            return np.asarray([self._index[str(x)] for x in raw_labels], dtype=np.int32)
+        except KeyError as e:
+            raise ValueError(f"label {e.args[0]!r} not in vocab {self.labels}") from None
+
+    def decode(self, ids) -> list[str]:
+        """Map int ids back to string labels."""
+        return [self.labels[int(i)] for i in np.asarray(ids).reshape(-1)]
+
+    def to_json(self) -> dict:
+        return {"labels": list(self.labels)}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "LabelVocab":
+        return cls(labels=tuple(obj["labels"]))

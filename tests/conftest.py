@@ -1,0 +1,60 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices
+(``--xla_force_host_platform_device_count=8``) so mesh/sharding/
+collective behaviour is exercised without TPU hardware (SURVEY §4,
+"distributed without a cluster"). Real-TPU runs use the
+``requires_tpu`` marker and are skipped here.
+
+Env vars must be set before the first ``import jax`` anywhere in the
+test process, hence this header runs at conftest import time.
+"""
+
+import os
+
+# Force CPU regardless of ambient JAX_PLATFORMS (the dev box tunnels a
+# real TPU chip; unit tests must not depend on it — bench.py does).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The dev image's sitecustomize registers the TPU plugin and overwrites
+# the jax_platforms *config* (which beats the env var). Backends are
+# lazy, so re-pinning the config here — before any computation — wins.
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "requires_tpu: needs real TPU hardware; skipped on CPU"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason="no TPU attached")
+        for item in items:
+            if "requires_tpu" in item.keywords:
+                item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """An 8-device (data=8, model=1) mesh on virtual CPU devices."""
+    from mlapi_tpu.parallel import create_mesh
+
+    return create_mesh((8, 1))
+
+
+@pytest.fixture(scope="session")
+def mesh_2x4():
+    """A (data=2, model=4) mesh for sharded-param configs."""
+    from mlapi_tpu.parallel import create_mesh
+
+    return create_mesh((2, 4))

@@ -1,0 +1,102 @@
+"""Config-1 golden parity: JAX linear classifier vs the reference.
+
+The reference's single published number is the notebook's held-out
+accuracy 0.9666666666666667 on the 30-sample Iris test split
+(``Logistic Regression.ipynb`` cell output, 80/20 split,
+``random_state=1``). We reproduce the identical split and require our
+TPU-native trainer to match or beat it, and additionally cross-check
+prediction/probability agreement against an sklearn oracle trained on
+the same data (SURVEY §4 "golden parity").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlapi_tpu.datasets import load_iris
+from mlapi_tpu.models import get_model
+from mlapi_tpu.train import fit, evaluate
+
+REFERENCE_ACCURACY = 0.9666666666666667
+
+
+@pytest.fixture(scope="module")
+def iris():
+    return load_iris()
+
+
+@pytest.fixture(scope="module")
+def trained(iris):
+    model = get_model(
+        "linear", num_features=iris.num_features, num_classes=iris.num_classes
+    )
+    result = fit(model, iris, steps=500, learning_rate=0.1, weight_decay=1e-3)
+    return model, result
+
+
+def test_split_matches_reference(iris):
+    # 150 rows -> 120 train / 30 test, exactly the notebook's split.
+    assert iris.x_train.shape == (120, 4)
+    assert iris.x_test.shape == (30, 4)
+    assert iris.vocab.labels == (
+        "Iris-setosa",
+        "Iris-versicolor",
+        "Iris-virginica",
+    )
+
+
+def test_accuracy_meets_reference(trained):
+    _, result = trained
+    assert result.test_accuracy is not None
+    assert result.test_accuracy >= REFERENCE_ACCURACY
+
+
+def test_sklearn_oracle_agreement(iris, trained):
+    """Predictions agree with an sklearn LogisticRegression oracle on
+    the test rows where the oracle itself is confident."""
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.linear_model import LogisticRegression
+
+    model, result = trained
+    oracle = LogisticRegression(max_iter=1000).fit(iris.x_train, iris.y_train)
+    oracle_pred = oracle.predict(iris.x_test)
+    oracle_conf = oracle.predict_proba(iris.x_test).max(axis=1)
+
+    logits = jax.jit(model.apply)(result.params, jnp.asarray(iris.x_test))
+    ours = np.argmax(np.asarray(logits), axis=-1)
+
+    confident = oracle_conf > 0.9
+    assert confident.sum() >= 15  # sanity: oracle is confident on half+ rows
+    np.testing.assert_array_equal(ours[confident], oracle_pred[confident])
+
+
+def test_single_forward_is_one_matmul_shared(trained, iris):
+    """Prediction and probability come from ONE forward pass — unlike
+    the reference, which recomputes the matmul (main.py:21-22)."""
+    model, result = trained
+    x = jnp.asarray(iris.x_test[:1])
+    logits = jax.jit(model.apply)(result.params, x)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pred = int(jnp.argmax(logits, axis=-1)[0])
+    assert 0.0 < float(probs[0, pred]) <= 1.0
+    np.testing.assert_allclose(float(jnp.sum(probs)), 1.0, rtol=1e-5)
+
+
+def test_data_parallel_fit_matches(iris, mesh8):
+    """Same training, batch sharded over an 8-device data mesh —
+    accuracy must not degrade (the all-reduce is numerically the same
+    full-batch gradient)."""
+    model = get_model(
+        "linear", num_features=iris.num_features, num_classes=iris.num_classes
+    )
+    result = fit(
+        model, iris, steps=500, learning_rate=0.1, weight_decay=1e-3, mesh=mesh8
+    )
+    assert result.test_accuracy >= REFERENCE_ACCURACY
+
+
+def test_evaluate_matches_manual(trained, iris):
+    model, result = trained
+    acc = evaluate(model.apply, result.params, iris.x_test, iris.y_test)
+    assert acc == pytest.approx(result.test_accuracy)
