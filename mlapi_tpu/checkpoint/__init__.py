@@ -1,0 +1,9 @@
+"""Versioned, atomic, pickle-free checkpoints."""
+
+from mlapi_tpu.checkpoint.io import (  # noqa: F401
+    CheckpointMeta,
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+    tree_signature,
+)

@@ -1,0 +1,19 @@
+"""TPU-native serving stack.
+
+End-to-end request path (contrast with reference ``main.py``, which
+re-loads a pickle and runs sklearn inline per request):
+
+    client ──HTTP──▶ server.py (asyncio HTTP/1.1, keep-alive)
+      └─ asgi.py  App: route match, pydantic 422 validation
+         └─ app.py /predict handler
+            └─ batcher.py  MicroBatcher: coalesce concurrent rows
+               └─ engine.py InferenceEngine: padded bucket batch →
+                  ONE jitted device call (argmax + max-softmax) →
+                  futures resolved per request
+"""
+
+from mlapi_tpu.serving.app import build_app, feature_schema  # noqa: F401
+from mlapi_tpu.serving.asgi import App, HTTPError, Request, Response  # noqa: F401
+from mlapi_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from mlapi_tpu.serving.engine import InferenceEngine  # noqa: F401
+from mlapi_tpu.serving.server import Server  # noqa: F401

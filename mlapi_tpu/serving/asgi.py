@@ -1,0 +1,295 @@
+"""A minimal ASGI web framework (routing + validation + JSON).
+
+The reference leans on FastAPI/Starlette/pydantic for routing, schema
+validation, and (de)serialisation (``main.py:8-16``). Those packages
+aren't part of this stack, so the framework provides its own ASGI 3
+application class with the same ergonomics where they matter for the
+capability contract:
+
+- ``@app.post(path)`` / ``@app.get(path)`` route decorators.
+- Handlers may declare a pydantic ``BaseModel`` parameter: the JSON
+  body is validated against it and a FastAPI-compatible 422
+  ``{"detail": [...]}`` is returned on failure (same observable
+  behaviour as the reference's schema handling).
+- Returned dicts become JSON responses; ``Response`` for anything
+  else.
+- Middleware hooks (used by the metrics/tracing subsystem).
+
+Being a real ASGI app, it runs under the framework's own asyncio
+HTTP server (``mlapi_tpu.serving.server``) in production and under
+``httpx.ASGITransport`` in tests — and would run under uvicorn
+unchanged if that were installed.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import traceback
+from typing import Any, Awaitable, Callable
+
+import pydantic
+
+from mlapi_tpu.serving.multipart import (
+    MultipartError,
+    Part,
+    boundary_from_content_type,
+    parse_multipart,
+)
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.asgi")
+
+
+class HTTPError(Exception):
+    """Raise from a handler to produce a clean JSON error response."""
+
+    def __init__(self, status: int, detail: Any):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+class Request:
+    """One HTTP request: ASGI scope + fully-read body."""
+
+    def __init__(self, scope: dict, body: bytes):
+        self.scope = scope
+        self.body = body
+        self.method: str = scope["method"]
+        self.path: str = scope["path"]
+        self.headers: dict[str, str] = {
+            k.decode("latin-1").lower(): v.decode("latin-1")
+            for k, v in scope.get("headers", [])
+        }
+
+    def json(self) -> Any:
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as e:
+            raise HTTPError(400, f"invalid JSON body: {e}") from None
+
+    def multipart(self) -> list[Part]:
+        ctype = self.headers.get("content-type", "")
+        try:
+            return parse_multipart(self.body, boundary_from_content_type(ctype))
+        except MultipartError as e:
+            raise HTTPError(400, str(e)) from None
+
+    def form(self) -> tuple[dict[str, str], dict[str, Part]]:
+        """(plain fields, file parts) from a multipart body."""
+        fields: dict[str, str] = {}
+        files: dict[str, Part] = {}
+        for part in self.multipart():
+            if part.filename is None:
+                fields[part.name] = part.text()
+            else:
+                files[part.name] = part
+        return fields, files
+
+
+class Response:
+    def __init__(
+        self,
+        body: bytes = b"",
+        status: int = 200,
+        content_type: str = "application/octet-stream",
+        headers: dict[str, str] | None = None,
+    ):
+        self.body = body
+        self.status = status
+        self.headers = {"content-type": content_type, **(headers or {})}
+
+
+def json_response(obj: Any, status: int = 200) -> Response:
+    return Response(
+        json.dumps(obj, separators=(",", ":"), default=_json_default).encode(),
+        status=status,
+        content_type="application/json",
+    )
+
+
+def _json_default(o: Any):
+    # numpy / jax scalars arrive from model code; coerce, don't 500.
+    for attr in ("item", "tolist"):
+        fn = getattr(o, attr, None)
+        if fn is not None:
+            return fn()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+Handler = Callable[..., Awaitable[Any]]
+Middleware = Callable[[Request, Callable[[Request], Awaitable[Response]]], Awaitable[Response]]
+
+
+class App:
+    """ASGI 3 application with method+path routing."""
+
+    def __init__(self, title: str = "mlapi-tpu"):
+        self.title = title
+        self._routes: dict[tuple[str, str], tuple[Handler, type | None]] = {}
+        self._middleware: list[Middleware] = []
+        self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
+        self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
+        self.state: dict[str, Any] = {}
+
+    # -- registration -----------------------------------------------------
+    def route(self, method: str, path: str):
+        def deco(fn: Handler) -> Handler:
+            body_model = _find_body_model(fn)
+            self._routes[(method.upper(), path)] = (fn, body_model)
+            return fn
+
+        return deco
+
+    def post(self, path: str):
+        return self.route("POST", path)
+
+    def get(self, path: str):
+        return self.route("GET", path)
+
+    def middleware(self, fn: Middleware) -> Middleware:
+        self._middleware.append(fn)
+        return fn
+
+    @property
+    def routes(self) -> frozenset[tuple[str, str]]:
+        """Registered (method, path) pairs."""
+        return frozenset(self._routes)
+
+    def on_startup(self, fn):
+        self._startup_hooks.append(fn)
+        return fn
+
+    def on_shutdown(self, fn):
+        self._shutdown_hooks.append(fn)
+        return fn
+
+    # -- dispatch ---------------------------------------------------------
+    async def _dispatch(self, request: Request) -> Response:
+        key = (request.method, request.path)
+        if key not in self._routes:
+            if any(p == request.path for _, p in self._routes):
+                return json_response({"detail": "Method Not Allowed"}, 405)
+            return json_response({"detail": "Not Found"}, 404)
+        handler, body_model = self._routes[key]
+
+        kwargs: dict[str, Any] = {}
+        if body_model is not None:
+            try:
+                payload = body_model.model_validate(request.json())
+            except pydantic.ValidationError as e:
+                # FastAPI-compatible 422 shape.
+                return json_response({"detail": e.errors(include_url=False)}, 422)
+            kwargs[_body_param_name(handler)] = payload
+
+        if _wants_request(handler):
+            kwargs["request"] = request
+
+        result = await handler(**kwargs)
+        if isinstance(result, Response):
+            return result
+        return json_response(result)
+
+    async def handle(self, request: Request) -> Response:
+        call = self._dispatch
+        for mw in reversed(self._middleware):
+            call = _bind_middleware(mw, call)
+        try:
+            return await call(request)
+        except HTTPError as e:
+            return json_response({"detail": e.detail}, e.status)
+        except Exception:
+            _log.error("unhandled error on %s %s\n%s", request.method,
+                        request.path, traceback.format_exc())
+            return json_response({"detail": "Internal Server Error"}, 500)
+
+    # -- ASGI -------------------------------------------------------------
+    async def __call__(self, scope, receive, send):
+        if scope["type"] == "lifespan":
+            await self._lifespan(receive, send)
+            return
+        if scope["type"] != "http":
+            raise RuntimeError(f"unsupported ASGI scope type {scope['type']!r}")
+
+        body = bytearray()
+        while True:
+            message = await receive()
+            body.extend(message.get("body", b""))
+            if not message.get("more_body", False):
+                break
+
+        response = await self.handle(Request(scope, bytes(body)))
+        await send(
+            {
+                "type": "http.response.start",
+                "status": response.status,
+                "headers": [
+                    (k.encode(), v.encode()) for k, v in response.headers.items()
+                ],
+            }
+        )
+        await send({"type": "http.response.body", "body": response.body})
+
+    async def _lifespan(self, receive, send):
+        while True:
+            message = await receive()
+            if message["type"] == "lifespan.startup":
+                try:
+                    await self.startup()
+                    await send({"type": "lifespan.startup.complete"})
+                except Exception as e:
+                    await send({"type": "lifespan.startup.failed", "message": str(e)})
+            elif message["type"] == "lifespan.shutdown":
+                await self.shutdown()
+                await send({"type": "lifespan.shutdown.complete"})
+                return
+
+    async def startup(self):
+        for hook in self._startup_hooks:
+            await hook()
+
+    async def shutdown(self):
+        for hook in self._shutdown_hooks:
+            await hook()
+
+
+def _bind_middleware(mw: Middleware, nxt):
+    async def call(request: Request) -> Response:
+        return await mw(request, nxt)
+
+    return call
+
+
+def _resolved_annotations(fn: Handler) -> dict[str, Any]:
+    """Parameter annotations as real objects, tolerating modules that
+    use ``from __future__ import annotations`` (string annotations)."""
+    anns: dict[str, Any] = {}
+    hints: dict[str, Any] = {}
+    try:
+        import typing
+
+        hints = typing.get_type_hints(fn)
+    except Exception:
+        pass  # unresolvable strings; fall back to raw values below
+    for name, param in inspect.signature(fn).parameters.items():
+        anns[name] = hints.get(name, param.annotation)
+    return anns
+
+
+def _find_body_model(fn: Handler) -> type | None:
+    for ann in _resolved_annotations(fn).values():
+        if isinstance(ann, type) and issubclass(ann, pydantic.BaseModel):
+            return ann
+    return None
+
+
+def _body_param_name(fn: Handler) -> str:
+    for name, ann in _resolved_annotations(fn).items():
+        if isinstance(ann, type) and issubclass(ann, pydantic.BaseModel):
+            return name
+    raise AssertionError("no body model param")
+
+
+def _wants_request(fn: Handler) -> bool:
+    return "request" in inspect.signature(fn).parameters

@@ -1,0 +1,198 @@
+"""Inference engine: params resident on device, one warmed jit forward.
+
+Inverts the reference's hot-path design, which re-loads the pickled
+model from disk **on every request** (``main.py:19``) and then runs
+the matmul twice (``predict`` then ``predict_proba``,
+``main.py:21-22``). Here:
+
+- The checkpoint is loaded **once** at startup (onto the mesh if one
+  is given).
+- The forward pass is jit-compiled once per batch-bucket size at
+  warmup, so no request ever pays XLA compilation.
+- Prediction *and* probability come out of a single device call:
+  ``argmax`` + ``max(softmax)`` over one set of logits, with only two
+  scalars per row transferred back to the host.
+- Requests are padded to a small set of bucket sizes so arbitrary
+  batch sizes never trigger recompilation (static shapes — XLA
+  requirement, SURVEY §7 step 4).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlapi_tpu.parallel import replicate_for_mesh
+from mlapi_tpu.utils.logging import get_logger
+from mlapi_tpu.utils.vocab import LabelVocab
+
+_log = get_logger("serving.engine")
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+class InferenceEngine:
+    """Batched classification inference over a jitted forward pass."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        vocab: LabelVocab,
+        feature_names: Sequence[str],
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: jax.sharding.Mesh | None = None,
+        meta: dict | None = None,
+    ):
+        self.model = model
+        self.vocab = vocab
+        self.feature_names = tuple(feature_names)
+        self.buckets = tuple(sorted(buckets))
+        self.mesh = mesh
+        self.meta = dict(meta or {})
+        if mesh is not None:
+            from mlapi_tpu.parallel import DATA_AXIS
+
+            axis = mesh.shape[DATA_AXIS]
+            bad = [b for b in self.buckets if b % axis]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} not divisible by data-axis size {axis}"
+                )
+            params = replicate_for_mesh(params, mesh)
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+        def forward(p, x):
+            logits = self.model.apply(p, x)
+            probs = jax.nn.softmax(logits, axis=-1)
+            # ONE fused [B, 2] output (id, max-prob) — a single
+            # device→host transfer. Two separate outputs would cost two
+            # round trips, which doubles latency when the chip is
+            # reached over a network tunnel (measured: 65 ms per
+            # readback on the dev tunnel).
+            return jnp.stack(
+                [jnp.argmax(logits, axis=-1).astype(jnp.float32),
+                 jnp.max(probs, axis=-1)],
+                axis=-1,
+            )
+
+        self._forward = jax.jit(forward)
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        path,
+        model=None,
+        *,
+        mesh: jax.sharding.Mesh | None = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+    ) -> "InferenceEngine":
+        """Build the engine from a committed checkpoint dir.
+
+        The model is reconstructed from the checkpoint's own config
+        (``model`` registry name + kwargs) unless one is passed in.
+        """
+        from mlapi_tpu.checkpoint import load_checkpoint
+        from mlapi_tpu.models import get_model
+
+        if model is None:
+            # Peek the manifest for the model config, then restore with
+            # signature validation against the freshly-built model.
+            meta = _load_meta_only(path)
+            cfg = dict(meta.config)
+            name = cfg.pop("model")
+            feature_names = cfg.pop("feature_names", ())
+            model = get_model(name, **cfg)
+        else:
+            feature_names = ()
+
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            model.init(jax.random.key(0)),
+        )
+        params, meta = load_checkpoint(path, abstract)
+        if meta.vocab is None:
+            raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
+        feature_names = meta.config.get("feature_names", feature_names)
+        return cls(
+            model,
+            params,
+            meta.vocab,
+            feature_names,
+            mesh=mesh,
+            buckets=buckets,
+            meta={"step": meta.step, "config_hash": meta.config_hash},
+        )
+
+    # -- shape management -------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        i = bisect.bisect_left(self.buckets, n)
+        return self.buckets[min(i, len(self.buckets) - 1)]
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def warmup(self) -> None:
+        """Compile every bucket shape before serving traffic."""
+        d = len(self.feature_names) or 1
+        for b in self.buckets:
+            x = np.zeros((b, d), np.float32)
+            jax.block_until_ready(self._predict_padded(x))
+        _log.info("warmed %d bucket shapes up to batch=%d", len(self.buckets),
+                  self.max_batch)
+
+    def _predict_padded(self, x: np.ndarray):
+        if self.mesh is not None:
+            from mlapi_tpu.parallel import shard_batch_for_mesh
+
+            x = shard_batch_for_mesh(x, self.mesh)
+        return self._forward(self.params, x)
+
+    # -- public API -------------------------------------------------------
+    def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Classify ``[n, d]`` features → (label ids ``[n]``, max-probs
+        ``[n]``); pads to bucket, chunks past the largest bucket."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2:
+            raise ValueError(f"expected [n, d] features, got shape {x.shape}")
+        n = len(x)
+        ids_out = np.empty((n,), np.int32)
+        probs_out = np.empty((n,), np.float32)
+        start = 0
+        while start < n:
+            chunk = x[start : start + self.max_batch]
+            b = self.bucket_for(len(chunk))
+            padded = np.zeros((b, x.shape[1]), np.float32)
+            padded[: len(chunk)] = chunk
+            fused = np.asarray(self._predict_padded(padded))  # one transfer
+            ids_out[start : start + len(chunk)] = fused[: len(chunk), 0].astype(
+                np.int32
+            )
+            probs_out[start : start + len(chunk)] = fused[: len(chunk), 1]
+            start += len(chunk)
+        return ids_out, probs_out
+
+    def predict_labels(self, x: np.ndarray) -> tuple[list[str], np.ndarray]:
+        ids, probs = self.predict(x)
+        return self.vocab.decode(ids), probs
+
+
+def _load_meta_only(path):
+    """Read just the manifest (no params I/O)."""
+    import json
+    from pathlib import Path
+
+    from mlapi_tpu.checkpoint.io import CheckpointMeta, _MANIFEST
+
+    manifest = Path(path) / _MANIFEST
+    if not manifest.exists():
+        raise FileNotFoundError(f"{path} is not a committed checkpoint")
+    return CheckpointMeta.from_json(json.loads(manifest.read_text()))

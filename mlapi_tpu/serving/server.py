@@ -1,0 +1,271 @@
+"""Asyncio HTTP/1.1 server speaking ASGI — the framework's uvicorn.
+
+The reference runs under uvicorn/h11 (``README.md:16``,
+``requirements.txt:3,17``); neither is part of this stack, so the
+framework ships its own server: a single-process asyncio server with
+persistent connections (keep-alive matters — the p50 budget can't
+afford a TCP+TLS handshake per request), Content-Length and chunked
+request bodies, and hard limits on header/body sizes.
+
+Single event loop, no worker processes: the CPU work per request is
+tiny (parse + validate); the heavy lifting is on the TPU behind the
+micro-batcher, and one loop feeds it comfortably.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from urllib.parse import unquote
+
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.server")
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 256 * 1024 * 1024
+_STATUS_PHRASES = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    408: "Request Timeout", 411: "Length Required", 413: "Payload Too Large",
+    422: "Unprocessable Entity", 431: "Request Header Fields Too Large",
+    500: "Internal Server Error", 501: "Not Implemented",
+}
+
+
+class HttpProtocolError(Exception):
+    def __init__(self, status: int, detail: str):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+class Server:
+    """Serves one ASGI app on (host, port)."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 8000):
+        self.app = app
+        self.host = host
+        self.port = port
+        self._server: asyncio.Server | None = None
+
+    async def start(self) -> None:
+        await self.app.startup()
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        _log.info("listening on http://%s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        await self.app.shutdown()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    # -- connection handling ---------------------------------------------
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    request = await _read_request(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break  # client closed between requests
+                except HttpProtocolError as e:
+                    await _write_simple(writer, e.status, e.detail)
+                    break
+                if request is None:
+                    break
+                keep_alive = await self._dispatch(request, writer)
+                if not keep_alive:
+                    break
+        except Exception:
+            _log.exception("connection error from %s", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _dispatch(self, request: "_ParsedRequest", writer) -> bool:
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": request.version,
+            "method": request.method,
+            "scheme": "http",
+            "path": request.path,
+            "raw_path": request.raw_path.encode("latin-1"),
+            "query_string": request.query.encode("latin-1"),
+            "headers": [
+                (k.encode("latin-1"), v.encode("latin-1"))
+                for k, v in request.headers
+            ],
+        }
+
+        body_sent = False
+
+        async def receive():
+            nonlocal body_sent
+            if body_sent:
+                return {"type": "http.disconnect"}
+            body_sent = True
+            return {"type": "http.request", "body": request.body, "more_body": False}
+
+        response_parts: dict = {"status": 500, "headers": [], "chunks": []}
+
+        async def send(message):
+            if message["type"] == "http.response.start":
+                response_parts["status"] = message["status"]
+                response_parts["headers"] = message.get("headers", [])
+            elif message["type"] == "http.response.body":
+                response_parts["chunks"].append(message.get("body", b""))
+
+        await self.app(scope, receive, send)
+
+        body = b"".join(response_parts["chunks"])
+        keep_alive = _wants_keep_alive(request)
+        headers = [
+            (k.decode("latin-1"), v.decode("latin-1"))
+            for k, v in response_parts["headers"]
+        ]
+        names = {k.lower() for k, _ in headers}
+        if "content-length" not in names:
+            headers.append(("content-length", str(len(body))))
+        headers.append(("connection", "keep-alive" if keep_alive else "close"))
+
+        status = response_parts["status"]
+        phrase = _STATUS_PHRASES.get(status, "Unknown")
+        head = [f"HTTP/1.1 {status} {phrase}"]
+        head.extend(f"{k}: {v}" for k, v in headers)
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+        writer.write(body)
+        await writer.drain()
+        return keep_alive
+
+
+class _ParsedRequest:
+    __slots__ = ("method", "raw_path", "path", "query", "version", "headers", "body")
+
+    def __init__(self, method, raw_path, path, query, version, headers, body):
+        self.method = method
+        self.raw_path = raw_path
+        self.path = path
+        self.query = query
+        self.version = version
+        self.headers = headers
+        self.body = body
+
+
+async def _read_request(reader: asyncio.StreamReader) -> _ParsedRequest | None:
+    try:
+        head = await reader.readuntil(b"\r\n\r\n")
+    except asyncio.LimitOverrunError:
+        raise HttpProtocolError(431, "headers too large") from None
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None  # clean EOF between keep-alive requests
+        raise
+    if len(head) > MAX_HEADER_BYTES:
+        raise HttpProtocolError(431, "headers too large")
+
+    lines = head.decode("latin-1").split("\r\n")
+    try:
+        method, target, proto = lines[0].split(" ", 2)
+    except ValueError:
+        raise HttpProtocolError(400, f"malformed request line: {lines[0]!r}") from None
+    if not proto.startswith("HTTP/1."):
+        raise HttpProtocolError(501, f"unsupported protocol {proto!r}")
+    version = proto.removeprefix("HTTP/")
+
+    headers: list[tuple[str, str]] = []
+    for line in lines[1:]:
+        if not line:
+            continue
+        key, sep, value = line.partition(":")
+        if not sep:
+            raise HttpProtocolError(400, f"malformed header line: {line!r}")
+        headers.append((key.strip().lower(), value.strip()))
+
+    hmap = dict(headers)
+    body = b""
+    if "transfer-encoding" in hmap:
+        if hmap["transfer-encoding"].lower() != "chunked":
+            raise HttpProtocolError(501, "unsupported transfer-encoding")
+        body = await _read_chunked(reader)
+    elif "content-length" in hmap:
+        try:
+            n = int(hmap["content-length"])
+        except ValueError:
+            raise HttpProtocolError(400, "bad content-length") from None
+        if n > MAX_BODY_BYTES:
+            raise HttpProtocolError(413, "body too large")
+        body = await reader.readexactly(n) if n else b""
+    elif method in ("POST", "PUT", "PATCH"):
+        # No length and not chunked: only valid if there is no body.
+        pass
+
+    raw_path, _, query = target.partition("?")
+    return _ParsedRequest(
+        method=method,
+        raw_path=target,
+        path=unquote(raw_path),
+        query=query,
+        version=version,
+        headers=headers,
+        body=body,
+    )
+
+
+async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
+    out = bytearray()
+    while True:
+        size_line = (await reader.readuntil(b"\r\n")).strip()
+        try:
+            size = int(size_line.split(b";")[0], 16)
+        except ValueError:
+            raise HttpProtocolError(400, f"bad chunk size {size_line!r}") from None
+        if size < 0:
+            raise HttpProtocolError(400, f"negative chunk size {size_line!r}")
+        if size == 0:
+            # Trailers until blank line.
+            while (await reader.readuntil(b"\r\n")) != b"\r\n":
+                pass
+            return bytes(out)
+        if len(out) + size > MAX_BODY_BYTES:
+            raise HttpProtocolError(413, "body too large")
+        out.extend(await reader.readexactly(size))
+        if await reader.readexactly(2) != b"\r\n":
+            raise HttpProtocolError(400, "chunk not CRLF-terminated")
+
+
+def _wants_keep_alive(request: _ParsedRequest) -> bool:
+    conn = dict(request.headers).get("connection", "").lower()
+    if request.version == "1.0":
+        return conn == "keep-alive"
+    return conn != "close"
+
+
+async def _write_simple(writer, status: int, detail: str) -> None:
+    body = detail.encode()
+    phrase = _STATUS_PHRASES.get(status, "Error")
+    writer.write(
+        (
+            f"HTTP/1.1 {status} {phrase}\r\ncontent-type: text/plain\r\n"
+            f"content-length: {len(body)}\r\nconnection: close\r\n\r\n"
+        ).encode("latin-1")
+        + body
+    )
+    try:
+        await writer.drain()
+    except (ConnectionResetError, BrokenPipeError):
+        pass

@@ -1,0 +1,41 @@
+"""Structured logging.
+
+The reference's only observability is ``print(df)`` (``main.py:34``).
+This module gives every subsystem a namespaced logger with a single
+consistent format; serving adds request metrics on top
+(``mlapi_tpu.utils.metrics``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_ROOT = "mlapi_tpu"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger(_ROOT)
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        root.addHandler(handler)
+    root.setLevel(os.environ.get("MLAPI_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Logger namespaced under the framework root (e.g. ``serving.asgi``)."""
+    _configure()
+    return logging.getLogger(f"{_ROOT}.{name}")

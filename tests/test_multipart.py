@@ -1,0 +1,80 @@
+"""Multipart parser unit tests (RFC 7578 shapes + malformed bodies)."""
+
+import pytest
+
+from mlapi_tpu.serving.multipart import (
+    MultipartError,
+    boundary_from_content_type,
+    parse_multipart,
+)
+
+
+def encode(parts, boundary=b"BoUnDaRy123"):
+    out = bytearray()
+    for name, filename, ctype, data in parts:
+        out += b"--" + boundary + b"\r\n"
+        disp = f'Content-Disposition: form-data; name="{name}"'
+        if filename is not None:
+            disp += f'; filename="{filename}"'
+        out += disp.encode() + b"\r\n"
+        if ctype:
+            out += f"Content-Type: {ctype}".encode() + b"\r\n"
+        out += b"\r\n" + data + b"\r\n"
+    out += b"--" + boundary + b"--\r\n"
+    return bytes(out)
+
+
+def test_fields_and_files():
+    body = encode(
+        [
+            ("token", None, None, b"sekrit"),
+            ("file", "iris.csv", "text/csv", b"a,b\r\n1,2\r\n"),
+        ]
+    )
+    parts = parse_multipart(body, b"BoUnDaRy123")
+    assert [p.name for p in parts] == ["token", "file"]
+    assert parts[0].filename is None and parts[0].text() == "sekrit"
+    assert parts[1].filename == "iris.csv"
+    assert parts[1].content_type == "text/csv"
+    assert parts[1].data == b"a,b\r\n1,2\r\n"
+
+
+def test_binary_data_with_crlf_inside():
+    payload = b"line1\r\nline2\r\n\r\nbinary\x00\xff"
+    parts = parse_multipart(
+        encode([("file", "x.bin", None, payload)]), b"BoUnDaRy123"
+    )
+    assert parts[0].data == payload
+
+
+def test_boundary_extraction():
+    assert (
+        boundary_from_content_type('multipart/form-data; boundary="abc123"')
+        == b"abc123"
+    )
+    assert (
+        boundary_from_content_type("multipart/form-data; boundary=xyz") == b"xyz"
+    )
+    with pytest.raises(MultipartError):
+        boundary_from_content_type("application/json")
+
+
+def test_unterminated_body_rejected():
+    body = encode([("a", None, None, b"1")])
+    with pytest.raises(MultipartError, match="terminated"):
+        parse_multipart(body[:-8], b"BoUnDaRy123")
+
+
+def test_missing_name_rejected():
+    boundary = b"B"
+    body = (
+        b"--B\r\nContent-Disposition: form-data\r\n\r\ndata\r\n--B--\r\n"
+    )
+    with pytest.raises(MultipartError, match="field name"):
+        parse_multipart(body, boundary)
+
+
+def test_wrong_boundary_rejected():
+    body = encode([("a", None, None, b"1")])
+    with pytest.raises(MultipartError, match="never appears"):
+        parse_multipart(body, b"NotTheBoundary")

@@ -1,0 +1,152 @@
+"""API contract tests against the ASGI app (in-process, httpx).
+
+Mirrors what the reference *would* test (SURVEY §4): the `/predict`
+schema/response contract of ``main.py:16-27`` and the `/files/`
+multipart contract of ``main.py:29-38`` — plus the subsystems the
+reference lacked (health, metrics, clean errors)."""
+
+import asyncio
+
+import httpx
+import pytest
+
+from mlapi_tpu.checkpoint import save_checkpoint
+from mlapi_tpu.datasets import load_iris
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving import InferenceEngine, build_app
+from mlapi_tpu.train import fit
+
+SETOSA = {
+    "sepal_length": 5.1,
+    "sepal_width": 3.5,
+    "petal_length": 1.4,
+    "petal_width": 0.2,
+}
+
+
+@pytest.fixture(scope="module")
+def iris_checkpoint(tmp_path_factory):
+    iris = load_iris()
+    model = get_model(
+        "linear", num_features=iris.num_features, num_classes=iris.num_classes
+    )
+    result = fit(model, iris, steps=300, learning_rate=0.1, weight_decay=1e-3)
+    path = tmp_path_factory.mktemp("ckpt") / "iris"
+    save_checkpoint(
+        path,
+        result.params,
+        step=result.steps,
+        config={
+            "model": "linear",
+            "num_features": iris.num_features,
+            "num_classes": iris.num_classes,
+            "feature_names": list(iris.feature_names),
+        },
+        vocab=iris.vocab,
+    )
+    return path
+
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture()
+async def client(iris_checkpoint):
+    engine = InferenceEngine.from_checkpoint(iris_checkpoint)
+    app = build_app(engine, max_wait_ms=0.0)
+    await app.startup()
+    transport = httpx.ASGITransport(app=app)
+    async with httpx.AsyncClient(
+        transport=transport, base_url="http://test"
+    ) as c:
+        yield c
+    await app.shutdown()
+
+
+async def test_predict_contract(client):
+    r = await client.post("/predict", json=SETOSA)
+    assert r.status_code == 200
+    body = r.json()
+    assert set(body) == {"prediction", "probability"}
+    assert body["prediction"] == "Iris-setosa"
+    assert 0.8 < body["probability"] <= 1.0
+
+
+async def test_predict_coerces_numeric_strings(client):
+    # pydantic coerces "5.1" -> 5.1, same as the reference's pydantic v1.
+    r = await client.post("/predict", json={k: str(v) for k, v in SETOSA.items()})
+    assert r.status_code == 200
+    assert r.json()["prediction"] == "Iris-setosa"
+
+
+async def test_predict_missing_field_422(client):
+    bad = dict(SETOSA)
+    del bad["petal_width"]
+    r = await client.post("/predict", json=bad)
+    assert r.status_code == 422
+    detail = r.json()["detail"]
+    assert any("petal_width" in str(item.get("loc", "")) for item in detail)
+
+
+async def test_predict_non_numeric_422(client):
+    r = await client.post("/predict", json={**SETOSA, "sepal_length": "wide"})
+    assert r.status_code == 422
+
+
+async def test_invalid_json_400(client):
+    r = await client.post("/predict", content=b"{not json")
+    assert r.status_code == 400
+
+
+async def test_unknown_route_404_and_wrong_method_405(client):
+    assert (await client.post("/nope", json={})).status_code == 404
+    assert (await client.get("/predict")).status_code == 405
+
+
+async def test_files_roundtrip(client):
+    csv = b"sepal_length,species\n5.1,Iris-setosa\n6.2,Iris-virginica\n"
+    r = await client.post(
+        "/files/",
+        files={"file": ("iris.csv", csv, "text/csv")},
+        data={"token": "tok123"},
+    )
+    assert r.status_code == 200
+    body = r.json()
+    assert body["token"] == "tok123"
+    assert body["file"]["columns"] == ["sepal_length", "species"]
+    assert body["file"]["rows"] == 2
+    assert body["file"]["records"][0]["species"] == "Iris-setosa"
+    assert body["file"]["truncated"] is False
+
+
+async def test_files_missing_token_422(client):
+    r = await client.post("/files/", files={"file": ("a.csv", b"a\n1\n")})
+    assert r.status_code == 422
+
+
+async def test_files_non_utf8_400(client):
+    r = await client.post(
+        "/files/",
+        files={"file": ("a.csv", b"\xff\xfe\x00bad")},
+        data={"token": "t"},
+    )
+    assert r.status_code == 400
+
+
+async def test_healthz_and_metrics(client):
+    await client.post("/predict", json=SETOSA)
+    h = (await client.get("/healthz")).json()
+    assert h["status"] == "ok"
+    assert h["classes"] == ["Iris-setosa", "Iris-versicolor", "Iris-virginica"]
+    m = (await client.get("/metrics")).json()
+    assert m["counters"]["batcher.requests"] >= 1
+    route_keys = [k for k in m["histograms"] if "/predict" in k]
+    assert route_keys and m["histograms"][route_keys[0]]["count"] >= 1
+
+
+async def test_concurrent_predictions_all_resolve(client):
+    rs = await asyncio.gather(
+        *(client.post("/predict", json=SETOSA) for _ in range(32))
+    )
+    assert all(r.status_code == 200 for r in rs)
+    assert all(r.json()["prediction"] == "Iris-setosa" for r in rs)
