@@ -1,0 +1,181 @@
+"""Typed config system covering the ``BASELINE.json:6-12`` ladder.
+
+The reference has no config at all — hardcoded filename
+(``main.py:19``), hardcoded dataset URL and split in the notebook
+(SURVEY §5). Here every training run is described by one
+``TrainConfig`` (buildable from YAML or CLI flags), and the five
+ladder configs ship as named presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """One training run: model, data, optimization, parallelism."""
+
+    name: str
+    model: str
+    model_kwargs: dict[str, Any] = field(default_factory=dict)
+    dataset: str = "iris"
+    dataset_kwargs: dict[str, Any] = field(default_factory=dict)
+
+    steps: int = 500
+    batch_size: int | None = None  # None = full batch
+    optimizer: str = "adam"
+    learning_rate: float = 0.1
+    weight_decay: float = 0.0
+    seed: int = 0
+    eval_every: int = 0
+
+    # Parallelism: mesh shape over (data, model) axes. None = no mesh
+    # (single device). (8, 1) = pure DP over 8 chips, (2, 4) = DP x TP.
+    mesh_shape: tuple[int, ...] | None = None
+
+    checkpoint_dir: str | None = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["mesh_shape"] = list(self.mesh_shape) if self.mesh_shape else None
+        return d
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "TrainConfig":
+        obj = dict(obj)
+        if obj.get("mesh_shape") is not None:
+            obj["mesh_shape"] = tuple(obj["mesh_shape"])
+        return cls(**obj)
+
+    @classmethod
+    def from_yaml(cls, path: str | Path) -> "TrainConfig":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_json(yaml.safe_load(f))
+
+
+# --- the ladder (BASELINE.json:6-12) ------------------------------------
+
+_PRESETS: dict[str, TrainConfig] = {}
+
+
+def register_preset(cfg: TrainConfig) -> TrainConfig:
+    if cfg.name in _PRESETS:
+        raise ValueError(f"preset {cfg.name!r} already registered")
+    _PRESETS[cfg.name] = cfg
+    return cfg
+
+
+def preset_available(cfg: TrainConfig) -> bool:
+    """True iff the preset's model and dataset are both registered in
+    this build (the ladder lands incrementally; a preset only shows up
+    in the CLI once it can actually run)."""
+    from mlapi_tpu.datasets import dataset_registered
+    from mlapi_tpu.models import model_registered
+
+    return model_registered(cfg.model) and dataset_registered(cfg.dataset)
+
+
+def get_preset(name: str) -> TrainConfig:
+    try:
+        cfg = _PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {name!r}; available: {sorted(_PRESETS)}"
+        ) from None
+    if not preset_available(cfg):
+        raise ValueError(
+            f"preset {name!r} needs model {cfg.model!r} and dataset "
+            f"{cfg.dataset!r}, which are not both registered in this build"
+        )
+    return cfg
+
+
+def preset_names(*, only_available: bool = True) -> list[str]:
+    if not only_available:
+        return sorted(_PRESETS)
+    return sorted(n for n, c in _PRESETS.items() if preset_available(c))
+
+
+register_preset(
+    TrainConfig(
+        name="iris-linear",
+        model="linear",
+        model_kwargs={"num_features": 4, "num_classes": 3},
+        dataset="iris",
+        steps=500,
+        learning_rate=0.1,
+        weight_decay=1e-3,
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="mnist-softmax",
+        model="linear",
+        model_kwargs={"num_features": 784, "num_classes": 10},
+        dataset="mnist",
+        steps=2000,
+        batch_size=256,
+        learning_rate=1e-3,
+        eval_every=500,
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="fashion-mlp",
+        model="mlp",
+        model_kwargs={
+            "num_features": 784,
+            "num_classes": 10,
+            "hidden_dims": [256, 128],
+        },
+        dataset="fashion_mnist",
+        steps=3000,
+        batch_size=256,
+        learning_rate=1e-3,
+        eval_every=500,
+        mesh_shape=(8, 1),  # pure data-parallel over a v5e-8
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="criteo-widedeep",
+        model="wide_deep",
+        model_kwargs={
+            "num_dense": 13,
+            "vocab_sizes": [100_000] * 26,
+            "embed_dim": 16,
+            "hidden_dims": [256, 128],
+            "num_classes": 2,
+        },
+        dataset="criteo",
+        steps=2000,
+        batch_size=1024,
+        learning_rate=1e-3,
+        eval_every=500,
+        mesh_shape=(2, 4),  # DP x model-sharded embeddings
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="sst2-bert",
+        model="bert_classifier",
+        model_kwargs={"bert_preset": "bert-base-uncased", "num_classes": 2},
+        dataset="sst2",
+        steps=3000,
+        batch_size=32,
+        optimizer="adamw",
+        learning_rate=2e-5,
+        eval_every=500,
+        mesh_shape=(2, 4),  # DP x TP
+    )
+)

@@ -21,7 +21,11 @@ from mlapi_tpu.utils.vocab import LabelVocab
 
 @dataclass(frozen=True)
 class SupervisedSplits:
-    """Train/test split of a supervised dataset, labels already encoded."""
+    """Train/test split of a supervised dataset, labels already encoded.
+
+    ``source`` records provenance: ``"real"`` / ``"idx"`` for actual
+    dataset files, ``"synthetic"`` for the air-gapped stand-ins.
+    """
 
     x_train: np.ndarray
     y_train: np.ndarray  # int32 class ids
@@ -29,6 +33,7 @@ class SupervisedSplits:
     y_test: np.ndarray  # int32 class ids
     vocab: LabelVocab
     feature_names: tuple[str, ...] = ()
+    source: str = "real"
 
     @property
     def num_features(self) -> int:
@@ -39,4 +44,31 @@ class SupervisedSplits:
         return self.vocab.size
 
 
+from mlapi_tpu.utils.registry import Registry
+
+_LOADERS: Registry = Registry("dataset")
+register_dataset = _LOADERS.register
+
+
+def get_dataset(name: str, **kwargs) -> SupervisedSplits:
+    """Load a dataset by registry name (``iris``, ``mnist``, …)."""
+    return _LOADERS.get(name)(**kwargs)
+
+
+def dataset_registered(name: str) -> bool:
+    return name in _LOADERS
+
+
+def registered_datasets() -> list[str]:
+    return _LOADERS.names()
+
+
 from mlapi_tpu.datasets.iris import load_iris  # noqa: E402,F401
+from mlapi_tpu.datasets.mnist import (  # noqa: E402,F401
+    load_fashion_mnist,
+    load_mnist,
+)
+
+register_dataset("iris")(load_iris)
+register_dataset("mnist")(load_mnist)
+register_dataset("fashion_mnist")(load_fashion_mnist)

@@ -16,38 +16,27 @@ Registry: ``get_model(name, **kwargs)`` builds a model by config name.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from mlapi_tpu.utils.registry import Registry
 
-_REGISTRY: dict[str, Callable[..., Any]] = {}
-
-
-def register_model(name: str):
-    """Decorator registering a model factory under a config name."""
-
-    def deco(factory):
-        if name in _REGISTRY:
-            raise ValueError(f"model {name!r} already registered")
-        _REGISTRY[name] = factory
-        return factory
-
-    return deco
+_REGISTRY: Registry = Registry("model")
+register_model = _REGISTRY.register
 
 
 def get_model(name: str, **kwargs):
     """Build a model by registry name (e.g. ``linear``, ``mlp``)."""
-    try:
-        factory = _REGISTRY[name]
-    except KeyError:
-        raise ValueError(
-            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
-        ) from None
-    return factory(**kwargs)
+    return _REGISTRY.get(name)(**kwargs)
+
+
+def model_registered(name: str) -> bool:
+    return name in _REGISTRY
 
 
 def registered_models() -> list[str]:
-    return sorted(_REGISTRY)
+    return _REGISTRY.names()
 
 
 # Import model modules for their registration side effects.
 from mlapi_tpu.models import linear as _linear  # noqa: E402,F401
+from mlapi_tpu.models import mlp as _mlp  # noqa: E402,F401
 from mlapi_tpu.models.linear import LinearClassifier  # noqa: E402,F401
+from mlapi_tpu.models.mlp import MLPClassifier  # noqa: E402,F401

@@ -1,0 +1,66 @@
+"""MLP classifier (Flax) — config 3 of the ladder
+(``BASELINE.json:9``: 2-layer MLP on Fashion-MNIST, data-parallel).
+
+Hidden matmuls run in bfloat16 on TPU (MXU-native) with float32
+params and a float32 final layer/softmax — the standard mixed
+precision recipe; the loss stays numerically stable while the FLOPs
+ride the systolic array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mlapi_tpu.models import register_model
+
+
+class _MLP(nn.Module):
+    hidden_dims: tuple[int, ...]
+    num_classes: int
+    compute_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        for i, width in enumerate(self.hidden_dims):
+            x = nn.Dense(width, dtype=self.compute_dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        # Final projection + logits in f32 for a stable softmax/CE.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="out")(
+            x.astype(jnp.float32)
+        )
+
+
+@register_model("mlp")
+@dataclass(frozen=True)
+class MLPClassifier:
+    """Functional wrapper: ``init(rng) -> params``, ``apply(params, x)``."""
+
+    num_features: int
+    num_classes: int
+    hidden_dims: tuple[int, ...] = (256, 128)
+    compute_dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        # Configs arriving from JSON/YAML carry lists; params must stay
+        # hashable (frozen dataclass) for jit-cache keying.
+        object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
+
+    @property
+    def _module(self) -> _MLP:
+        return _MLP(
+            hidden_dims=tuple(self.hidden_dims),
+            num_classes=self.num_classes,
+            compute_dtype=jnp.dtype(self.compute_dtype),
+        )
+
+    def init(self, rng: jax.Array) -> dict:
+        dummy = jnp.zeros((1, self.num_features), jnp.float32)
+        return self._module.init(rng, dummy)["params"]
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        return self._module.apply({"params": params}, x)

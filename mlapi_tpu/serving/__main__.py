@@ -43,8 +43,10 @@ def _demo_iris_checkpoint() -> str:
         step=result.steps,
         config={
             "model": "linear",
-            "num_features": iris.num_features,
-            "num_classes": iris.num_classes,
+            "model_kwargs": {
+                "num_features": iris.num_features,
+                "num_classes": iris.num_classes,
+            },
             "feature_names": list(iris.feature_names),
         },
         vocab=iris.vocab,

@@ -42,10 +42,13 @@ def feature_schema(feature_names) -> type[pydantic.BaseModel]:
     """Build the request schema from the model's feature names — for
     Iris this reproduces the reference's ``IrisSpecies``
     (``main.py:10-14``): four required floats, numeric strings
-    coerced."""
-    return pydantic.create_model(
-        "Features", **{name: (float, ...) for name in feature_names}
-    )
+    coerced. Models without named features (e.g. 784-pixel MNIST)
+    take ``{"features": [..784 floats..]}`` instead."""
+    if feature_names:
+        return pydantic.create_model(
+            "Features", **{name: (float, ...) for name in feature_names}
+        )
+    return pydantic.create_model("Features", features=(list[float], ...))
 
 
 def build_app(
@@ -64,6 +67,7 @@ def build_app(
 
     schema = feature_schema(engine.feature_names)
     order = engine.feature_names
+    expected_dim = engine.num_features
 
     @app.on_startup
     async def _start():
@@ -96,7 +100,25 @@ def build_app(
 
     @app.post("/predict")
     async def predict(features: schema):  # type: ignore[valid-type]
-        row = np.asarray([getattr(features, f) for f in order], np.float32)
+        if order:
+            row = np.asarray([getattr(features, f) for f in order], np.float32)
+        else:
+            row = np.asarray(features.features, np.float32)
+        if row.shape != (expected_dim,):
+            # Same FastAPI-shaped detail list as pydantic 422s, so
+            # clients parse every validation failure one way.
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["features"],
+                        "msg": f"expected {expected_dim} features, "
+                               f"got {row.shape[0]}",
+                        "input": int(row.shape[0]),
+                    }
+                ],
+            )
         label, prob = await batcher.submit(row)
         return {"prediction": label, "probability": prob}
 

@@ -24,7 +24,7 @@ pickle-load + two matmuls inline on the event loop (``main.py:19-22``).
 from __future__ import annotations
 
 import asyncio
-from concurrent.futures import ThreadPoolExecutor
+import threading
 
 import numpy as np
 
@@ -43,22 +43,22 @@ class MicroBatcher:
         max_batch: int | None = None,
         max_wait_ms: float = 0.2,
         max_queue: int = 8192,
-        max_inflight: int = 8,
+        max_inflight: int = 4,
+        dispatch_timeout_s: float = 30.0,
     ):
         self.engine = engine
         self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
         self.max_wait_s = max_wait_ms / 1e3
         self.max_inflight = max_inflight
+        self.dispatch_timeout_s = dispatch_timeout_s
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
-        self._executor = ThreadPoolExecutor(
-            max_workers=max_inflight, thread_name_prefix="tpu-dispatch"
-        )
         self._inflight: asyncio.Semaphore | None = None
         self._task: asyncio.Task | None = None
         self._resolvers: set[asyncio.Task] = set()
         # Stats (read by /metrics and the coalescing test).
         self.device_calls = 0
         self.requests = 0
+        self.timeouts = 0
 
     async def start(self) -> None:
         if self._task is None:
@@ -84,7 +84,6 @@ class MicroBatcher:
             _, fut = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("batcher stopped"))
-        self._executor.shutdown(wait=False)
 
     async def submit(self, row: np.ndarray) -> tuple[str, float]:
         """Queue one feature row; resolves to (label, probability)."""
@@ -121,15 +120,64 @@ class MicroBatcher:
             # max_inflight device round trips overlap, while this loop
             # goes straight back to collecting the next batch.
             await self._inflight.acquire()
-            work = loop.run_in_executor(self._executor, self._predict_sync, batch)
+            work = self._dispatch_thread(loop, batch)
             resolver = asyncio.create_task(self._resolve(work, futures))
             self._resolvers.add(resolver)
             resolver.add_done_callback(self._resolvers.discard)
 
-    async def _resolve(self, work, futures) -> None:
+    def _dispatch_thread(self, loop, batch: np.ndarray) -> asyncio.Future:
+        """Run one device call on its own daemon thread.
+
+        A dedicated thread per batch (not a fixed pool): if a call
+        wedges (lost transport RPC), only that thread is stranded —
+        after the watchdog fires, fresh batches still get fresh
+        threads, so the batcher recovers instead of exhausting a pool
+        whose every worker is stuck. Steady-state thread count equals
+        in-flight batches (≤ max_inflight).
+        """
+        fut: asyncio.Future = loop.create_future()
+        self.device_calls += 1
+
+        def runner():
+            try:
+                out = self.engine.predict_labels(batch)
+            except Exception as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(self._finish_future, fut, None, e)
+            else:
+                loop.call_soon_threadsafe(self._finish_future, fut, out, None)
+
+        threading.Thread(
+            target=runner, name="tpu-dispatch", daemon=True
+        ).start()
+        return fut
+
+    @staticmethod
+    def _finish_future(fut: asyncio.Future, result, exc) -> None:
+        # The watchdog may have abandoned this future already; a late
+        # arrival is dropped silently (nobody is waiting for it).
+        if fut.done():
+            return
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+
+    async def _resolve(self, work: asyncio.Future, futures) -> None:
         try:
-            labels, probs = await work
+            # The watchdog is a failure detector, not flow control: a
+            # wedged device call fails its own requests and frees the
+            # in-flight slot instead of deadlocking the whole batcher.
+            labels, probs = await asyncio.wait_for(
+                asyncio.shield(work), self.dispatch_timeout_s
+            )
         except Exception as e:
+            if isinstance(e, asyncio.TimeoutError):
+                self.timeouts += 1
+                work.cancel()  # nobody will consume a late result
+                e = RuntimeError(
+                    f"device call exceeded {self.dispatch_timeout_s}s "
+                    "(wedged accelerator or transport?)"
+                )
             _log.error("batch of %d failed: %s", len(futures), e)
             for f in futures:
                 if not f.done():
@@ -140,7 +188,3 @@ class MicroBatcher:
         for f, label, prob in zip(futures, labels, probs):
             if not f.done():
                 f.set_result((label, float(prob)))
-
-    def _predict_sync(self, batch: np.ndarray):
-        self.device_calls += 1
-        return self.engine.predict_labels(batch)

@@ -52,6 +52,9 @@ class InferenceEngine:
         self.model = model
         self.vocab = vocab
         self.feature_names = tuple(feature_names)
+        self.num_features = int(
+            getattr(model, "num_features", 0) or len(self.feature_names) or 1
+        )
         self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
         self.meta = dict(meta or {})
@@ -106,10 +109,10 @@ class InferenceEngine:
             # Peek the manifest for the model config, then restore with
             # signature validation against the freshly-built model.
             meta = _load_meta_only(path)
-            cfg = dict(meta.config)
-            name = cfg.pop("model")
-            feature_names = cfg.pop("feature_names", ())
-            model = get_model(name, **cfg)
+            model = get_model(
+                meta.config["model"], **meta.config.get("model_kwargs", {})
+            )
+            feature_names = meta.config.get("feature_names", ())
         else:
             feature_names = ()
 
@@ -142,7 +145,7 @@ class InferenceEngine:
 
     def warmup(self) -> None:
         """Compile every bucket shape before serving traffic."""
-        d = len(self.feature_names) or 1
+        d = self.num_features
         for b in self.buckets:
             x = np.zeros((b, d), np.float32)
             jax.block_until_ready(self._predict_padded(x))

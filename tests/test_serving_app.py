@@ -38,8 +38,10 @@ def iris_checkpoint(tmp_path_factory):
         step=result.steps,
         config={
             "model": "linear",
-            "num_features": iris.num_features,
-            "num_classes": iris.num_classes,
+            "model_kwargs": {
+                "num_features": iris.num_features,
+                "num_classes": iris.num_classes,
+            },
             "feature_names": list(iris.feature_names),
         },
         vocab=iris.vocab,
@@ -150,3 +152,36 @@ async def test_concurrent_predictions_all_resolve(client):
     )
     assert all(r.status_code == 200 for r in rs)
     assert all(r.json()["prediction"] == "Iris-setosa" for r in rs)
+
+
+async def test_array_schema_for_unnamed_features(tmp_path):
+    """Models without named features (MNIST-family) serve via
+    {"features": [...]} with length validation."""
+    import jax
+
+    from mlapi_tpu.models import get_model
+    from mlapi_tpu.utils.vocab import LabelVocab
+
+    model = get_model("mlp", num_features=16, num_classes=3, hidden_dims=(8,))
+    engine = InferenceEngine(
+        model,
+        model.init(jax.random.key(0)),
+        LabelVocab(labels=("a", "b", "c")),
+        feature_names=(),
+        buckets=(1, 2, 4),
+    )
+    app = build_app(engine, max_wait_ms=0.0)
+    await app.startup()
+    try:
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(transport=transport, base_url="http://t") as c:
+            ok = await c.post("/predict", json={"features": [0.1] * 16})
+            assert ok.status_code == 200
+            assert ok.json()["prediction"] in ("a", "b", "c")
+            bad = await c.post("/predict", json={"features": [0.1] * 5})
+            assert bad.status_code == 422
+            detail = bad.json()["detail"]  # FastAPI-shaped list
+            assert detail[0]["loc"] == ["features"]
+            assert "expected 16 features" in detail[0]["msg"]
+    finally:
+        await app.shutdown()
