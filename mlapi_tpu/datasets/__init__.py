@@ -12,7 +12,7 @@ config. Replaces the reference's in-notebook
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -34,6 +34,9 @@ class SupervisedSplits:
     vocab: LabelVocab
     feature_names: tuple[str, ...] = ()
     source: str = "real"
+    # Loader-specific metadata that must travel into checkpoints
+    # (e.g. the text pipeline's tokenizer fingerprint + max_len).
+    extras: dict = field(default_factory=dict)
 
     @property
     def num_features(self) -> int:
@@ -72,3 +75,6 @@ from mlapi_tpu.datasets.mnist import (  # noqa: E402,F401
 register_dataset("iris")(load_iris)
 register_dataset("mnist")(load_mnist)
 register_dataset("fashion_mnist")(load_fashion_mnist)
+
+from mlapi_tpu.datasets.criteo import load_criteo  # noqa: E402,F401  (self-registers)
+from mlapi_tpu.datasets.sst2 import load_sst2  # noqa: E402,F401  (self-registers)

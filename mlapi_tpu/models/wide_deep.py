@@ -1,0 +1,134 @@
+"""Wide&Deep classifier — config 4 of the ladder
+(``BASELINE.json:10``); the first model where mesh layout matters
+(SURVEY §7 step 6).
+
+Architecture (Cheng et al. 2016, re-designed for TPU/GSPMD):
+
+- **Wide**: linear on the dense features + per-(feature, id) scalar
+  weights for the categoricals — implemented as dim-``num_classes``
+  embedding lookups so the whole wide path is gathers + one matmul.
+- **Deep**: dim-``embed_dim`` embeddings per categorical feature,
+  concatenated with the dense features into an MLP (bfloat16 hidden
+  compute on the MXU, f32 logits).
+
+All 26 tables share one stacked tensor ``[F, V, D]`` (vocabs padded
+to the max size), so the lookup is ONE advanced-indexing gather that
+XLA maps onto a batched dynamic-slice — no per-feature Python loop in
+the traced graph.
+
+Sharding: the tables' vocab axis is the big dimension
+(26 × 100k × 16 floats for the preset), so ``param_shardings`` places
+it on the ``model`` mesh axis — each chip owns a slab of the hash
+space and XLA turns the gather into gather + all-to-all over ICI.
+Everything else (dense weights, MLP) is small and replicated.
+
+Input rows are flat float32 ``[num_dense + F]`` vectors (categorical
+ids as floats, cast inside ``apply``) so the tabular serving stack —
+schema, batcher, engine — works unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from mlapi_tpu.models import register_model
+
+
+@register_model("wide_deep")
+@dataclass(frozen=True)
+class WideDeepClassifier:
+    num_dense: int
+    vocab_sizes: tuple[int, ...]
+    embed_dim: int = 16
+    hidden_dims: tuple[int, ...] = (256, 128)
+    num_classes: int = 2
+    compute_dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        object.__setattr__(self, "vocab_sizes", tuple(self.vocab_sizes))
+        object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
+
+    @property
+    def num_categorical(self) -> int:
+        return len(self.vocab_sizes)
+
+    @property
+    def num_features(self) -> int:
+        return self.num_dense + self.num_categorical
+
+    @property
+    def padded_vocab(self) -> int:
+        return max(self.vocab_sizes)
+
+    def init(self, rng: jax.Array) -> dict:
+        k_deep, k_wide, *k_mlp = jax.random.split(
+            rng, 2 + len(self.hidden_dims) + 1
+        )
+        f, v, d = self.num_categorical, self.padded_vocab, self.embed_dim
+        params = {
+            "wide_dense": jnp.zeros((self.num_dense, self.num_classes)),
+            "wide_bias": jnp.zeros((self.num_classes,)),
+            "wide_tables": 1e-3
+            * jax.random.normal(k_wide, (f, v, self.num_classes)),
+            "deep_tables": (1.0 / jnp.sqrt(d))
+            * jax.random.normal(k_deep, (f, v, d)),
+        }
+        widths = [self.num_dense + f * d, *self.hidden_dims, self.num_classes]
+        for i, (w_in, w_out) in enumerate(zip(widths[:-1], widths[1:])):
+            scale = jnp.sqrt(2.0 / w_in)
+            params[f"deep_{i}"] = {
+                "kernel": scale * jax.random.normal(k_mlp[i], (w_in, w_out)),
+                "bias": jnp.zeros((w_out,)),
+            }
+        return jax.tree.map(lambda a: a.astype(jnp.float32), params)
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        nd, f = self.num_dense, self.num_categorical
+        dense = x[:, :nd]
+        # Ids arrive as floats in the flat row; clamp into the table.
+        cat = jnp.remainder(
+            x[:, nd:].astype(jnp.int32), jnp.asarray(self.vocab_sizes, jnp.int32)
+        )  # [B, F]
+
+        feat_idx = jnp.arange(f)[None, :]  # [1, F] broadcasts over batch
+        wide_cat = params["wide_tables"][feat_idx, cat]  # [B, F, K]
+        deep_emb = params["deep_tables"][feat_idx, cat]  # [B, F, D]
+
+        wide_logits = (
+            dense @ params["wide_dense"]
+            + params["wide_bias"]
+            + jnp.sum(wide_cat, axis=1)
+        )
+
+        cdt = jnp.dtype(self.compute_dtype)
+        h = jnp.concatenate(
+            [dense, deep_emb.reshape(dense.shape[0], -1)], axis=1
+        ).astype(cdt)
+        n_hidden = len(self.hidden_dims)
+        for i in range(n_hidden):
+            layer = params[f"deep_{i}"]
+            h = jax.nn.relu(h @ layer["kernel"].astype(cdt) + layer["bias"].astype(cdt))
+        out = params[f"deep_{n_hidden}"]
+        deep_logits = h.astype(jnp.float32) @ out["kernel"] + out["bias"]
+
+        return wide_logits + deep_logits
+
+    def param_shardings(self, layout=None) -> dict:
+        """PartitionSpec pytree matching ``init``'s structure: tables
+        sharded over the model axis on the vocab dim, the rest
+        replicated."""
+        from mlapi_tpu.parallel import MODEL_AXIS
+
+        specs = {
+            "wide_dense": P(),
+            "wide_bias": P(),
+            "wide_tables": P(None, MODEL_AXIS, None),
+            "deep_tables": P(None, MODEL_AXIS, None),
+        }
+        for i in range(len(self.hidden_dims) + 1):
+            specs[f"deep_{i}"] = {"kernel": P(), "bias": P()}
+        return specs

@@ -12,6 +12,8 @@ from mlapi_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
     create_mesh,
+    params_for_model,
+    place_params,
     replicate_for_mesh,
     shard_batch_for_mesh,
 )

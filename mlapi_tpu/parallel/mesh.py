@@ -47,6 +47,34 @@ def replicate_for_mesh(pytree, mesh: Mesh):
     return jax.device_put(pytree, sharding)
 
 
+def place_params(params, mesh: Mesh, spec_tree=None):
+    """Place a param pytree on the mesh per a PartitionSpec pytree.
+
+    ``spec_tree`` mirrors ``params`` (models provide it via
+    ``param_shardings()``); missing/None spec ⇒ replicated. This is
+    the moment sharded training/serving actually happens: after
+    placement, ``jax.jit`` sees the shardings on its inputs and GSPMD
+    partitions the whole step — gathers, all-to-alls, gradient
+    reductions — with no further annotation.
+    """
+    if spec_tree is None:
+        return replicate_for_mesh(params, mesh)
+
+    def put(leaf, spec):
+        return jax.device_put(
+            leaf, NamedSharding(mesh, spec if spec is not None else P())
+        )
+
+    return jax.tree.map(put, params, spec_tree)
+
+
+def params_for_model(model, params, mesh: Mesh):
+    """Place ``params`` using the model's own layout when it has one
+    (``param_shardings``), else fully replicated."""
+    spec_fn = getattr(model, "param_shardings", None)
+    return place_params(params, mesh, spec_fn() if spec_fn else None)
+
+
 def shard_batch_for_mesh(pytree, mesh: Mesh, axis: str = DATA_AXIS):
     """Shard each leaf's leading (batch) dimension over ``axis``.
 

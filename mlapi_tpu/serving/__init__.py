@@ -15,5 +15,8 @@ re-loads a pickle and runs sklearn inline per request):
 from mlapi_tpu.serving.app import build_app, feature_schema  # noqa: F401
 from mlapi_tpu.serving.asgi import App, HTTPError, Request, Response  # noqa: F401
 from mlapi_tpu.serving.batcher import MicroBatcher  # noqa: F401
-from mlapi_tpu.serving.engine import InferenceEngine  # noqa: F401
+from mlapi_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+    TextClassificationEngine,
+)
 from mlapi_tpu.serving.server import Server  # noqa: F401

@@ -65,7 +65,10 @@ def build_app(
     batcher = MicroBatcher(engine, max_batch=max_batch, max_wait_ms=max_wait_ms)
     app.state["batcher"] = batcher
 
-    schema = feature_schema(engine.feature_names)
+    if engine.kind == "text":
+        schema = pydantic.create_model("TextRequest", text=(str, ...))
+    else:
+        schema = feature_schema(engine.feature_names)
     order = engine.feature_names
     expected_dim = engine.num_features
 
@@ -100,7 +103,9 @@ def build_app(
 
     @app.post("/predict")
     async def predict(features: schema):  # type: ignore[valid-type]
-        if order:
+        if engine.kind == "text":
+            row = engine.encode(features.text)
+        elif order:
             row = np.asarray([getattr(features, f) for f in order], np.float32)
         else:
             row = np.asarray(features.features, np.float32)

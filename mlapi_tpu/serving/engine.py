@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mlapi_tpu.parallel import replicate_for_mesh
 from mlapi_tpu.utils.logging import get_logger
 from mlapi_tpu.utils.vocab import LabelVocab
 
@@ -36,7 +35,14 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 
 
 class InferenceEngine:
-    """Batched classification inference over a jitted forward pass."""
+    """Batched classification inference over a jitted forward pass.
+
+    Rows are float32 feature vectors; see
+    :class:`TextClassificationEngine` for the token-id variant.
+    """
+
+    kind = "tabular"
+    input_dtype = np.float32
 
     def __init__(
         self,
@@ -59,7 +65,7 @@ class InferenceEngine:
         self.mesh = mesh
         self.meta = dict(meta or {})
         if mesh is not None:
-            from mlapi_tpu.parallel import DATA_AXIS
+            from mlapi_tpu.parallel import DATA_AXIS, params_for_model
 
             axis = mesh.shape[DATA_AXIS]
             bad = [b for b in self.buckets if b % axis]
@@ -67,7 +73,11 @@ class InferenceEngine:
                 raise ValueError(
                     f"buckets {bad} not divisible by data-axis size {axis}"
                 )
-            params = replicate_for_mesh(params, mesh)
+            # Serve in the model's declared layout (e.g. Wide&Deep's
+            # vocab-sharded tables) — the reason to serve on a mesh at
+            # all is that the params don't fit (or shouldn't be
+            # copied) per chip.
+            params = params_for_model(model, params, mesh)
         else:
             params = jax.device_put(params)
         self.params = params
@@ -97,10 +107,12 @@ class InferenceEngine:
         mesh: jax.sharding.Mesh | None = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
     ) -> "InferenceEngine":
-        """Build the engine from a committed checkpoint dir.
+        """Build an engine from a committed checkpoint dir.
 
         The model is reconstructed from the checkpoint's own config
-        (``model`` registry name + kwargs) unless one is passed in.
+        (``model`` registry name + kwargs) unless one is passed in,
+        and the engine class follows the model's ``input_kind``
+        (tabular feature rows vs text token ids).
         """
         from mlapi_tpu.checkpoint import load_checkpoint
         from mlapi_tpu.models import get_model
@@ -124,7 +136,30 @@ class InferenceEngine:
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
         feature_names = meta.config.get("feature_names", feature_names)
-        return cls(
+
+        if getattr(model, "input_kind", "tabular") == "text":
+            from mlapi_tpu.text import load_tokenizer
+            from mlapi_tpu.text.tokenizer import tokenizer_from_fingerprint
+
+            if "tokenizer" in meta.config:
+                # Rebuild exactly the training tokenizer or refuse —
+                # serving must never silently substitute a different
+                # tokenization scheme.
+                tokenizer = tokenizer_from_fingerprint(meta.config["tokenizer"])
+            else:
+                tokenizer = load_tokenizer(model.vocab_size)
+            default_len = min(128, getattr(model, "max_positions", 128))
+            return TextClassificationEngine(
+                model,
+                params,
+                meta.vocab,
+                tokenizer=tokenizer,
+                max_len=meta.config.get("max_len", default_len),
+                mesh=mesh,
+                buckets=buckets,
+                meta={"step": meta.step, "config_hash": meta.config_hash},
+            )
+        return InferenceEngine(
             model,
             params,
             meta.vocab,
@@ -147,7 +182,7 @@ class InferenceEngine:
         """Compile every bucket shape before serving traffic."""
         d = self.num_features
         for b in self.buckets:
-            x = np.zeros((b, d), np.float32)
+            x = np.zeros((b, d), self.input_dtype)
             jax.block_until_ready(self._predict_padded(x))
         _log.info("warmed %d bucket shapes up to batch=%d", len(self.buckets),
                   self.max_batch)
@@ -161,9 +196,9 @@ class InferenceEngine:
 
     # -- public API -------------------------------------------------------
     def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Classify ``[n, d]`` features → (label ids ``[n]``, max-probs
+        """Classify ``[n, d]`` rows → (label ids ``[n]``, max-probs
         ``[n]``); pads to bucket, chunks past the largest bucket."""
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x, self.input_dtype)
         if x.ndim != 2:
             raise ValueError(f"expected [n, d] features, got shape {x.shape}")
         n = len(x)
@@ -173,7 +208,7 @@ class InferenceEngine:
         while start < n:
             chunk = x[start : start + self.max_batch]
             b = self.bucket_for(len(chunk))
-            padded = np.zeros((b, x.shape[1]), np.float32)
+            padded = np.zeros((b, x.shape[1]), self.input_dtype)
             padded[: len(chunk)] = chunk
             fused = np.asarray(self._predict_padded(padded))  # one transfer
             ids_out[start : start + len(chunk)] = fused[: len(chunk), 0].astype(
@@ -186,6 +221,52 @@ class InferenceEngine:
     def predict_labels(self, x: np.ndarray) -> tuple[list[str], np.ndarray]:
         ids, probs = self.predict(x)
         return self.vocab.decode(ids), probs
+
+
+class TextClassificationEngine(InferenceEngine):
+    """Batched text classification: tokenizer + BERT-style model.
+
+    Rows are fixed-length int32 token-id vectors (``max_len``); the
+    attention mask is recomputed inside the model (``ids != pad``),
+    so the batcher/bucketing machinery is identical to the tabular
+    engine — only the row dtype and the request encoding differ.
+    """
+
+    kind = "text"
+    input_dtype = np.int32
+
+    def __init__(
+        self,
+        model,
+        params,
+        vocab: LabelVocab,
+        *,
+        tokenizer,
+        max_len: int = 128,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        mesh: jax.sharding.Mesh | None = None,
+        meta: dict | None = None,
+    ):
+        super().__init__(
+            model, params, vocab, feature_names=(), buckets=buckets,
+            mesh=mesh, meta=meta,
+        )
+        model_vocab = getattr(model, "vocab_size", None)
+        if model_vocab is not None and tokenizer.vocab_size > model_vocab:
+            # JAX gather clamps out-of-range ids silently — refuse the
+            # pairing instead of mispredicting.
+            raise ValueError(
+                f"tokenizer emits ids up to {tokenizer.vocab_size - 1} but "
+                f"the model's embedding table has {model_vocab} rows"
+            )
+        self.tokenizer = tokenizer
+        self.max_len = int(max_len)
+        self.num_features = self.max_len  # row width for warmup/stacking
+
+    def encode(self, text: str) -> np.ndarray:
+        """One request's text → a fixed-length id row."""
+        ids, _ = self.tokenizer.encode(text, self.max_len)
+        return ids
 
 
 def _load_meta_only(path):

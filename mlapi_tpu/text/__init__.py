@@ -1,0 +1,7 @@
+"""Text pipeline: tokenizers for the BERT serving/training path."""
+
+from mlapi_tpu.text.tokenizer import (  # noqa: F401
+    HashTokenizer,
+    WordPieceTokenizer,
+    load_tokenizer,
+)

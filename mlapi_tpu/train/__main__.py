@@ -40,6 +40,16 @@ def run(cfg: TrainConfig, out: str | None) -> dict:
             cfg.dataset,
         )
     model = get_model(cfg.model, **cfg.model_kwargs)
+    if getattr(model, "input_kind", "tabular") == "text":
+        # JAX gather clamps out-of-range ids silently; catch a
+        # tokenizer/model vocab mismatch before it trains to garbage.
+        max_id = int(splits.x_train.max())
+        if max_id >= model.vocab_size:
+            raise ValueError(
+                f"dataset token ids go up to {max_id} but the model's "
+                f"embedding table has only {model.vocab_size} rows — "
+                "tokenizer and model vocab_size disagree"
+            )
 
     mesh = None
     if cfg.mesh_shape is not None:
@@ -75,16 +85,23 @@ def run(cfg: TrainConfig, out: str | None) -> dict:
     )
 
     if out:
+        ckpt_config = {
+            "model": cfg.model,
+            "model_kwargs": cfg.model_kwargs,
+            "feature_names": list(splits.feature_names),
+            "train_config": cfg.to_json(),
+        }
+        if getattr(model, "input_kind", "tabular") == "text":
+            # The serving engine must encode requests exactly the way
+            # training did: same sequence length, same tokenizer.
+            ckpt_config["max_len"] = int(splits.x_train.shape[1])
+            if "tokenizer" in splits.extras:
+                ckpt_config["tokenizer"] = splits.extras["tokenizer"]
         save_checkpoint(
             out,
             result.params,
             step=result.steps,
-            config={
-                "model": cfg.model,
-                "model_kwargs": cfg.model_kwargs,
-                "feature_names": list(splits.feature_names),
-                "train_config": cfg.to_json(),
-            },
+            config=ckpt_config,
             vocab=splits.vocab,
         )
         _log.info("checkpoint written to %s", out)

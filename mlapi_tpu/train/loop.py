@@ -115,19 +115,26 @@ def fit(
     the mesh's ``data`` axis and params are replicated, which makes
     the jitted step data-parallel with an ICI all-reduce on gradients.
     """
-    from mlapi_tpu.parallel import shard_batch_for_mesh, replicate_for_mesh
+    from mlapi_tpu.parallel import params_for_model, shard_batch_for_mesh
 
     tx = _make_optimizer(optimizer, learning_rate)
     params = model.init(jax.random.key(seed))
-    opt_state = tx.init(params)
 
     if mesh is not None:
-        params = replicate_for_mesh(params, mesh)
-        opt_state = replicate_for_mesh(opt_state, mesh)
+        # Model-declared layout (e.g. Wide&Deep's sharded embedding
+        # tables) or fully replicated. Optimizer state initialised
+        # *under jit from placed params*, so its leaves inherit the
+        # same shardings (adam moments shard like their params).
+        params = params_for_model(model, params, mesh)
+        opt_state = jax.jit(tx.init)(params)
+    else:
+        opt_state = tx.init(params)
 
     step_fn = make_train_step(model.apply, tx, weight_decay=weight_decay)
 
-    x_all = np.asarray(splits.x_train, dtype=np.float32)
+    # Preserve the dataset's feature dtype: float32 for tabular rows,
+    # int32 token ids for text models.
+    x_all = np.asarray(splits.x_train)
     y_all = np.asarray(splits.y_train, dtype=np.int32)
     n = len(x_all)
 

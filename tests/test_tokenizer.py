@@ -1,0 +1,53 @@
+"""Tokenizers: WordPiece correctness against BERT's scheme, hash
+fallback determinism, fixed-length encoding contract."""
+
+import numpy as np
+
+from mlapi_tpu.text import HashTokenizer, WordPieceTokenizer
+
+TOY_VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]",
+    "the", "movie", "was", "great", "##ly", "un", "##believ", "##able",
+    ",", "!",
+]
+
+
+def test_wordpiece_greedy_longest_match():
+    tok = WordPieceTokenizer(TOY_VOCAB)
+    ids = tok.token_ids("unbelievable")
+    assert [TOY_VOCAB[i] for i in ids] == ["un", "##believ", "##able"]
+
+
+def test_wordpiece_punctuation_and_case():
+    tok = WordPieceTokenizer(TOY_VOCAB)
+    ids = tok.token_ids("The movie, was GREAT!")
+    assert [TOY_VOCAB[i] for i in ids] == [
+        "the", "movie", ",", "was", "great", "!",
+    ]
+
+
+def test_wordpiece_unknown_word():
+    tok = WordPieceTokenizer(TOY_VOCAB)
+    assert tok.token_ids("zzz") == [tok.unk_id]
+
+
+def test_encode_contract():
+    tok = WordPieceTokenizer(TOY_VOCAB)
+    ids, mask = tok.encode("the movie was great", max_len=8)
+    assert ids.shape == (8,) and mask.shape == (8,)
+    assert ids[0] == tok.cls_id
+    assert ids[5] == tok.sep_id  # 4 tokens + CLS
+    assert mask.tolist() == [1, 1, 1, 1, 1, 1, 0, 0]
+    # Truncation keeps CLS/SEP.
+    ids2, mask2 = tok.encode("the movie was great " * 10, max_len=6)
+    assert ids2[0] == tok.cls_id and ids2[5] == tok.sep_id
+    assert mask2.sum() == 6
+
+
+def test_hash_tokenizer_deterministic_and_in_range():
+    a, b = HashTokenizer(1000), HashTokenizer(1000)
+    ta, tb = a.token_ids("some words here"), b.token_ids("some words here")
+    assert ta == tb
+    assert all(4 <= t < 1000 for t in ta)
+    # Different words, different ids (overwhelmingly).
+    assert a.token_ids("alpha") != a.token_ids("omega")
