@@ -18,3 +18,4 @@ from mlapi_tpu.parallel.mesh import (  # noqa: F401
     shard_batch_for_mesh,
 )
 from mlapi_tpu.parallel.layout import SpecLayout  # noqa: F401
+from mlapi_tpu.parallel.distributed import initialize_from_env  # noqa: F401

@@ -55,6 +55,9 @@ def _demo_iris_checkpoint() -> str:
 
 
 def main(argv=None) -> None:
+    from mlapi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     parser = argparse.ArgumentParser("mlapi_tpu.serving")
     parser.add_argument("--checkpoint", help="committed checkpoint dir")
     parser.add_argument(
@@ -66,7 +69,18 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--max-wait-ms", type=float, default=0.2, help="micro-batch window"
     )
+    parser.add_argument(
+        "--profiler-port", type=int, default=0,
+        help="start a jax.profiler server on this port (XProf/TensorBoard "
+             "can attach live)",
+    )
     args = parser.parse_args(argv)
+
+    if args.profiler_port:
+        import jax.profiler
+
+        jax.profiler.start_server(args.profiler_port)
+        _log.info("jax profiler server on port %d", args.profiler_port)
 
     if not args.checkpoint and not args.demo_iris:
         parser.error("need --checkpoint or --demo-iris")

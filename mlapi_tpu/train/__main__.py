@@ -23,14 +23,23 @@ from mlapi_tpu.utils.logging import get_logger
 _log = get_logger("train.main")
 
 
-def run(cfg: TrainConfig, out: str | None) -> dict:
+def run(
+    cfg: TrainConfig,
+    out: str | None,
+    *,
+    save_every: int = 0,
+    resume: bool = True,
+    profile_dir: str | None = None,
+) -> dict:
     import jax
 
     from mlapi_tpu.checkpoint import save_checkpoint
     from mlapi_tpu.datasets import get_dataset
     from mlapi_tpu.models import get_model
-    from mlapi_tpu.parallel import create_mesh
+    from mlapi_tpu.parallel import create_mesh, initialize_from_env
     from mlapi_tpu.train import fit
+
+    initialize_from_env()  # multi-host no-op on a single host
 
     splits = get_dataset(cfg.dataset, **cfg.dataset_kwargs)
     if splits.source == "synthetic":
@@ -66,6 +75,12 @@ def run(cfg: TrainConfig, out: str | None) -> dict:
                 jax.device_count(),
             )
 
+    train_state_dir = cfg.checkpoint_dir or (f"{out}_train_state" if out else None)
+    if save_every and not train_state_dir:
+        raise ValueError(
+            "--save-every needs somewhere to write train state: pass --out "
+            "or set checkpoint_dir in the config"
+        )
     result = fit(
         model,
         splits,
@@ -77,6 +92,10 @@ def run(cfg: TrainConfig, out: str | None) -> dict:
         seed=cfg.seed,
         mesh=mesh,
         eval_every=cfg.eval_every,
+        checkpoint_dir=train_state_dir if save_every else None,
+        save_every=save_every,
+        resume=resume,
+        profile_dir=profile_dir,
     )
     _log.info(
         "%s: %d steps in %.2fs, final_loss=%.4f, test_accuracy=%s",
@@ -118,6 +137,9 @@ def run(cfg: TrainConfig, out: str | None) -> dict:
 
 
 def main(argv=None) -> None:
+    from mlapi_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     parser = argparse.ArgumentParser("mlapi_tpu.train")
     group = parser.add_mutually_exclusive_group(required=True)
     group.add_argument(
@@ -128,6 +150,18 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--steps", type=int, default=None, help="override config steps"
     )
+    parser.add_argument(
+        "--save-every", type=int, default=0,
+        help="checkpoint full train state every N steps (enables resume)",
+    )
+    parser.add_argument(
+        "--no-resume", action="store_true",
+        help="ignore existing train-state checkpoints",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace here (view with TensorBoard)",
+    )
     args = parser.parse_args(argv)
 
     cfg = get_preset(args.preset) if args.preset else TrainConfig.from_yaml(args.config)
@@ -136,7 +170,13 @@ def main(argv=None) -> None:
 
         cfg = dataclasses.replace(cfg, steps=args.steps)
 
-    summary = run(cfg, args.out)
+    summary = run(
+        cfg,
+        args.out,
+        save_every=args.save_every,
+        resume=not args.no_resume,
+        profile_dir=args.profile_dir,
+    )
     print(json.dumps(summary))
 
 

@@ -17,6 +17,7 @@ we fold that into ``weight_decay`` on the mean loss).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from dataclasses import dataclass, field
@@ -87,6 +88,42 @@ def evaluate(apply_fn: Callable, params, x, y) -> float:
     return float(jnp.mean(jnp.argmax(logits, axis=-1) == jnp.asarray(y)))
 
 
+def _save_train_state(root, params, opt_state, step: int) -> None:
+    """Checkpoint FULL train state (params + optimizer moments) so a
+    resumed run continues the same trajectory, not a fresh-optimizer
+    approximation of it."""
+    from mlapi_tpu.checkpoint import save_checkpoint
+    from mlapi_tpu.checkpoint.io import step_dir
+
+    save_checkpoint(
+        step_dir(root, step),
+        {"params": params, "opt_state": list(opt_state)},
+        step=step,
+        config={"kind": "train_state"},
+    )
+
+
+def _maybe_resume(root, params, opt_state, ):
+    """Restore the newest committed train-state checkpoint under
+    ``root``, if any. Returns (params, opt_state, start_step)."""
+    from mlapi_tpu.checkpoint import latest_step, load_checkpoint
+
+    from mlapi_tpu.utils.logging import get_logger
+
+    newest = latest_step(root)
+    if newest is None:
+        return params, opt_state, 0
+    get_logger("train.loop").info("resuming from %s", newest)
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=getattr(a, "sharding", None)
+        ),
+        {"params": params, "opt_state": list(opt_state)},
+    )
+    state, meta = load_checkpoint(newest, abstract)
+    return state["params"], tuple(state["opt_state"]), meta.step
+
+
 def _make_optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
     try:
         factory = getattr(optax, name)
@@ -107,13 +144,28 @@ def fit(
     seed: int = 0,
     mesh: jax.sharding.Mesh | None = None,
     eval_every: int = 0,
+    checkpoint_dir: str | None = None,
+    save_every: int = 0,
+    resume: bool = True,
+    profile_dir: str | None = None,
 ) -> TrainResult:
     """Train ``model`` on ``splits``.
 
     ``batch_size=None`` runs full-batch steps (right for tiny convex
     problems like Iris). With ``mesh`` set, the batch is sharded over
-    the mesh's ``data`` axis and params are replicated, which makes
-    the jitted step data-parallel with an ICI all-reduce on gradients.
+    the mesh's ``data`` axis and params follow the model's declared
+    layout, which makes the jitted step data-parallel (ICI all-reduce
+    on gradients) and — for sharded models — tensor-parallel too.
+
+    Fault tolerance (SURVEY §5 failure-detection row): with
+    ``checkpoint_dir`` + ``save_every``, full train state (params AND
+    optimizer moments) is checkpointed periodically; a rerun resumes
+    from the newest committed step and — because minibatch selection
+    is a pure function of (seed, step) — replays the exact schedule a
+    never-interrupted run would have seen.
+
+    ``profile_dir`` wraps the whole loop in a ``jax.profiler.trace``
+    (view with TensorBoard/XProf).
     """
     from mlapi_tpu.parallel import params_for_model, shard_batch_for_mesh
 
@@ -129,6 +181,18 @@ def fit(
         opt_state = jax.jit(tx.init)(params)
     else:
         opt_state = tx.init(params)
+
+    start_step = 0
+    if checkpoint_dir and resume:
+        params, opt_state, start_step = _maybe_resume(
+            checkpoint_dir, params, opt_state
+        )
+        if start_step >= steps:
+            raise ValueError(
+                f"resumed train state is already at step {start_step}, past "
+                f"the requested {steps} steps — raise --steps or pass "
+                "resume=False / --no-resume"
+            )
 
     step_fn = make_train_step(model.apply, tx, weight_decay=weight_decay)
 
@@ -146,18 +210,45 @@ def fit(
         idx = np.random.default_rng((seed, i)).choice(n, size=batch_size, replace=False)
         return x_all[idx], y_all[idx]
 
+    profiler_cm = (
+        jax.profiler.trace(profile_dir) if profile_dir
+        else contextlib.nullcontext()
+    )
     t0 = time.perf_counter()
     history: list[dict] = []
     loss = float("nan")
-    for i in range(steps):
-        x, y = batch_at(i)
-        if mesh is not None:
-            x, y = shard_batch_for_mesh((x, y), mesh)
-        params, opt_state, loss = step_fn(params, opt_state, x, y)
-        if eval_every and (i + 1) % eval_every == 0:
-            acc = evaluate(model.apply, params, splits.x_test, splits.y_test)
-            history.append({"step": i + 1, "loss": float(loss), "test_accuracy": acc})
+    with profiler_cm:
+        for i in range(start_step, steps):
+            x, y = batch_at(i)
+            if mesh is not None:
+                x, y = shard_batch_for_mesh((x, y), mesh)
+            params, opt_state, loss = step_fn(params, opt_state, x, y)
+            if eval_every and (i + 1) % eval_every == 0:
+                if not np.isfinite(float(loss)):
+                    raise FloatingPointError(
+                        f"non-finite loss {float(loss)} at step {i + 1}"
+                    )
+                acc = evaluate(model.apply, params, splits.x_test, splits.y_test)
+                history.append(
+                    {"step": i + 1, "loss": float(loss), "test_accuracy": acc}
+                )
+            if (
+                checkpoint_dir
+                and save_every
+                and (i + 1) % save_every == 0
+                and (i + 1) < steps
+            ):
+                if not np.isfinite(float(loss)):
+                    raise FloatingPointError(
+                        f"refusing to checkpoint non-finite loss "
+                        f"{float(loss)} at step {i + 1}"
+                    )
+                _save_train_state(checkpoint_dir, params, opt_state, i + 1)
     wall = time.perf_counter() - t0
+    if steps > start_step and not np.isfinite(float(loss)):
+        raise FloatingPointError(
+            f"training ended with non-finite loss {float(loss)}"
+        )
 
     test_acc = (
         evaluate(model.apply, params, splits.x_test, splits.y_test)

@@ -1,0 +1,24 @@
+"""Platform selection override.
+
+The deploy image's ``sitecustomize`` registers the TPU PJRT plugin and
+pins ``jax_platforms`` at the *config* level, which beats the
+``JAX_PLATFORMS`` env var. ``MLAPI_TPU_PLATFORM`` re-pins the config
+after import (backends initialise lazily, so doing this before the
+first computation wins) — the supported way to force a CLI onto CPU,
+e.g. for a bench fallback when the accelerator transport is wedged.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> str | None:
+    """Honor ``$MLAPI_TPU_PLATFORM`` (e.g. ``cpu``); returns the value
+    applied, if any. Call before any JAX computation."""
+    platform = os.environ.get("MLAPI_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return platform or None
