@@ -13,12 +13,17 @@ from __future__ import annotations
 import os
 
 
-def apply_platform_override() -> str | None:
-    """Honor ``$MLAPI_TPU_PLATFORM`` (e.g. ``cpu``); returns the value
-    applied, if any. Call before any JAX computation."""
-    platform = os.environ.get("MLAPI_TPU_PLATFORM")
+def apply_platform_override(env_var: str = "MLAPI_TPU_PLATFORM") -> str | None:
+    """Re-pin ``jax_platforms`` from ``env_var`` (e.g. to ``cpu``);
+    returns the value applied, if any. Call before any JAX computation.
+
+    Pass ``env_var="JAX_PLATFORMS"`` to restore the standard env var's
+    intent when sitecustomize has clobbered it at the config level.
+    """
+    platform = os.environ.get(env_var)
     if platform:
         import jax
 
-        jax.config.update("jax_platforms", platform)
+        if jax.config.jax_platforms != platform:
+            jax.config.update("jax_platforms", platform)
     return platform or None
