@@ -41,10 +41,17 @@ FLOWER = {
 }
 
 
-def wait_healthy(port: int, timeout_s: float = 120.0) -> dict:
+def wait_healthy(
+    port: int, timeout_s: float = 120.0, proc: subprocess.Popen | None = None
+) -> dict:
     deadline = time.time() + timeout_s
     last_err = None
     while time.time() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"server exited with code {proc.returncode} before "
+                f"becoming healthy (last probe error: {last_err})"
+            )
         try:
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=2
@@ -87,15 +94,15 @@ def main() -> None:
     server = _spawn_server(workdir)
     try:
         try:
-            health = wait_healthy(PORT, timeout_s=startup_timeout)
+            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
         except RuntimeError:
             server.kill()
             server.wait()
             server = _spawn_server(workdir, {"MLAPI_TPU_PLATFORM": "cpu"})
-            health = wait_healthy(PORT, timeout_s=startup_timeout)
+            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
 
-        n_chips = 1  # the serving process owns the chip; this host has one
         assert health["status"] == "ok", health
+        n_chips = int(health.get("device_count", 1))
 
         async def measure():
             # Warmup, then three measured passes; take the best
@@ -119,7 +126,6 @@ def main() -> None:
             return single, best
 
         single, best = asyncio.run(measure())
-        n_chips = int(health.get("device_count", n_chips))
         rps_per_chip = best.throughput / max(1, n_chips)
         print(
             json.dumps(

@@ -123,6 +123,18 @@ def save_checkpoint(
     ckptr.wait_until_finished()
     ckptr.close()
 
+    # Multi-host: every process reaches here after *its own* shards
+    # landed, but the manifest is the commit marker for the WHOLE
+    # checkpoint — so barrier first, then let only process 0 write it.
+    # Otherwise a fast process could commit before a slow one's shards
+    # exist, and concurrent writers would race on the tmp path.
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_commit:{path.name}")
+        if jax.process_index() != 0:
+            return path
+
     meta = CheckpointMeta(
         format_version=FORMAT_VERSION,
         framework_version=_framework_version,
@@ -140,6 +152,27 @@ def save_checkpoint(
     return path
 
 
+def read_manifest(path: str | os.PathLike) -> CheckpointMeta:
+    """Read a checkpoint's metadata WITHOUT touching the tensors.
+
+    Cheap (one small JSON file) — use it to validate a checkpoint
+    before paying for the orbax/tensorstore restore.
+    """
+    path = Path(path).absolute()
+    manifest = path / _MANIFEST
+    if not manifest.exists():
+        raise FileNotFoundError(
+            f"{path} is not a committed checkpoint (no {_MANIFEST})"
+        )
+    meta = CheckpointMeta.from_json(json.loads(manifest.read_text()))
+    if meta.format_version > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format v{meta.format_version} is newer than this "
+            f"framework understands (v{FORMAT_VERSION})"
+        )
+    return meta
+
+
 def load_checkpoint(
     path: str | os.PathLike,
     abstract_params=None,
@@ -155,17 +188,7 @@ def load_checkpoint(
     import orbax.checkpoint as ocp
 
     path = Path(path).absolute()
-    manifest = path / _MANIFEST
-    if not manifest.exists():
-        raise FileNotFoundError(
-            f"{path} is not a committed checkpoint (no {_MANIFEST})"
-        )
-    meta = CheckpointMeta.from_json(json.loads(manifest.read_text()))
-    if meta.format_version > FORMAT_VERSION:
-        raise ValueError(
-            f"checkpoint format v{meta.format_version} is newer than this "
-            f"framework understands (v{FORMAT_VERSION})"
-        )
+    meta = read_manifest(path)
 
     if abstract_params is not None:
         expect = tree_signature(abstract_params)

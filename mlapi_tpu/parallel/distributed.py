@@ -34,10 +34,15 @@ _log = get_logger("parallel.distributed")
 def initialize_from_env() -> bool:
     """Initialise JAX's distributed runtime from the environment.
 
-    Returns True if multi-host init happened. Safe to call on every
-    entry point: a plain single-host run (no env vars) is a no-op.
+    Returns True if the distributed runtime is (now) initialised.
+    Safe to call on every entry point: a plain single-host run (no env
+    vars) is a no-op, and a second call in an already-initialised
+    process (e.g. a sweep script looping over configs) is too.
     """
     import jax
+
+    if jax.distributed.is_initialized():
+        return True
 
     coordinator = os.environ.get("MLAPI_TPU_COORDINATOR")
     if coordinator:

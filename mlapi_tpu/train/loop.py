@@ -88,7 +88,7 @@ def evaluate(apply_fn: Callable, params, x, y) -> float:
     return float(jnp.mean(jnp.argmax(logits, axis=-1) == jnp.asarray(y)))
 
 
-def _save_train_state(root, params, opt_state, step: int) -> None:
+def _save_train_state(root, params, opt_state, step: int, run_config: dict) -> None:
     """Checkpoint FULL train state (params + optimizer moments) so a
     resumed run continues the same trajectory, not a fresh-optimizer
     approximation of it."""
@@ -99,21 +99,55 @@ def _save_train_state(root, params, opt_state, step: int) -> None:
         step_dir(root, step),
         {"params": params, "opt_state": list(opt_state)},
         step=step,
-        config={"kind": "train_state"},
+        config={"kind": "train_state", **run_config},
     )
 
 
-def _maybe_resume(root, params, opt_state, ):
+def _maybe_resume(root, params, opt_state, run_config: dict):
     """Restore the newest committed train-state checkpoint under
-    ``root``, if any. Returns (params, opt_state, start_step)."""
-    from mlapi_tpu.checkpoint import latest_step, load_checkpoint
+    ``root``, if any. Returns (params, opt_state, start_step).
 
+    The checkpoint's recorded hyperparameters must match this run's —
+    silently continuing an lr=1e-2 trajectory with lr=1e-3 (or a
+    different seed/optimizer with identical state shapes) produces a
+    run matching neither config.
+    """
+    from mlapi_tpu.checkpoint import latest_step, load_checkpoint
+    from mlapi_tpu.checkpoint.io import read_manifest
     from mlapi_tpu.utils.logging import get_logger
 
+    log = get_logger("train.loop")
     newest = latest_step(root)
     if newest is None:
         return params, opt_state, 0
-    get_logger("train.loop").info("resuming from %s", newest)
+
+    # Validate hyperparameters from the manifest alone, BEFORE paying
+    # for the tensor restore (gigabytes of tensorstore I/O for sharded
+    # models). Keys absent from the checkpoint (written by an older
+    # framework version) can't be checked — warn, don't reject, so
+    # legacy checkpoints stay resumable.
+    meta = read_manifest(newest)
+    diff = {
+        k: (meta.config[k], run_config[k])
+        for k in run_config
+        if k in meta.config and meta.config[k] != run_config[k]
+    }
+    if diff:
+        raise ValueError(
+            f"refusing to resume from {newest}: checkpoint was written "
+            f"with different hyperparameters (checkpoint vs requested: "
+            f"{diff}). Match the original config, or pass resume=False "
+            "/ --no-resume to start fresh."
+        )
+    unchecked = [k for k in run_config if k not in meta.config]
+    if unchecked:
+        log.warning(
+            "resuming from %s: checkpoint predates hyperparameter "
+            "recording; cannot verify %s match the original run",
+            newest, unchecked,
+        )
+
+    log.info("resuming from %s", newest)
     abstract = jax.tree.map(
         lambda a: jax.ShapeDtypeStruct(
             a.shape, a.dtype, sharding=getattr(a, "sharding", None)
@@ -182,10 +216,21 @@ def fit(
     else:
         opt_state = tx.init(params)
 
+    # The hyperparameters that define the optimisation trajectory; a
+    # resumed run must match them exactly (steps may grow — extending
+    # a finished run is legitimate).
+    run_config = {
+        "optimizer": optimizer,
+        "learning_rate": learning_rate,
+        "weight_decay": weight_decay,
+        "batch_size": batch_size,
+        "seed": seed,
+    }
+
     start_step = 0
     if checkpoint_dir and resume:
         params, opt_state, start_step = _maybe_resume(
-            checkpoint_dir, params, opt_state
+            checkpoint_dir, params, opt_state, run_config
         )
         if start_step >= steps:
             raise ValueError(
@@ -243,7 +288,9 @@ def fit(
                         f"refusing to checkpoint non-finite loss "
                         f"{float(loss)} at step {i + 1}"
                     )
-                _save_train_state(checkpoint_dir, params, opt_state, i + 1)
+                _save_train_state(
+                    checkpoint_dir, params, opt_state, i + 1, run_config
+                )
     wall = time.perf_counter() - t0
     if steps > start_step and not np.isfinite(float(loss)):
         raise FloatingPointError(

@@ -4,6 +4,7 @@ on the same trajectory as an uninterrupted one."""
 
 import jax
 import numpy as np
+import pytest
 
 from mlapi_tpu.datasets import get_dataset
 from mlapi_tpu.models import get_model
@@ -40,6 +41,23 @@ def test_resume_skips_when_no_checkpoint(tmp_path):
     result = fit(model, iris, steps=50, checkpoint_dir=str(tmp_path / "none"),
                  save_every=0)
     assert result.test_accuracy is not None
+
+
+def test_resume_rejects_changed_hyperparameters(tmp_path):
+    """A checkpoint trained with lr=1e-2 must not silently continue
+    under lr=1e-3 — the result would match neither configuration."""
+    mnist = get_dataset("mnist", synthetic_train=256, synthetic_test=64)
+    model = get_model("linear", num_features=784, num_classes=10)
+    ck = tmp_path / "train_state"
+
+    fit(model, mnist, steps=20, checkpoint_dir=str(ck), save_every=10,
+        batch_size=64, learning_rate=1e-2, seed=3)
+    with pytest.raises(ValueError, match="different hyperparameters"):
+        fit(model, mnist, steps=40, checkpoint_dir=str(ck), save_every=10,
+            batch_size=64, learning_rate=1e-3, seed=3)
+    # resume=False starts fresh instead of raising.
+    fit(model, mnist, steps=20, checkpoint_dir=str(ck), save_every=0,
+        batch_size=64, learning_rate=1e-3, seed=3, resume=False)
 
 
 def test_initialize_from_env_is_noop_single_host(monkeypatch):
