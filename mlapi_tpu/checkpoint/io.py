@@ -125,14 +125,16 @@ def save_checkpoint(
 
     # Multi-host: every process reaches here after *its own* shards
     # landed, but the manifest is the commit marker for the WHOLE
-    # checkpoint — so barrier first, then let only process 0 write it.
-    # Otherwise a fast process could commit before a slow one's shards
-    # exist, and concurrent writers would race on the tmp path.
-    if jax.process_count() > 1:
+    # checkpoint — so barrier, let only process 0 write it, then
+    # barrier again so no process returns (and e.g. reads the path
+    # back or reports success) until the manifest actually exists.
+    multi = jax.process_count() > 1
+    if multi:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"ckpt_commit:{path.name}")
+        multihost_utils.sync_global_devices(f"ckpt_pre:{path.name}")
         if jax.process_index() != 0:
+            multihost_utils.sync_global_devices(f"ckpt_post:{path.name}")
             return path
 
     meta = CheckpointMeta(
@@ -149,6 +151,8 @@ def save_checkpoint(
     tmp = path / f".{_MANIFEST}.tmp"
     tmp.write_text(json.dumps(meta.to_json(), indent=2, sort_keys=True))
     tmp.rename(path / _MANIFEST)
+    if multi:
+        multihost_utils.sync_global_devices(f"ckpt_post:{path.name}")
     return path
 
 

@@ -21,6 +21,12 @@ Dropout is omitted: serving is deterministic, and the ladder's
 fine-tuning runs are short enough that it isn't the difference that
 matters. (Add stochastic depth later if config 5 fine-tuning
 regresses.)
+
+Long-context: ``attention_impl="ring"`` swaps in sequence-parallel
+ring attention (``mlapi_tpu.ops.ring_attention``) with the sequence
+sharded over the mesh's ``seq`` axis — attention is the only
+cross-token op, so the rest of the encoder partitions along L under
+GSPMD with no code change.
 """
 
 from __future__ import annotations
@@ -67,8 +73,23 @@ class BertClassifier:
     max_positions: int = 512
     type_vocab_size: int = 2
     compute_dtype: str = "bfloat16"
+    # "full" = whole-sequence softmax attention on each device;
+    # "ring" = sequence-parallel ring attention (mlapi_tpu.ops) with L
+    # sharded over ``mesh``'s ``seq_axis`` — the long-context path.
+    attention_impl: str = "full"
+    mesh: object | None = None
+    seq_axis: str = "seq"
 
     def __post_init__(self):
+        if self.attention_impl not in ("full", "ring"):
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}"
+            )
+        if self.attention_impl == "ring" and self.mesh is None:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with a "
+                f"{self.seq_axis!r} axis"
+            )
         if self.bert_preset is not None:
             v, h, l, a, i, p = BERT_PRESETS[self.bert_preset]
             for name, val in [
@@ -139,12 +160,11 @@ class BertClassifier:
         )
         x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"])
 
-        # Additive mask: 0 where attended, large-negative where padded.
-        mask = (1.0 - attention_mask.astype(jnp.float32))[:, None, None, :]
-        mask = mask * jnp.finfo(jnp.float32).min
+        from mlapi_tpu.ops import full_attention, ring_self_attention
+
+        key_mask = attention_mask.astype(jnp.float32)
 
         nh, hd = self.num_heads, self.head_dim
-        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
         for n in range(self.num_layers):
             layer = params[f"layer_{n}"]
             xc = x.astype(cdt)
@@ -155,13 +175,14 @@ class BertClassifier:
                 ).reshape(b, l, nh, hd)
 
             q, k, v = proj(layer["q"]), proj(layer["k"]), proj(layer["v"])
-            # [B, heads, L, L] attention scores in f32 for stable softmax.
-            scores = (
-                jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
-                + mask
-            )
-            probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-            ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v).reshape(b, l, -1)
+            if self.attention_impl == "ring":
+                ctx = ring_self_attention(
+                    self.mesh, q, k, v, key_mask,
+                    seq_axis=self.seq_axis, head_axis="model",
+                )
+            else:
+                ctx = full_attention(q, k, v, key_mask)
+            ctx = ctx.reshape(b, l, -1)
             attn = ctx @ layer["attn_out"]["kernel"].astype(cdt) + layer[
                 "attn_out"
             ]["bias"].astype(cdt)

@@ -1,0 +1,20 @@
+"""TPU-native operator library.
+
+The hot ops behind the model zoo, written against the hardware rather
+than any reference implementation (the reference, ``main.py:21-22``,
+has exactly one "op": a 1x4 sklearn matmul — everything here is the
+capability scaled up TPU-first):
+
+- ``attention``       — stable full softmax attention (the baseline).
+- ``ring_attention``  — sequence-parallel blockwise attention with KV
+                        rotation over a mesh axis (long-context path).
+"""
+
+from mlapi_tpu.ops.attention import full_attention
+from mlapi_tpu.ops.ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "full_attention",
+    "ring_attention",
+    "ring_self_attention",
+]

@@ -1,0 +1,45 @@
+"""Stable softmax attention — the single-device baseline op.
+
+Layout convention (shared by every attention impl in ``ops``):
+``q, k, v`` are ``[B, L, H, D]`` (batch, sequence, heads, head_dim),
+``mask`` is a binary ``[B, L]`` key-validity mask (1 = attend). Scores
+and the softmax run in float32 regardless of input dtype — bfloat16
+accumulation visibly degrades softmax tails on TPU — and the output is
+cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Finite large-negative instead of -inf: keeps exp() NaN-free when an
+# entire key block is masked (exp(NEG - NEG) == 1 is then zeroed by the
+# explicit binary-mask multiply in the online-softmax update).
+NEG = jnp.float32(-1e30)
+
+
+def full_attention(q, k, v, mask=None, *, causal: bool = False, scale=None):
+    """Softmax attention over the full sequence.
+
+    ``q, k, v``: ``[B, L, H, D]``; ``mask``: optional binary ``[B, L]``
+    over keys; returns ``[B, L, H, D]`` in ``q.dtype``.
+    """
+    *_, d = q.shape
+    scale = (1.0 / d**0.5) if scale is None else scale
+    # Matmuls take native-dtype (bf16) inputs with f32 accumulation —
+    # the MXU recipe; only the softmax itself lives in f32.
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if mask is not None:
+        scores = scores + (1.0 - mask.astype(jnp.float32))[:, None, None, :] * NEG
+    if causal:
+        l = q.shape[1]
+        keep = jnp.tril(jnp.ones((l, l), jnp.bool_))
+        scores = jnp.where(keep[None, None, :, :], scores, NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)

@@ -1,0 +1,189 @@
+"""Ring attention — sequence-parallel softmax attention over a mesh axis.
+
+Long context is a first-class capability here even though the
+reference has no sequence models at all (SURVEY §2: max "sequence" is
+4 tabular features, ``main.py:10-14``): a sequence too long for one
+chip's HBM is split into per-device blocks along a ``seq`` mesh axis,
+and attention runs blockwise with the K/V blocks rotating around the
+ring via ``lax.ppermute`` — ICI-neighbor traffic only, overlapped by
+XLA with the per-block matmuls. Softmax is accumulated online
+(running max / denominator / numerator, the flash-attention
+recurrence), so no device ever materialises an ``[L, L]`` score
+matrix: per-device memory is O(L·L/n) score blocks and O(L/n·D)
+activations.
+
+Two entry points:
+
+- ``ring_attention``       — the per-device computation, for use
+                             inside an existing ``shard_map`` (axis
+                             name + size passed in).
+- ``ring_self_attention``  — convenience wrapper that shard_maps over
+                             a mesh for you, given globally-sharded
+                             ``[B, L, H, D]`` arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from mlapi_tpu.ops.attention import NEG
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale=None,
+):
+    """Blockwise ring attention for ONE device's sequence block.
+
+    Call inside ``shard_map`` over ``axis_name``. ``q, k, v`` are the
+    local blocks ``[B, Lb, H, D]`` (global L = Lb * axis_size, blocks
+    laid out in ring order), ``mask`` the local binary key mask
+    ``[B, Lb]``. Returns the local output block ``[B, Lb, H, D]`` in
+    ``q.dtype``.
+
+    ``axis_size`` must be the static size of ``axis_name`` (it sets
+    the ring-step count; ``lax.axis_index`` is traced so it cannot).
+    """
+    b, lb, h, d = q.shape
+    scale = (1.0 / d**0.5) if scale is None else scale
+    if mask is None:
+        mask = jnp.ones((b, lb), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def update(src, kb, vb, maskb, m, l, o):
+        """One online-softmax block update: fold the K/V block that
+        originated on device ``src`` into (m, l, o) — running max
+        [B,H,Lb], denominator [B,H,Lb], numerator [B,Lb,H,D]. Matmuls
+        take native-dtype (bf16) inputs with f32 accumulation — the
+        MXU recipe; only the softmax bookkeeping lives in f32."""
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kb,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        keep = maskb[:, None, None, :]  # [B,1,1,Lk] binary
+        if causal:
+            q_pos = my_idx * lb + jnp.arange(lb)
+            k_pos = src * lb + jnp.arange(lb)
+            keep = keep * (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+        scores = scores + (1.0 - keep) * NEG
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # exp(NEG - m_new) saturates to exp(0)=1 when a whole block is
+        # masked — the explicit * keep zeroes those lanes, keeping the
+        # recurrence NaN-free with finite masking (see ops.attention).
+        p = jnp.exp(scores - m_new[..., None]) * keep
+        corr = jnp.exp(m - m_new)  # [B,H,Lq]
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(q.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, o
+
+    # The accumulators must carry q's varying-manual-axes type (JAX
+    # tracks which mesh axes a value varies over inside shard_map;
+    # fresh zeros are "unvarying" and would mismatch the loop carry).
+    def varying(x):
+        return jax.lax.pcast(x, tuple(jax.typeof(q).vma), to="varying")
+
+    # Block 0 (our own K/V) outside the loop, then rotate-and-fold
+    # axis_size-1 times — permute first, so no rotation result is ever
+    # computed and discarded (XLA can't DCE a collective in the body).
+    m, l, o = update(
+        my_idx, k, v, mask,
+        varying(jnp.full((b, h, lb), NEG, jnp.float32)),
+        varying(jnp.zeros((b, h, lb), jnp.float32)),
+        varying(jnp.zeros((b, lb, h, d), jnp.float32)),
+    )
+
+    def body(t, carry):
+        m, l, o, kb, vb, maskb = carry
+        kb, vb, maskb = jax.lax.ppermute(
+            (kb, vb, maskb), axis_name, perm=perm
+        )
+        # After t rotations we hold the block originally on device
+        # (my_idx - t) mod n.
+        m, l, o = update((my_idx - t) % axis_size, kb, vb, maskb, m, l, o)
+        return m, l, o, kb, vb, maskb
+
+    _, l, o, *_ = jax.lax.fori_loop(1, axis_size, body, (m, l, o, k, v, mask))
+
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_self_attention(
+    mesh,
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    head_axis: str | None = None,
+    causal: bool = False,
+    scale=None,
+):
+    """Ring attention over globally-shaped ``[B, L, H, D]`` arrays.
+
+    Shards L over ``mesh``'s ``seq_axis`` (and B over ``batch_axis``
+    when the mesh has it), runs :func:`ring_attention` per device, and
+    returns the global ``[B, L, H, D]`` result. L must divide evenly
+    by the seq-axis size; pad upstream (padded keys masked out via
+    ``mask``).
+
+    ``head_axis`` additionally shards the head dim (tensor parallel —
+    attention is independent per head, so SP x TP composes with no
+    extra communication: K/V rotation stays within each head shard).
+    """
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{seq_axis!r} of size {n}; pad first"
+        )
+    bspec = batch_axis if batch_axis in mesh.axis_names else None
+    if bspec and q.shape[0] % mesh.shape[bspec]:
+        bspec = None  # e.g. a single-request serving batch on a DP mesh
+    hspec = head_axis if head_axis in mesh.axis_names else None
+    if hspec and q.shape[2] % mesh.shape[hspec]:
+        hspec = None
+    qkv_spec = P(bspec, seq_axis, hspec, None)
+    mask_spec = P(bspec, seq_axis)
+
+    inner = functools.partial(
+        ring_attention,
+        axis_name=seq_axis,
+        axis_size=n,
+        causal=causal,
+        scale=scale,
+    )
+    mapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], jnp.float32)
+    # shard_map reshards inputs to in_specs itself, eagerly or under
+    # jit — no explicit placement needed here.
+    return mapped(q, k, v, mask)
