@@ -73,15 +73,17 @@ class BertClassifier:
     max_positions: int = 512
     type_vocab_size: int = 2
     compute_dtype: str = "bfloat16"
-    # "full" = whole-sequence softmax attention on each device;
-    # "ring" = sequence-parallel ring attention (mlapi_tpu.ops) with L
-    # sharded over ``mesh``'s ``seq_axis`` — the long-context path.
+    # "full"  = whole-sequence softmax attention on each device;
+    # "flash" = fused Pallas kernel (mlapi_tpu.ops.pallas): scores/
+    #           softmax/PV stay in VMEM, no [L, L] HBM traffic;
+    # "ring"  = sequence-parallel ring attention (mlapi_tpu.ops) with
+    #           L sharded over ``mesh``'s ``seq_axis`` (long context).
     attention_impl: str = "full"
     mesh: object | None = None
     seq_axis: str = "seq"
 
     def __post_init__(self):
-        if self.attention_impl not in ("full", "ring"):
+        if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r}"
             )
@@ -179,6 +181,15 @@ class BertClassifier:
                 ctx = ring_self_attention(
                     self.mesh, q, k, v, key_mask,
                     seq_axis=self.seq_axis, head_axis="model",
+                )
+            elif self.attention_impl == "flash":
+                from mlapi_tpu.ops.pallas import flash_attention
+
+                # Interpreter off the TPU: correctness-testable
+                # anywhere, compiled Mosaic kernel on the real chip.
+                ctx = flash_attention(
+                    q, k, v, key_mask,
+                    interpret=jax.default_backend() != "tpu",
                 )
             else:
                 ctx = full_attention(q, k, v, key_mask)

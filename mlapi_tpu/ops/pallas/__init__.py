@@ -1,0 +1,15 @@
+"""Pallas TPU kernels — the hand-scheduled hot ops.
+
+XLA fusion covers most of this framework (SURVEY §2: the reference's
+only native code is transitive BLAS, so "native" here means kernels
+against the TPU's own memory hierarchy). These kernels exist where
+hand control of VMEM/MXU beats the XLA default:
+
+- ``flash_attention`` — fused attention: scores, softmax and the
+  probability-value contraction stay in VMEM per q-block; the [L, L]
+  score matrix never touches HBM.
+"""
+
+from mlapi_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

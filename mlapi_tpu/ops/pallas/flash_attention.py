@@ -1,0 +1,167 @@
+"""Fused attention kernel (flash-attention style) in Pallas.
+
+Why a kernel at all: stock XLA materialises the ``[B, H, L, L]``
+score tensor in HBM between the two attention matmuls once L is big
+enough that fusion gives up — at L=2048, BERT-base shapes, that is
+256 MB of HBM traffic per layer. Here the grid is
+``(B, H, L/block_q)`` and each program computes one q-block's output
+with scores, softmax and the probs·V contraction all resident in
+VMEM: HBM sees only Q/K/V/O.
+
+Per-program VMEM footprint is ``block_q·L`` f32 scores plus the K/V
+blocks — ~5 MB at L=4096, ``block_q=128``, ``D=64`` — inside the
+~16 MB budget. Longer sequences belong to the sequence-parallel path
+(``mlapi_tpu.ops.ring_attention``), which composes: each ring step's
+local block attention can itself be this kernel.
+
+Layout convention matches ``mlapi_tpu.ops.attention``: ``q, k, v``
+are ``[B, L, H, D]``, ``mask`` is binary ``[B, L]`` over keys; both
+matmuls run native-dtype inputs with f32 accumulation on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Python float (not a jax scalar — kernels may not capture traced
+# constants); same finite large-negative as mlapi_tpu.ops.attention.NEG.
+_NEG = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, causal, block_q):
+    # Block shapes: q [1,1,block_q,D]; k/v [1,1,L,D]; mask [1,1,L].
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    key_mask = mask_ref[0, 0]  # [L] binary
+
+    scores = (
+        jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [block_q, L]
+    keep = key_mask[None, :].astype(jnp.float32)
+    if causal:
+        i = pl.program_id(2)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        keep = keep * (q_pos >= k_pos)
+    scores = scores + (1.0 - keep) * _NEG
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # exp(NEG - NEG) == 1 when a row sees no valid key; * keep zeroes
+    # those lanes so fully-masked rows come out 0, not NaN.
+    p = jnp.exp(scores - m) * keep
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(q.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _forward(q, k, v, mask, causal, scale, block_q, interpret):
+    b, l, h, d = q.shape
+    # [B, 1, L]: TPU lowering wants the last two block dims tile-
+    # aligned or equal to the array dims; a (1, 1, L) block satisfies
+    # that where a (1, L) block over [B, L] cannot when B > 1.
+    mask3 = mask.astype(jnp.float32)[:, None, :]
+
+    # [B, L, H, D] -> [B, H, L, D]: heads become a grid dimension.
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    grid = (b, h, l // block_q)
+    qo_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+    )
+    kv_spec = pl.BlockSpec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, l), lambda bi, hi, qi: (bi, 0, 0))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal, block_q=block_q
+        ),
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec, mask_spec],
+        out_specs=qo_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, mask3)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, scale, block_q, interpret):
+    return _forward(q, k, v, mask, causal, scale, block_q, interpret)
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, interpret):
+    out = _forward(q, k, v, mask, causal, scale, block_q, interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd(causal, scale, block_q, interpret, res, g):
+    # Backward via the differentiable XLA reference (recompute-from-
+    # residuals, flash-attention style): training pays the [L, L]
+    # materialisation only in the grad pass; the serving-critical
+    # forward keeps the fused kernel. A Pallas backward kernel can
+    # replace this without touching callers.
+    from mlapi_tpu.ops.attention import full_attention
+
+    q, k, v, mask = res
+
+    def ref(q, k, v):
+        return full_attention(q, k, v, mask, causal=causal, scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "interpret")
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    causal: bool = False,
+    scale=None,
+    block_q: int = 128,
+    interpret: bool = False,
+):
+    """Fused softmax attention. ``q, k, v``: ``[B, L, H, D]``;
+    ``mask``: optional binary ``[B, L]`` over keys. Returns
+    ``[B, L, H, D]`` in ``q.dtype``.
+
+    Differentiable: the forward runs the Pallas kernel, the backward
+    runs the XLA reference via a custom VJP (see ``_flash_bwd``).
+    ``interpret=True`` runs the Pallas interpreter (CPU testing).
+    """
+    b, l, h, d = q.shape
+    scale = (1.0 / d**0.5) if scale is None else scale
+    block_q = min(block_q, l)
+    if l % block_q:
+        raise ValueError(
+            f"sequence length {l} not divisible by block_q {block_q}"
+        )
+    if mask is None:
+        mask = jnp.ones((b, l), jnp.float32)
+    return _flash(
+        q, k, v, mask.astype(jnp.float32), causal, scale, block_q, interpret
+    )
