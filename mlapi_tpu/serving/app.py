@@ -22,12 +22,13 @@ must survive as a real class for routing-time body-model detection.)
 
 import asyncio
 import io
+import json
 import time
 
 import numpy as np
 import pydantic
 
-from mlapi_tpu.serving.asgi import App, HTTPError, Request, json_response
+from mlapi_tpu.serving.asgi import App, HTTPError, Request, Response, json_response
 from mlapi_tpu.serving.batcher import MicroBatcher
 from mlapi_tpu.serving.engine import InferenceEngine
 from mlapi_tpu.utils.logging import get_logger
@@ -71,6 +72,11 @@ def build_app(
         schema = feature_schema(engine.feature_names)
     order = engine.feature_names
     expected_dim = engine.num_features
+    # Pre-escaped JSON bytes per class label (labels are fixed at
+    # checkpoint load; escaping them per request would be waste).
+    label_json = {
+        label: json.dumps(label).encode() for label in engine.vocab.labels
+    }
 
     @app.on_startup
     async def _start():
@@ -85,21 +91,47 @@ def build_app(
     async def _stop():
         await batcher.stop()
 
+    # Counter/histogram objects resolved once per (route, status) and
+    # cached — the hot path does two dict hits, not two f-string
+    # formats + registry lookups per request. Only registered routes
+    # become labels — unmatched paths all collapse to one bucket, so a
+    # URL scanner can't grow the registry (or this cache) without bound.
+    _counters: dict = {}
+    _histograms: dict = {}
+
+    def _record(key, status: int, ms: float) -> None:
+        ckey = (key, status)
+        counter = _counters.get(ckey)
+        if counter is None:
+            route = f"{key[0]} {key[1]}" if key else "unmatched"
+            counter = _counters[ckey] = registry.counter(
+                f"http.requests{{route={route},status={status}}}"
+            )
+            _histograms.setdefault(
+                key, registry.histogram(f"http.latency_ms{{route={route}}}")
+            )
+        counter.inc()
+        _histograms[key].observe(ms)
+
     @app.middleware
     async def _metrics_mw(request: Request, nxt):
         t0 = time.perf_counter()
-        response = await nxt(request)
-        ms = (time.perf_counter() - t0) * 1e3
-        # Only registered routes become labels — unmatched paths all
-        # collapse to one bucket, so a URL scanner can't grow the
-        # registry without bound.
-        if (request.method, request.path) in app.routes:
-            route = f"{request.method} {request.path}"
-        else:
-            route = "unmatched"
-        registry.counter(f"http.requests{{route={route},status={response.status}}}").inc()
-        registry.histogram(f"http.latency_ms{{route={route}}}").observe(ms)
-        return response
+        # Errors must be counted too: a handler raising HTTPError (or
+        # anything else -> 500) unwinds through this middleware before
+        # App.handle converts it to a response.
+        status = 500
+        try:
+            response = await nxt(request)
+            status = response.status
+            return response
+        except HTTPError as e:
+            status = e.status
+            raise
+        finally:
+            key = (request.method, request.path)
+            if key not in app._routes:  # plain dict hit, not a frozenset build
+                key = None
+            _record(key, status, (time.perf_counter() - t0) * 1e3)
 
     @app.post("/predict")
     async def predict(features: schema):  # type: ignore[valid-type]
@@ -125,7 +157,14 @@ def build_app(
                 ],
             )
         label, prob = await batcher.submit(row)
-        return {"prediction": label, "probability": prob}
+        # Hot path: hand-assembled JSON from the per-label pre-escaped
+        # bytes — skips json.dumps (with its default-fn machinery) on
+        # every request. %.10g is plenty for a softmax probability.
+        body = b'{"prediction":%b,"probability":%.10g}' % (
+            label_json.get(label) or json.dumps(label).encode(),
+            prob,
+        )
+        return Response(body, content_type="application/json")
 
     @app.post("/files/")
     async def create_file(request: Request):

@@ -53,15 +53,25 @@ class HTTPError(Exception):
 class Request:
     """One HTTP request: ASGI scope + fully-read body."""
 
+    __slots__ = ("scope", "body", "method", "path", "_headers")
+
     def __init__(self, scope: dict, body: bytes):
         self.scope = scope
         self.body = body
         self.method: str = scope["method"]
         self.path: str = scope["path"]
-        self.headers: dict[str, str] = {
-            k.decode("latin-1").lower(): v.decode("latin-1")
-            for k, v in scope.get("headers", [])
-        }
+        self._headers: dict[str, str] | None = None
+
+    @property
+    def headers(self) -> dict[str, str]:
+        # Decoded lazily: the /predict hot path never reads headers,
+        # so per-request decode would be pure overhead there.
+        if self._headers is None:
+            self._headers = {
+                k.decode("latin-1").lower(): v.decode("latin-1")
+                for k, v in self.scope.get("headers", [])
+            }
+        return self._headers
 
     def json(self) -> Any:
         try:
@@ -177,10 +187,22 @@ class App:
         kwargs: dict[str, Any] = {}
         if body_model is not None:
             try:
-                payload = body_model.model_validate(request.json())
+                # One pass in pydantic-core (parse + validate) instead
+                # of json.loads followed by model_validate.
+                payload = body_model.model_validate_json(request.body)
             except pydantic.ValidationError as e:
+                errors = e.errors(include_url=False)
+                # Malformed JSON stays a 400 (transport-level problem),
+                # matching Request.json(); schema violations are 422.
+                # Top-level only (empty loc): a nested Json[...] field
+                # failure is a schema violation, not a bad body.
+                if any(
+                    err.get("type") == "json_invalid" and not err.get("loc")
+                    for err in errors
+                ):
+                    return json_response({"detail": "invalid JSON body"}, 400)
                 # FastAPI-compatible 422 shape.
-                return json_response({"detail": e.errors(include_url=False)}, 422)
+                return json_response({"detail": errors}, 422)
             kwargs[_body_param_name(handler)] = payload
 
         if _wants_request(handler):
@@ -212,14 +234,21 @@ class App:
         if scope["type"] != "http":
             raise RuntimeError(f"unsupported ASGI scope type {scope['type']!r}")
 
-        body = bytearray()
-        while True:
-            message = await receive()
-            body.extend(message.get("body", b""))
-            if not message.get("more_body", False):
-                break
+        # Fast path: the framework's own server has already read the
+        # full body and passes it via an ASGI extension, skipping the
+        # receive-message dance. Standard servers (uvicorn) take the
+        # spec path below.
+        body = scope.get("extensions", {}).get("mlapi_tpu.body")
+        if body is None:
+            buf = bytearray()
+            while True:
+                message = await receive()
+                buf.extend(message.get("body", b""))
+                if not message.get("more_body", False):
+                    break
+            body = bytes(buf)
 
-        response = await self.handle(Request(scope, bytes(body)))
+        response = await self.handle(Request(scope, body))
         await send(
             {
                 "type": "http.response.start",

@@ -59,14 +59,12 @@ async def _worker(
             writer.write(request_bytes)
             await writer.drain()
             head = await reader.readuntil(b"\r\n\r\n")
-            status = int(head.split(b" ", 2)[1])
-            length = 0
-            for line in head.split(b"\r\n"):
-                if line.lower().startswith(b"content-length"):
-                    length = int(line.split(b":")[1])
-                    break
-            if length:
-                await reader.readexactly(length)
+            status = int(head[9:12])  # b"HTTP/1.1 200 ..."
+            # The framework server always emits lowercase header names.
+            i = head.find(b"content-length:")
+            if i >= 0:
+                j = head.index(b"\r\n", i)
+                await reader.readexactly(int(head[i + 15 : j]))
             result.latencies_ms.append((time.perf_counter() - t0) * 1e3)
             result.requests += 1
             if status != 200:

@@ -106,10 +106,10 @@ class Server:
             "path": request.path,
             "raw_path": request.raw_path.encode("latin-1"),
             "query_string": request.query.encode("latin-1"),
-            "headers": [
-                (k.encode("latin-1"), v.encode("latin-1"))
-                for k, v in request.headers
-            ],
+            "headers": request.headers,  # bytes pairs, passed through
+            # Body already fully read — the framework's own App picks
+            # it up here and skips the receive-message round trip.
+            "extensions": {"mlapi_tpu.body": request.body},
         }
 
         body_sent = False
@@ -134,21 +134,24 @@ class Server:
 
         body = b"".join(response_parts["chunks"])
         keep_alive = _wants_keep_alive(request)
-        headers = [
-            (k.decode("latin-1"), v.decode("latin-1"))
-            for k, v in response_parts["headers"]
-        ]
-        names = {k.lower() for k, _ in headers}
-        if "content-length" not in names:
-            headers.append(("content-length", str(len(body))))
-        headers.append(("connection", "keep-alive" if keep_alive else "close"))
-
         status = response_parts["status"]
         phrase = _STATUS_PHRASES.get(status, "Unknown")
-        head = [f"HTTP/1.1 {status} {phrase}"]
-        head.extend(f"{k}: {v}" for k, v in headers)
-        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
-        writer.write(body)
+        # Bytes all the way down — response headers arrive as bytes
+        # from ASGI and go to the socket as bytes; no str round trip.
+        head = bytearray(f"HTTP/1.1 {status} {phrase}\r\n".encode("latin-1"))
+        have_length = False
+        for k, v in response_parts["headers"]:
+            if not have_length and k.lower() == b"content-length":
+                have_length = True
+            head += k + b": " + v + b"\r\n"
+        if not have_length:
+            head += b"content-length: " + str(len(body)).encode() + b"\r\n"
+        head += (
+            b"connection: keep-alive\r\n\r\n"
+            if keep_alive
+            else b"connection: close\r\n\r\n"
+        )
+        writer.write(bytes(head) + body)
         await writer.drain()
         return keep_alive
 
@@ -178,33 +181,38 @@ async def _read_request(reader: asyncio.StreamReader) -> _ParsedRequest | None:
     if len(head) > MAX_HEADER_BYTES:
         raise HttpProtocolError(431, "headers too large")
 
-    lines = head.decode("latin-1").split("\r\n")
+    # Headers stay bytes end to end: parsed as bytes here, passed as
+    # bytes in the ASGI scope, decoded lazily only if a handler reads
+    # them (the /predict hot path never does).
+    lines = head.split(b"\r\n")
     try:
-        method, target, proto = lines[0].split(" ", 2)
-    except ValueError:
+        method_b, target_b, proto = lines[0].split(b" ", 2)
+        method = method_b.decode("latin-1")
+        target = target_b.decode("latin-1")
+    except (ValueError, UnicodeDecodeError):
         raise HttpProtocolError(400, f"malformed request line: {lines[0]!r}") from None
-    if not proto.startswith("HTTP/1."):
+    if not proto.startswith(b"HTTP/1."):
         raise HttpProtocolError(501, f"unsupported protocol {proto!r}")
-    version = proto.removeprefix("HTTP/")
+    version = proto[5:].decode("latin-1")
 
-    headers: list[tuple[str, str]] = []
+    headers: list[tuple[bytes, bytes]] = []
     for line in lines[1:]:
         if not line:
             continue
-        key, sep, value = line.partition(":")
+        key, sep, value = line.partition(b":")
         if not sep:
             raise HttpProtocolError(400, f"malformed header line: {line!r}")
         headers.append((key.strip().lower(), value.strip()))
 
     hmap = dict(headers)
     body = b""
-    if "transfer-encoding" in hmap:
-        if hmap["transfer-encoding"].lower() != "chunked":
+    if b"transfer-encoding" in hmap:
+        if hmap[b"transfer-encoding"].lower() != b"chunked":
             raise HttpProtocolError(501, "unsupported transfer-encoding")
         body = await _read_chunked(reader)
-    elif "content-length" in hmap:
+    elif b"content-length" in hmap:
         try:
-            n = int(hmap["content-length"])
+            n = int(hmap[b"content-length"])
         except ValueError:
             raise HttpProtocolError(400, "bad content-length") from None
         if n > MAX_BODY_BYTES:
@@ -249,10 +257,10 @@ async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
 
 
 def _wants_keep_alive(request: _ParsedRequest) -> bool:
-    conn = dict(request.headers).get("connection", "").lower()
+    conn = dict(request.headers).get(b"connection", b"").lower()
     if request.version == "1.0":
-        return conn == "keep-alive"
-    return conn != "close"
+        return conn == b"keep-alive"
+    return conn != b"close"
 
 
 async def _write_simple(writer, status: int, detail: str) -> None:

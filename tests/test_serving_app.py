@@ -146,6 +146,19 @@ async def test_healthz_and_metrics(client):
     assert route_keys and m["histograms"][route_keys[0]]["count"] >= 1
 
 
+async def test_error_responses_are_counted_in_metrics(client):
+    """4xx/5xx traffic must be visible in /metrics — handler-raised
+    HTTPErrors unwind through the metrics middleware."""
+    await client.post("/predict", json={"sepal_length": "nope"})  # 422
+    await client.post("/predict", content=b"{broken")  # 400
+    m = (await client.get("/metrics")).json()
+    statuses = {
+        k: v for k, v in m["counters"].items() if "/predict" in k
+    }
+    assert any("status=422" in k for k in statuses), statuses
+    assert any("status=400" in k for k in statuses), statuses
+
+
 async def test_concurrent_predictions_all_resolve(client):
     rs = await asyncio.gather(
         *(client.post("/predict", json=SETOSA) for _ in range(32))
