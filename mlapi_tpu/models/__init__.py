@@ -40,7 +40,9 @@ from mlapi_tpu.models import linear as _linear  # noqa: E402,F401
 from mlapi_tpu.models import mlp as _mlp  # noqa: E402,F401
 from mlapi_tpu.models import wide_deep as _wide_deep  # noqa: E402,F401
 from mlapi_tpu.models import bert as _bert  # noqa: E402,F401
+from mlapi_tpu.models import gpt as _gpt  # noqa: E402,F401
 from mlapi_tpu.models.bert import BertClassifier  # noqa: E402,F401
+from mlapi_tpu.models.gpt import GptLM  # noqa: E402,F401
 from mlapi_tpu.models.linear import LinearClassifier  # noqa: E402,F401
 from mlapi_tpu.models.mlp import MLPClassifier  # noqa: E402,F401
 from mlapi_tpu.models.wide_deep import WideDeepClassifier  # noqa: E402,F401

@@ -16,7 +16,11 @@ import jax.numpy as jnp
 # Finite large-negative instead of -inf: keeps exp() NaN-free when an
 # entire key block is masked (exp(NEG - NEG) == 1 is then zeroed by the
 # explicit binary-mask multiply in the online-softmax update).
-NEG = jnp.float32(-1e30)
+# A Python float, deliberately NOT a jax array: a module-level jax
+# array gets captured by traced functions as an implicit argument
+# ("captured constants"), which both bloats signatures and trips a
+# fastpath buffer-count bug in this JAX version on repeat calls.
+NEG = -1e30
 
 
 def full_attention(q, k, v, mask=None, *, causal: bool = False, scale=None):

@@ -63,9 +63,37 @@ def build_app(
     registry = registry or MetricsRegistry()
     app.state["engine"] = engine
     app.state["metrics"] = registry
-    batcher = MicroBatcher(engine, max_batch=max_batch, max_wait_ms=max_wait_ms)
-    app.state["batcher"] = batcher
 
+    if engine.kind == "generative":
+        batcher = None
+        _install_generate(app, engine)
+    else:
+        batcher = MicroBatcher(
+            engine, max_batch=max_batch, max_wait_ms=max_wait_ms
+        )
+        app.state["batcher"] = batcher
+        _install_predict(app, engine, batcher)
+
+    @app.on_startup
+    async def _start():
+        # Warm the compiled shapes off the request path, then start
+        # the collector. No request ever sees an XLA compile.
+        await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
+        if batcher is not None:
+            await batcher.start()
+        _log.info("serving %s (%s)", type(engine.model).__name__, engine.kind)
+
+    @app.on_shutdown
+    async def _stop():
+        if batcher is not None:
+            await batcher.stop()
+
+    _install_common(app, engine, registry, batcher)
+    return app
+
+
+def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
+    """The classification surface: ``POST /predict``."""
     if engine.kind == "text":
         schema = pydantic.create_model("TextRequest", text=(str, ...))
     else:
@@ -78,19 +106,101 @@ def build_app(
         label: json.dumps(label).encode() for label in engine.vocab.labels
     }
 
-    @app.on_startup
-    async def _start():
-        # Warm every bucket shape off the request path, then start
-        # the collector. No request ever sees an XLA compile.
-        await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
-        await batcher.start()
-        _log.info("serving %s features=%s classes=%s", engine.model,
-                  engine.feature_names, engine.vocab.labels)
+    @app.post("/predict")
+    async def predict(features: schema):  # type: ignore[valid-type]
+        if engine.kind == "text":
+            row = engine.encode(features.text)
+        elif order:
+            row = np.asarray([getattr(features, f) for f in order], np.float32)
+        else:
+            row = np.asarray(features.features, np.float32)
+        if row.shape != (expected_dim,):
+            # Same FastAPI-shaped detail list as pydantic 422s, so
+            # clients parse every validation failure one way.
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["features"],
+                        "msg": f"expected {expected_dim} features, "
+                               f"got {row.shape[0]}",
+                        "input": int(row.shape[0]),
+                    }
+                ],
+            )
+        label, prob = await batcher.submit(row)
+        # Hot path: hand-assembled JSON from the per-label pre-escaped
+        # bytes — skips json.dumps (with its default-fn machinery) on
+        # every request. %.10g is plenty for a softmax probability.
+        body = b'{"prediction":%b,"probability":%.10g}' % (
+            label_json.get(label) or json.dumps(label).encode(),
+            prob,
+        )
+        return Response(body, content_type="application/json")
 
-    @app.on_shutdown
-    async def _stop():
-        await batcher.stop()
 
+def _install_generate(app: App, engine) -> None:
+    """The generative surface: ``POST /generate``."""
+    schema = pydantic.create_model(
+        "GenerateRequest",
+        text=(str, ...),
+        max_new_tokens=(int | None, None),
+        temperature=(float, 0.0),
+        seed=(int, 0),
+    )
+    hard_cap = engine.model.max_positions - 1
+    # One generation at a time per signature keeps a burst of novel
+    # (bucket, tokens, temperature) shapes from stampeding XLA; the
+    # compiled path itself is fast.
+    gate = asyncio.Semaphore(4)
+
+    @app.post("/generate")
+    async def generate(req: schema):  # type: ignore[valid-type]
+        n_new = (
+            req.max_new_tokens
+            if req.max_new_tokens is not None
+            else engine.default_max_new_tokens
+        )
+        if not 0 < n_new <= hard_cap:
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["max_new_tokens"],
+                        "msg": f"must be in [1, {hard_cap}]",
+                        "input": n_new,
+                    }
+                ],
+            )
+        if not 0.0 <= req.temperature <= 10.0:
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["temperature"],
+                        "msg": "must be in [0, 10]",
+                        "input": req.temperature,
+                    }
+                ],
+            )
+        async with gate:
+            return await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: engine.generate_text(
+                    req.text,
+                    max_new_tokens=n_new,
+                    temperature=req.temperature,
+                    seed=req.seed,
+                ),
+            )
+
+
+def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> None:
+    """Routes/middleware every engine kind shares: CSV ingestion
+    (``/files/``, the reference's second endpoint), health, metrics."""
     # Counter/histogram objects resolved once per (route, status) and
     # cached — the hot path does two dict hits, not two f-string
     # formats + registry lookups per request. Only registered routes
@@ -132,39 +242,6 @@ def build_app(
             if key not in app._routes:  # plain dict hit, not a frozenset build
                 key = None
             _record(key, status, (time.perf_counter() - t0) * 1e3)
-
-    @app.post("/predict")
-    async def predict(features: schema):  # type: ignore[valid-type]
-        if engine.kind == "text":
-            row = engine.encode(features.text)
-        elif order:
-            row = np.asarray([getattr(features, f) for f in order], np.float32)
-        else:
-            row = np.asarray(features.features, np.float32)
-        if row.shape != (expected_dim,):
-            # Same FastAPI-shaped detail list as pydantic 422s, so
-            # clients parse every validation failure one way.
-            raise HTTPError(
-                422,
-                [
-                    {
-                        "type": "value_error",
-                        "loc": ["features"],
-                        "msg": f"expected {expected_dim} features, "
-                               f"got {row.shape[0]}",
-                        "input": int(row.shape[0]),
-                    }
-                ],
-            )
-        label, prob = await batcher.submit(row)
-        # Hot path: hand-assembled JSON from the per-label pre-escaped
-        # bytes — skips json.dumps (with its default-fn machinery) on
-        # every request. %.10g is plenty for a softmax probability.
-        body = b'{"prediction":%b,"probability":%.10g}' % (
-            label_json.get(label) or json.dumps(label).encode(),
-            prob,
-        )
-        return Response(body, content_type="application/json")
 
     @app.post("/files/")
     async def create_file(request: Request):
@@ -213,8 +290,9 @@ def build_app(
     @app.get("/metrics")
     async def metrics():
         snap = registry.snapshot()
-        snap["counters"]["batcher.device_calls"] = batcher.device_calls
-        snap["counters"]["batcher.requests"] = batcher.requests
+        if batcher is not None:
+            snap["counters"]["batcher.device_calls"] = batcher.device_calls
+            snap["counters"]["batcher.requests"] = batcher.requests
         return snap
 
     return app

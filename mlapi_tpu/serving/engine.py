@@ -133,6 +133,26 @@ class InferenceEngine:
             model.init(jax.random.key(0)),
         )
         params, meta = load_checkpoint(path, abstract)
+
+        if hasattr(model, "generate"):
+            # Generative LM: no label vocab — the output space is the
+            # tokenizer's.
+            from mlapi_tpu.text import load_tokenizer
+            from mlapi_tpu.text.tokenizer import tokenizer_from_fingerprint
+
+            tokenizer = (
+                tokenizer_from_fingerprint(meta.config["tokenizer"])
+                if "tokenizer" in meta.config
+                else load_tokenizer(model.vocab_size)
+            )
+            return TextGenerationEngine(
+                model,
+                params,
+                tokenizer=tokenizer,
+                mesh=mesh,
+                meta={"step": meta.step, "config_hash": meta.config_hash},
+            )
+
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
         feature_names = meta.config.get("feature_names", feature_names)
@@ -267,6 +287,128 @@ class TextClassificationEngine(InferenceEngine):
         """One request's text → a fixed-length id row."""
         ids, _ = self.tokenizer.encode(text, self.max_len)
         return ids
+
+
+class TextGenerationEngine:
+    """Serving engine for generative LMs (``gpt_lm``).
+
+    Unlike the classification engines there is no label vocab and no
+    micro-batcher: one request is one ``model.generate`` program
+    (prefill + ``lax.scan`` decode), compiled per
+    (prompt-bucket, max_new_tokens, temperature) signature and warmed
+    for the default shape at startup.
+    """
+
+    kind = "generative"
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        tokenizer,
+        mesh: jax.sharding.Mesh | None = None,
+        meta: dict | None = None,
+        default_max_new_tokens: int = 32,
+        prompt_buckets: Sequence[int] = (16, 64, 128),
+    ):
+        if tokenizer.vocab_size > model.vocab_size:
+            raise ValueError(
+                f"tokenizer emits ids up to {tokenizer.vocab_size - 1} but "
+                f"the model's embedding table has {model.vocab_size} rows"
+            )
+        self.model = model
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        self.meta = dict(meta or {})
+        self.default_max_new_tokens = default_max_new_tokens
+        self.prompt_buckets = tuple(
+            b for b in sorted(prompt_buckets) if b < model.max_positions
+        ) or (model.max_positions // 2,)
+        if mesh is not None:
+            from mlapi_tpu.parallel import params_for_model
+
+            params = params_for_model(model, params, mesh)
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+    # Shared surface with the classification engines (healthz, app).
+    @property
+    def vocab(self):
+        from mlapi_tpu.utils.vocab import LabelVocab
+
+        return LabelVocab(())  # no label space; output is text
+
+    def warmup(self) -> None:
+        """Compile the default-shape generate program off the request
+        path (each new (bucket, tokens, temperature) signature still
+        compiles on first use). Clamped to the model's context window
+        so a small-context LM still comes up."""
+        bucket = self.prompt_buckets[0]
+        n_new = min(
+            self.default_max_new_tokens, self.model.max_positions - bucket
+        )
+        if n_new < 1:
+            bucket = max(1, self.model.max_positions // 2)
+            n_new = self.model.max_positions - bucket
+        ids = np.zeros((1, bucket), np.int32)
+        jax.block_until_ready(
+            self.model.generate(
+                self.params, jnp.asarray(ids), max_new_tokens=n_new
+            )
+        )
+        _log.info(
+            "warmed generate: prompt_bucket=%d, max_new_tokens=%d",
+            bucket, n_new,
+        )
+
+    def _bucket(self, n: int) -> int:
+        i = bisect.bisect_left(self.prompt_buckets, n)
+        return self.prompt_buckets[min(i, len(self.prompt_buckets) - 1)]
+
+    def generate_text(
+        self,
+        text: str,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> dict:
+        """One prompt → generated continuation (text + ids)."""
+        n_new = int(max_new_tokens or self.default_max_new_tokens)
+        raw = self.tokenizer.token_ids(text)
+        limit = self.model.max_positions - n_new
+        if limit <= 0:
+            raise ValueError(
+                f"max_new_tokens={n_new} leaves no room for a prompt "
+                f"(max_positions={self.model.max_positions})"
+            )
+        raw = raw[-limit:] if raw else [self.tokenizer.pad_id]
+        # Left-pad to a bucket so common prompt lengths never
+        # recompile; the model treats every position causally, and
+        # pad-prefix tokens wash out of the final-position logits with
+        # trained models. A prompt longer than the largest bucket gets
+        # its exact length (one-off compile) rather than silent
+        # truncation.
+        bucket = min(max(self._bucket(len(raw)), len(raw)), limit)
+        prompt = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        used = min(len(raw), bucket)
+        prompt[0, -used:] = raw[-used:]
+
+        out = self.model.generate(
+            self.params,
+            jnp.asarray(prompt),
+            max_new_tokens=n_new,
+            temperature=float(temperature),
+            rng=jax.random.key(seed),
+        )
+        out_ids = [int(i) for i in np.asarray(out)[0]]
+        return {
+            "text": self.tokenizer.decode(out_ids),
+            "token_ids": out_ids,
+            "prompt_tokens": used,  # tokens that actually conditioned
+        }
 
 
 def _load_meta_only(path):

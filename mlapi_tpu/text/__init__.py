@@ -1,6 +1,8 @@
-"""Text pipeline: tokenizers for the BERT serving/training path."""
+"""Text pipeline: tokenizers for the BERT and GPT serving/training
+paths."""
 
 from mlapi_tpu.text.tokenizer import (  # noqa: F401
+    ByteTokenizer,
     HashTokenizer,
     WordPieceTokenizer,
     load_tokenizer,

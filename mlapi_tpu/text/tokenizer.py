@@ -80,6 +80,12 @@ class _Base:
         mask = [1] * n + [0] * (max_len - n)
         return np.asarray(ids, np.int32), np.asarray(mask, np.int32)
 
+    def decode(self, ids) -> str:
+        """Best-effort ids → text (generation output). Subclasses with
+        a real vocab detokenize; schemes without one (hashing) render
+        placeholders — generation then needs a vocab-bearing tokenizer."""
+        return " ".join(f"<{int(i)}>" for i in ids)
+
 
 class WordPieceTokenizer(_Base):
     def __init__(self, vocab: list[str], max_chars_per_word: int = 100):
@@ -151,6 +157,23 @@ class WordPieceTokenizer(_Base):
         return ids
 
 
+    def decode(self, ids) -> str:
+        """WordPiece detokenization: ``##`` continuation pieces join
+        their predecessor; specials are dropped."""
+        words: list[str] = []
+        specials = {self.pad_id, self.cls_id, self.sep_id}
+        for i in ids:
+            i = int(i)
+            if i in specials or not 0 <= i < len(self.vocab):
+                continue
+            tok = self.vocab[i]
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)
+
+
 class HashTokenizer(_Base):
     """word → crc32 hash → id in [4, vocab_size)."""
 
@@ -171,6 +194,32 @@ class HashTokenizer(_Base):
 
     def fingerprint(self) -> dict:
         return {"kind": "hash", "vocab_size": self.vocab_size}
+
+
+class ByteTokenizer(_Base):
+    """Byte-level ids (+4 reserved specials) — lossless round trip
+    with no vocab file; the natural pairing for the ``gpt_lm`` demo
+    (vocab_size 260)."""
+
+    pad_id, cls_id, sep_id, unk_id = 0, 1, 2, 3
+    _RESERVED = 4
+    vocab_size = 256 + _RESERVED
+
+    def token_ids(self, text: str) -> list[int]:
+        return [self._RESERVED + b for b in text.encode("utf-8")]
+
+    def decode(self, ids) -> str:
+        # Best-effort both ways: drop specials below the byte range
+        # AND ids past it (an untied LM head can emit ids up to the
+        # model's vocab_size, which may exceed 260).
+        return bytes(
+            int(i) - self._RESERVED
+            for i in ids
+            if self._RESERVED <= int(i) < self._RESERVED + 256
+        ).decode("utf-8", "replace")
+
+    def fingerprint(self) -> dict:
+        return {"kind": "bytes", "vocab_size": self.vocab_size}
 
 
 def _find_vocab_file(data_dir: str | None = None) -> Path | None:
@@ -203,6 +252,8 @@ def tokenizer_from_fingerprint(fp: dict, data_dir: str | None = None):
     kind = fp.get("kind")
     if kind == "hash":
         return HashTokenizer(fp["vocab_size"])
+    if kind == "bytes":
+        return ByteTokenizer()
     if kind == "wordpiece":
         p = _find_vocab_file(data_dir)
         if p is None:

@@ -1,0 +1,138 @@
+"""GPT decoder: causal correctness, KV-cache decode parity with the
+full forward (the silent killer in every decoder implementation), TP
+sharding, and learnability on a planted sequence task."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mlapi_tpu.models import get_model
+
+TINY = dict(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=64,
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model("gpt_lm", **TINY)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0))
+
+
+def test_forward_shapes(model, params):
+    ids = np.ones((3, 10), np.int32)
+    logits = jax.jit(model.apply)(params, ids)
+    assert logits.shape == (3, 10, TINY["vocab_size"])
+
+
+def test_causality(model, params):
+    """Changing a future token must not change past logits."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (2, 16)).astype(np.int32)
+    base = np.asarray(jax.jit(model.apply)(params, ids))
+    ids2 = ids.copy()
+    ids2[:, 10:] = (ids2[:, 10:] + 7) % 64
+    out = np.asarray(jax.jit(model.apply)(params, ids2))
+    np.testing.assert_allclose(out[:, :10], base[:, :10], atol=1e-5)
+    assert not np.allclose(out[:, 10:], base[:, 10:], atol=1e-5)
+
+
+def test_kv_cache_decode_matches_full_forward(model, params):
+    """Token-by-token decode through the cache must produce the same
+    next-token choices as re-running the full forward each step."""
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 64, (2, 8)).astype(np.int32)
+    n_new = 6
+
+    generated = np.asarray(
+        model.generate(params, jnp.asarray(prompt), max_new_tokens=n_new)
+    )
+
+    # Reference: greedy decode by full re-forward (no cache).
+    seq = prompt.copy()
+    ref = []
+    for _ in range(n_new):
+        logits = np.asarray(jax.jit(model.apply)(params, seq))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        ref.append(nxt)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(generated, np.stack(ref, axis=1))
+
+
+def test_sampled_generation_is_reproducible(model, params):
+    prompt = np.ones((1, 4), np.int32)
+    a = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=5, temperature=0.8,
+        rng=jax.random.key(7),
+    )
+    b = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=5, temperature=0.8,
+        rng=jax.random.key(7),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_rejects_overflow(model, params):
+    with pytest.raises(ValueError, match="max_positions"):
+        model.generate(
+            params, jnp.ones((1, 60), jnp.int32), max_new_tokens=10
+        )
+
+
+def test_learns_induction_copy_task(model):
+    """Train on sequences where token t+1 = token t (constant-run
+    sequences): a causal LM must drive loss near zero; a broken mask
+    or cache can't."""
+    rng = np.random.default_rng(2)
+    starts = rng.integers(0, 64, (512, 1)).astype(np.int32)
+    seqs = np.repeat(starts, 17, axis=1)  # [B, 17], constant runs
+    x, y = seqs[:, :-1], seqs[:, 1:]
+
+    params = model.init(jax.random.key(1))
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    loss = None
+    for _ in range(120):
+        params, opt, loss = step(params, opt, x, y)
+    assert float(loss) < 0.1, f"copy task not learned, loss={float(loss)}"
+
+    # And generation actually continues the pattern.
+    out = model.generate(
+        params, jnp.asarray([[5, 5, 5, 5]], jnp.int32), max_new_tokens=4
+    )
+    np.testing.assert_array_equal(np.asarray(out), [[5, 5, 5, 5]])
+
+
+def test_tp_sharded_forward(model, params, mesh_2x4):
+    from mlapi_tpu.parallel import params_for_model, shard_batch_for_mesh
+
+    placed = params_for_model(model, params, mesh_2x4)
+    assert tuple(placed["wte"].sharding.spec)[0] == "model"
+    ids = shard_batch_for_mesh(np.ones((8, 12), np.int32), mesh_2x4)
+    sharded = np.asarray(jax.jit(model.apply)(placed, ids))
+    ref = np.asarray(jax.jit(model.apply)(params, np.ones((8, 12), np.int32)))
+    np.testing.assert_allclose(sharded, ref, atol=1e-4)
