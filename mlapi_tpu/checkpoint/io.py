@@ -102,6 +102,17 @@ class CheckpointMeta:
         )
 
 
+# Seams for the commit-barrier logic (tests mock these to exercise the
+# multi-process paths without a real jax.distributed runtime; orbax
+# reads jax.process_count() itself, so patching jax globally breaks it).
+def _process_count() -> int:
+    return jax.process_count()
+
+
+def _process_index() -> int:
+    return jax.process_index()
+
+
 def save_checkpoint(
     path: str | os.PathLike,
     params,
@@ -128,13 +139,21 @@ def save_checkpoint(
     # checkpoint — so barrier, let only process 0 write it, then
     # barrier again so no process returns (and e.g. reads the path
     # back or reports success) until the manifest actually exists.
-    multi = jax.process_count() > 1
+    # Barrier keys carry the FULL path: two concurrent saves of
+    # same-named leaf dirs under different roots (e.g. step_100 in two
+    # experiment dirs) must not cross-match each other's barriers.
+    # Known limitation: if process 0 dies between the two barriers
+    # (manifest write failure, disk full), the other processes block in
+    # ckpt_post until the distributed runtime propagates the abort —
+    # the same contract as any collective, and strictly safer than
+    # returning success without a committed manifest.
+    multi = _process_count() > 1
     if multi:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"ckpt_pre:{path.name}")
-        if jax.process_index() != 0:
-            multihost_utils.sync_global_devices(f"ckpt_post:{path.name}")
+        multihost_utils.sync_global_devices(f"ckpt_pre:{path}")
+        if _process_index() != 0:
+            multihost_utils.sync_global_devices(f"ckpt_post:{path}")
             return path
 
     meta = CheckpointMeta(
@@ -152,7 +171,7 @@ def save_checkpoint(
     tmp.write_text(json.dumps(meta.to_json(), indent=2, sort_keys=True))
     tmp.rename(path / _MANIFEST)
     if multi:
-        multihost_utils.sync_global_devices(f"ckpt_post:{path.name}")
+        multihost_utils.sync_global_devices(f"ckpt_post:{path}")
     return path
 
 

@@ -167,22 +167,34 @@ class GptLM:
             for n in range(self.num_layers)
         }
 
-    def decode_step(self, params, cache, token_ids, pos):
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
         """One decode step: ``[B, 1]`` ids at position ``pos`` (traced
         scalar) → (``[B, V]`` logits, updated cache). The KV for the
         new token is written into the fixed-shape cache; attention
         reads the full cache with positions ``> pos`` masked out —
-        static shapes, so the scan body compiles once."""
+        static shapes, so the scan body compiles once.
+
+        ``n_pad`` (``[B]`` int32) is the per-row count of left-pad
+        positions in the cache: those keys are masked out and the
+        position embedding is shifted so row ``b``'s real tokens sit
+        at effective positions ``0..pos-n_pad[b]`` — a prompt's output
+        is identical whichever pad bucket it landed in.
+        """
         from mlapi_tpu.ops.attention import NEG
 
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
         nh, hd = self.num_heads, self.head_dim
         max_len = cache["layer_0"]["k"].shape[1]
+        if n_pad is None:
+            n_pad = jnp.zeros((b,), jnp.int32)
 
-        x = params["wte"][token_ids] + params["wpe"][pos][None, None]
+        idx = jnp.arange(max_len)
+        x = params["wte"][token_ids] + params["wpe"][pos - n_pad][:, None, :]
         new_cache = {}
-        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,L]
+        valid = ((idx[None, :] <= pos) & (idx[None, :] >= n_pad[:, None]))[
+            :, None, None, :
+        ]  # [B,1,1,L]
 
         for n in range(self.num_layers):
             layer = params[f"layer_{n}"]
@@ -223,18 +235,27 @@ class GptLM:
         prompt_ids,
         *,
         max_new_tokens: int,
-        temperature: float = 0.0,
+        temperature=0.0,
         rng: jax.Array | None = None,
+        pad_lens=None,
     ):
         """Greedy (``temperature=0``) or sampled generation.
 
         ``prompt_ids``: ``[B, P]`` int32. Returns ``[B, max_new_tokens]``.
         Prefill runs the full forward once; decode is a ``lax.scan``
         over single-token steps against the KV cache — one jitted
-        program end to end (the jit also keys the executable cache
-        correctly per (shape, max_new_tokens, temperature) signature).
+        program end to end, compiled per (shape, max_new_tokens).
+
+        ``temperature`` may be a float or a per-row ``[B]`` array; it
+        is a *traced* argument, so a client cycling temperatures never
+        forces recompilation. ``pad_lens`` (``[B]`` int) marks how many
+        left-pad tokens each row carries: pads are masked out of
+        attention and position embeddings are shifted, so bucketed
+        serving produces bucket-invariant outputs. Sampling uses one
+        PRNG stream per row (``fold_in(rng, row)``), making each row's
+        tokens independent of its batch position.
         """
-        p = prompt_ids.shape[1]
+        b, p = prompt_ids.shape
         if p + max_new_tokens > self.max_positions:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
@@ -245,8 +266,19 @@ class GptLM:
         # key array as a jit argument trips a fastpath buffer-count
         # bug in this JAX version once other executables exist on a
         # multi-device host (second identical call INVALID_ARGUMENT).
-        return _generate_fn(self, max_new_tokens, float(temperature))(
-            params, prompt_ids, jax.random.key_data(rng)
+        row_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+            jnp.arange(b)
+        )
+        temps = jnp.broadcast_to(
+            jnp.asarray(temperature, jnp.float32), (b,)
+        )
+        n_pad = (
+            jnp.zeros((b,), jnp.int32)
+            if pad_lens is None
+            else jnp.asarray(pad_lens, jnp.int32)
+        )
+        return _generate_fn(self, max_new_tokens)(
+            params, prompt_ids, jax.random.key_data(row_keys), temps, n_pad
         )
 
     # ------------------------------------------------------------------
@@ -275,44 +307,52 @@ class GptLM:
         return specs
 
 
-@functools.lru_cache(maxsize=256)
-def _generate_fn(model: GptLM, max_new_tokens: int, temperature: float):
-    """One jitted generation program per (model config, token count,
-    temperature); config enters via closure and the PRNG key as raw
-    data (see ``generate`` for the jit-boundary rationale)."""
+def _pick_token(temps, logits, key_data, step):
+    """Next token per row: greedy where ``temps[b] <= 0``, else sampled
+    from ``logits / temps[b]`` with the row's own PRNG stream
+    (``fold_in(row_key, step)``) — a row's tokens do not depend on
+    which batch slot it landed in."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    keys = jax.vmap(
+        lambda kd: jax.random.fold_in(jax.random.wrap_key_data(kd), step)
+    )(key_data)
+    sampled = jax.vmap(
+        lambda k, lg: jax.random.categorical(k, lg)
+    )(keys, logits / safe_t[:, None]).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
 
-    def _run(params, prompt_ids, key_data):
-        rng = jax.random.wrap_key_data(key_data)
-        return _generate(model, params, prompt_ids, max_new_tokens,
-                         temperature, rng)
 
-    return jax.jit(_run)
+def _prefill_core(model: GptLM, params, prompt_ids, n_pad, total_len: int):
+    """Full causal forward over a left-padded ``[B, P]`` prompt,
+    writing K/V into a fresh ``[B, total_len, H, D]`` cache.
 
+    Per-row ``n_pad`` pad positions are masked out of attention and
+    position embeddings are shifted so real tokens occupy effective
+    positions ``0..P-1-n_pad[b]``. Returns ``(cache, last_logits)``
+    — every row's last real token sits at index ``P-1`` (right-
+    aligned), so the next-token logits are one static slice.
 
-def _generate(
-    model: GptLM, params, prompt_ids, max_new_tokens: int,
-    temperature: float, rng,
-):
+    One batched forward + cache build is a single fused program;
+    prefilling via P decode-shaped steps would cost P dispatches.
+    """
     self = model
     b, p = prompt_ids.shape
-    total = p + max_new_tokens
-    # Prefill: full causal forward over the prompt while writing
-    # the cache via decode-shaped updates would cost P steps; one
-    # batched forward + cache build is a single fused program.
-    cache = self.init_cache(b, total)
+    cache = self.init_cache(b, total_len)
     cdt = jnp.dtype(self.compute_dtype)
-    nh, hd = self.num_heads, self.head_dim
 
     from mlapi_tpu.ops import full_attention
 
-    x = params["wte"][prompt_ids] + params["wpe"][jnp.arange(p)][None]
+    pos_idx = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
+    x = params["wte"][prompt_ids] + params["wpe"][pos_idx]
+    mask = (jnp.arange(p)[None, :] >= n_pad[:, None]).astype(jnp.float32)
     for n in range(self.num_layers):
         layer = params[f"layer_{n}"]
         kv_seen = {}
 
-        def attend(q, k, v, *, _n=n, _kv=kv_seen):
+        def attend(q, k, v, *, _kv=kv_seen):
             _kv["k"], _kv["v"] = k, v
-            return full_attention(q, k, v, causal=True)
+            return full_attention(q, k, v, mask=mask, causal=True)
 
         x = self._block(layer, x, attend)
         cache[f"layer_{n}"] = {
@@ -326,29 +366,92 @@ def _generate(
             ),
         }
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
-    first_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
+    last_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
         jnp.float32
     )
+    return cache, last_logits
 
-    def pick(logits, step_rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            step_rng, logits / temperature, axis=-1
-        ).astype(jnp.int32)
 
-    def step(carry, step_rng):
+def _decode_scan(
+    model: GptLM, params, cache, tok, pos, n_pad, temps, key_data,
+    n_steps: int, step0,
+):
+    """``n_steps`` cached decode steps under one ``lax.scan``.
+
+    ``tok`` ``[B]`` is the last emitted token (fed back in), ``pos``
+    the traced cache position it occupies + 1 is written next;
+    ``step0`` the traced sampling-stream offset (so chunked decoding
+    reproduces the single-scan token stream exactly). Returns
+    ``(tokens [B, n_steps], cache, last_tok)``.
+    """
+
+    def step(carry, i):
         cache, tok, pos = carry
-        logits, cache = self.decode_step(params, cache, tok[:, None], pos)
-        nxt = pick(logits, step_rng)
+        logits, cache = model.decode_step(
+            params, cache, tok[:, None], pos, n_pad
+        )
+        nxt = _pick_token(temps, logits, key_data, i)
         return (cache, nxt, pos + 1), nxt
 
-    first = pick(first_logits, jax.random.fold_in(rng, 0))
-    if max_new_tokens == 1:
-        return first[:, None]
-    (_, _, _), rest = jax.lax.scan(
-        step,
-        (cache, first, jnp.int32(p)),
-        jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1),
+    (cache, tok, _), toks = jax.lax.scan(
+        step, (cache, tok, pos), jnp.arange(n_steps) + step0
     )
-    return jnp.concatenate([first[:, None], rest.T], axis=1)
+    return toks.T, cache, tok
+
+
+@functools.lru_cache(maxsize=256)
+def _generate_fn(model: GptLM, max_new_tokens: int):
+    """One jitted end-to-end generation program per (model config,
+    token count); temperature, pad widths, and PRNG keys are traced
+    arguments (the key as raw uint32 data — see ``generate``)."""
+
+    def _run(params, prompt_ids, key_data, temps, n_pad):
+        p = prompt_ids.shape[1]
+        cache, first_logits = _prefill_core(
+            model, params, prompt_ids, n_pad, p + max_new_tokens
+        )
+        first = _pick_token(temps, first_logits, key_data, 0)
+        if max_new_tokens == 1:
+            return first[:, None]
+        rest, _, _ = _decode_scan(
+            model, params, cache, first, jnp.int32(p), n_pad, temps,
+            key_data, max_new_tokens - 1, jnp.int32(1),
+        )
+        return jnp.concatenate([first[:, None], rest], axis=1)
+
+    return jax.jit(_run)
+
+
+@functools.lru_cache(maxsize=64)
+def prefill_fn(model: GptLM, total_len: int):
+    """Jitted prefill + first-token program for incremental decoding:
+    ``(params, prompt_ids [B,P], key_data, temps, n_pad)`` →
+    ``(first_tok [B], cache)``. Compiled per (model, B, P, total_len);
+    any ``max_new_tokens`` then reuses it via ``decode_chunk_fn`` —
+    the serving engine's compile count stays bounded by shape buckets,
+    not by request parameters."""
+
+    def _run(params, prompt_ids, key_data, temps, n_pad):
+        cache, logits = _prefill_core(
+            model, params, prompt_ids, n_pad, total_len
+        )
+        return _pick_token(temps, logits, key_data, 0), cache
+
+    return jax.jit(_run)
+
+
+@functools.lru_cache(maxsize=64)
+def decode_chunk_fn(model: GptLM, chunk: int):
+    """Jitted ``chunk``-step decode program:
+    ``(params, cache, tok, pos, n_pad, temps, key_data, step0)`` →
+    ``(tokens [B, chunk], cache, last_tok)``. The cache is donated —
+    each chunk updates it in place (no per-chunk HBM copy); callers
+    must use the returned cache handle."""
+
+    def _run(params, cache, tok, pos, n_pad, temps, key_data, step0):
+        return _decode_scan(
+            model, params, cache, tok, pos, n_pad, temps, key_data,
+            chunk, step0,
+        )
+
+    return jax.jit(_run, donate_argnums=(1,))

@@ -43,7 +43,15 @@ def full_attention(q, k, v, mask=None, *, causal: bool = False, scale=None):
         l = q.shape[1]
         keep = jnp.tril(jnp.ones((l, l), jnp.bool_))
         scores = jnp.where(keep[None, None, :, :], scores, NEG)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if mask is not None:
+        # Softmax is shift-invariant, so a query row whose keys are
+        # ALL masked would otherwise attend uniformly (additive NEG
+        # cancels out). Zero those contributions explicitly so the
+        # full/flash/ring implementations agree: fully-masked rows
+        # return zeros everywhere.
+        probs = probs * mask.astype(jnp.float32)[:, None, None, :]
+    probs = probs.astype(q.dtype)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
     ).astype(q.dtype)

@@ -28,7 +28,14 @@ import time
 import numpy as np
 import pydantic
 
-from mlapi_tpu.serving.asgi import App, HTTPError, Request, Response, json_response
+from mlapi_tpu.serving.asgi import (
+    App,
+    HTTPError,
+    Request,
+    Response,
+    StreamingResponse,
+    json_response,
+)
 from mlapi_tpu.serving.batcher import MicroBatcher
 from mlapi_tpu.serving.engine import InferenceEngine
 from mlapi_tpu.utils.logging import get_logger
@@ -81,12 +88,16 @@ def build_app(
         await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
         if batcher is not None:
             await batcher.start()
+        elif hasattr(engine, "start"):
+            await engine.start()  # generative: its own decode batcher
         _log.info("serving %s (%s)", type(engine.model).__name__, engine.kind)
 
     @app.on_shutdown
     async def _stop():
         if batcher is not None:
             await batcher.stop()
+        elif hasattr(engine, "stop"):
+            await engine.stop()
 
     _install_common(app, engine, registry, batcher)
     return app
@@ -141,19 +152,21 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
 
 
 def _install_generate(app: App, engine) -> None:
-    """The generative surface: ``POST /generate``."""
+    """The generative surface: ``POST /generate``.
+
+    Concurrent requests coalesce into one batched decode stream
+    (``TextGenerationEngine``); ``"stream": true`` returns NDJSON —
+    one ``{"token_ids": [...]}`` line per decoded chunk as it lands,
+    then a ``{"done": true, "text": ..., ...}`` line."""
     schema = pydantic.create_model(
         "GenerateRequest",
         text=(str, ...),
         max_new_tokens=(int | None, None),
         temperature=(float, 0.0),
         seed=(int, 0),
+        stream=(bool, False),
     )
     hard_cap = engine.model.max_positions - 1
-    # One generation at a time per signature keeps a burst of novel
-    # (bucket, tokens, temperature) shapes from stampeding XLA; the
-    # compiled path itself is fast.
-    gate = asyncio.Semaphore(4)
 
     @app.post("/generate")
     async def generate(req: schema):  # type: ignore[valid-type]
@@ -186,16 +199,53 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
-        async with gate:
-            return await asyncio.get_running_loop().run_in_executor(
-                None,
-                lambda: engine.generate_text(
-                    req.text,
-                    max_new_tokens=n_new,
-                    temperature=req.temperature,
-                    seed=req.seed,
-                ),
+        gen = await engine.submit(
+            req.text,
+            max_new_tokens=n_new,
+            temperature=req.temperature,
+            seed=req.seed,
+        )
+
+        if req.stream:
+            async def ndjson():
+                ids: list[int] = []
+                while True:
+                    item = await gen.queue.get()
+                    if isinstance(item, Exception):
+                        yield json.dumps(
+                            {"error": str(item)}
+                        ).encode() + b"\n"
+                        return
+                    if item is None:
+                        yield json.dumps(
+                            {
+                                "done": True,
+                                "text": engine.tokenizer.decode(ids),
+                                "token_ids": ids,
+                                "prompt_tokens": gen.used,
+                            }
+                        ).encode() + b"\n"
+                        return
+                    ids.extend(item["token_ids"])
+                    yield json.dumps(item).encode() + b"\n"
+
+            return StreamingResponse(
+                ndjson(), content_type="application/x-ndjson"
             )
+
+        ids: list[int] = []
+        while True:
+            item = await gen.queue.get()
+            if isinstance(item, Exception):
+                raise item
+            if item is None:
+                break
+            ids.extend(item["token_ids"])
+        return {
+            "text": engine.tokenizer.decode(ids),
+            "token_ids": ids,
+            "prompt_tokens": gen.used,
+        }
 
 
 def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> None:
@@ -230,16 +280,36 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
         # anything else -> 500) unwinds through this middleware before
         # App.handle converts it to a response.
         status = 500
+        recorded = False
         try:
             response = await nxt(request)
             status = response.status
+            if isinstance(response, StreamingResponse):
+                # The handler returns before a single token decodes;
+                # measuring here would log ~0 ms for every stream.
+                # Record when the body iterator finishes instead.
+                response.body_iter = _record_when_done(
+                    response.body_iter, request, status, t0
+                )
+                recorded = True
             return response
         except HTTPError as e:
             status = e.status
             raise
         finally:
+            if not recorded:
+                key = (request.method, request.path)
+                if key not in app._routes:  # plain dict hit, no frozenset
+                    key = None
+                _record(key, status, (time.perf_counter() - t0) * 1e3)
+
+    async def _record_when_done(it, request: Request, status: int, t0: float):
+        try:
+            async for chunk in it:
+                yield chunk
+        finally:
             key = (request.method, request.path)
-            if key not in app._routes:  # plain dict hit, not a frozenset build
+            if key not in app._routes:
                 key = None
             _record(key, status, (time.perf_counter() - t0) * 1e3)
 
@@ -293,6 +363,10 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
         if batcher is not None:
             snap["counters"]["batcher.device_calls"] = batcher.device_calls
             snap["counters"]["batcher.requests"] = batcher.requests
+        elif engine.kind == "generative":
+            snap["counters"]["generate.requests"] = engine.requests
+            snap["counters"]["generate.batch_calls"] = engine.batch_calls
+            snap["counters"]["generate.chunk_calls"] = engine.chunk_calls
         return snap
 
     return app

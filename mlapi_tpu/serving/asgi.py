@@ -111,6 +111,25 @@ class Response:
         self.headers = {"content-type": content_type, **(headers or {})}
 
 
+class StreamingResponse(Response):
+    """Response whose body is an async iterator of byte chunks,
+    written to the wire as they are produced — under the framework
+    server via chunked transfer encoding, under any ASGI server via
+    ``more_body`` messages. Used by ``/generate`` streaming: the
+    client sees tokens as the decode loop emits them instead of
+    waiting for the whole generation."""
+
+    def __init__(
+        self,
+        body_iter,
+        status: int = 200,
+        content_type: str = "application/octet-stream",
+        headers: dict[str, str] | None = None,
+    ):
+        super().__init__(b"", status, content_type, headers)
+        self.body_iter = body_iter
+
+
 def json_response(obj: Any, status: int = 200) -> Response:
     return Response(
         json.dumps(obj, separators=(",", ":"), default=_json_default).encode(),
@@ -258,6 +277,26 @@ class App:
                 ],
             }
         )
+        if isinstance(response, StreamingResponse):
+            # The status line is already on the wire; a mid-stream
+            # failure can only be logged and the stream ended early.
+            try:
+                async for chunk in response.body_iter:
+                    if chunk:
+                        await send(
+                            {
+                                "type": "http.response.body",
+                                "body": chunk,
+                                "more_body": True,
+                            }
+                        )
+            except Exception:
+                _log.error(
+                    "stream aborted on %s %s\n%s", scope.get("method"),
+                    scope.get("path"), traceback.format_exc(),
+                )
+            await send({"type": "http.response.body", "body": b""})
+            return
         await send({"type": "http.response.body", "body": response.body})
 
     async def _lifespan(self, receive, send):

@@ -19,6 +19,7 @@ the matmul twice (``predict`` then ``predict_proba``,
 
 from __future__ import annotations
 
+import asyncio
 import bisect
 from typing import Sequence
 
@@ -289,14 +290,69 @@ class TextClassificationEngine(InferenceEngine):
         return ids
 
 
+class GenRequest:
+    """One in-flight generation request: its encoded prompt plus an
+    asyncio queue the decode loop feeds with token chunks (and a
+    ``None`` sentinel when done)."""
+
+    __slots__ = (
+        "row", "used", "n_new", "temperature", "seed", "queue", "loop",
+    )
+
+    def __init__(self, row, used, n_new, temperature, seed, loop):
+        self.row = row            # [bucketed] int32 ids, left-padded
+        self.used = used          # real prompt tokens in the row
+        self.n_new = n_new
+        self.temperature = temperature
+        self.seed = seed
+        self.loop = loop
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def push(self, item) -> None:
+        """Thread-safe enqueue from the decode thread."""
+        self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
+
+
+class _SyncSink:
+    """Adapter so the synchronous ``generate_text`` path reuses
+    ``_run_batch`` verbatim: collects token chunks into a list instead
+    of an asyncio queue."""
+
+    def __init__(self, req: "GenRequest", out_ids: list):
+        self.row, self.used, self.n_new = req.row, req.used, req.n_new
+        self.temperature, self.seed = req.temperature, req.seed
+        self._out = out_ids
+        self.error: Exception | None = None
+
+    def push(self, item) -> None:
+        if isinstance(item, Exception):
+            self.error = item
+        elif item is not None:
+            self._out.extend(item["token_ids"])
+
+
 class TextGenerationEngine:
     """Serving engine for generative LMs (``gpt_lm``).
 
-    Unlike the classification engines there is no label vocab and no
-    micro-batcher: one request is one ``model.generate`` program
-    (prefill + ``lax.scan`` decode), compiled per
-    (prompt-bucket, max_new_tokens, temperature) signature and warmed
-    for the default shape at startup.
+    Decoding is *incremental and batched*: prompts are left-padded to
+    a bucket (pads masked, positions shifted — output is
+    bucket-invariant, see ``GptLM.decode_step``) and decoded in
+    ``chunk``-token jitted scans against a donated KV cache. Two
+    consequences the one-shot design lacked:
+
+    - **Batching**: up to ``max_batch`` concurrent ``/generate``
+      requests share one prefill + one decode stream — N requests cost
+      ~1 request's device time (the classification batcher's win,
+      brought to generation). Per-row temperature/PRNG-stream means
+      mixed greedy/sampled requests batch together.
+    - **Streaming**: each decoded chunk is pushed to the requester as
+      it lands, so time-to-first-token is one prefill + one chunk, not
+      the whole generation.
+
+    Compile count is bounded by shape buckets only: programs are keyed
+    on (batch, prompt bucket, cache length), never on
+    ``max_new_tokens``/temperature/seed (request parameters are traced
+    or sliced on the host).
     """
 
     kind = "generative"
@@ -311,6 +367,9 @@ class TextGenerationEngine:
         meta: dict | None = None,
         default_max_new_tokens: int = 32,
         prompt_buckets: Sequence[int] = (16, 64, 128),
+        max_batch: int = 8,
+        chunk: int = 8,
+        max_wait_ms: float = 2.0,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -325,6 +384,9 @@ class TextGenerationEngine:
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b < model.max_positions
         ) or (model.max_positions // 2,)
+        self.max_batch = int(max_batch)
+        self.chunk = max(1, int(chunk))
+        self.max_wait_s = max_wait_ms / 1e3
         if mesh is not None:
             from mlapi_tpu.parallel import params_for_model
 
@@ -332,6 +394,13 @@ class TextGenerationEngine:
         else:
             params = jax.device_put(params)
         self.params = params
+        # Batcher state (started by the app's startup hook).
+        self._queue: asyncio.Queue | None = None
+        self._task: asyncio.Task | None = None
+        # Stats (read by /metrics and the coalescing test).
+        self.requests = 0
+        self.batch_calls = 0
+        self.chunk_calls = 0
 
     # Shared surface with the classification engines (healthz, app).
     @property
@@ -340,33 +409,232 @@ class TextGenerationEngine:
 
         return LabelVocab(())  # no label space; output is text
 
-    def warmup(self) -> None:
-        """Compile the default-shape generate program off the request
-        path (each new (bucket, tokens, temperature) signature still
-        compiles on first use). Clamped to the model's context window
-        so a small-context LM still comes up."""
-        bucket = self.prompt_buckets[0]
-        n_new = min(
-            self.default_max_new_tokens, self.model.max_positions - bucket
-        )
-        if n_new < 1:
-            bucket = max(1, self.model.max_positions // 2)
-            n_new = self.model.max_positions - bucket
-        ids = np.zeros((1, bucket), np.int32)
-        jax.block_until_ready(
-            self.model.generate(
-                self.params, jnp.asarray(ids), max_new_tokens=n_new
-            )
-        )
-        _log.info(
-            "warmed generate: prompt_bucket=%d, max_new_tokens=%d",
-            bucket, n_new,
-        )
-
+    # -- shapes ------------------------------------------------------------
     def _bucket(self, n: int) -> int:
         i = bisect.bisect_left(self.prompt_buckets, n)
         return self.prompt_buckets[min(i, len(self.prompt_buckets) - 1)]
 
+    def _cache_len(self, bucket: int, n_new: int) -> int:
+        """Static KV-cache length for a batch: prompt bucket + new
+        tokens rounded up to a chunk multiple (so one cache shape
+        serves a range of ``max_new_tokens``), clamped to the model's
+        window."""
+        rounded = -(-n_new // self.chunk) * self.chunk
+        return min(self.model.max_positions, bucket + rounded)
+
+    def _encode(self, text: str, n_new: int, temperature: float, seed: int,
+                loop) -> GenRequest:
+        limit = self.model.max_positions - n_new
+        if limit <= 0:
+            raise ValueError(
+                f"max_new_tokens={n_new} leaves no room for a prompt "
+                f"(max_positions={self.model.max_positions})"
+            )
+        raw = self.tokenizer.token_ids(text)
+        raw = raw[-limit:] if raw else [self.tokenizer.pad_id]
+        # Left-pad to a bucket so common prompt lengths never
+        # recompile; pads are masked out by the model (n_pad), so the
+        # answer is identical whichever bucket the prompt lands in. A
+        # prompt longer than the largest bucket gets its exact length
+        # (one-off compile) rather than silent truncation.
+        bucket = min(max(self._bucket(len(raw)), len(raw)), limit)
+        row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
+        used = min(len(raw), bucket)
+        row[-used:] = raw[-used:]
+        return GenRequest(row, used, n_new, temperature, seed, loop)
+
+    # -- the batched decode (runs on a worker thread) ----------------------
+    def _run_batch(self, reqs: list) -> None:
+        """Decode one coalesced batch, streaming chunks to each
+        request's queue; a ``None`` sentinel marks completion, an
+        exception object marks failure."""
+        from mlapi_tpu.models.gpt import decode_chunk_fn, prefill_fn
+
+        try:
+            self.batch_calls += 1
+            bucket = max(len(r.row) for r in reqs)
+            n_new_max = max(r.n_new for r in reqs)
+            total = self._cache_len(bucket, n_new_max)
+            n_new_max = min(n_new_max, total - bucket)
+            b = len(reqs)
+
+            prompt = np.full((b, bucket), self.tokenizer.pad_id, np.int32)
+            n_pad = np.zeros((b,), np.int32)
+            temps = np.zeros((b,), np.float32)
+            for i, r in enumerate(reqs):
+                prompt[i, bucket - len(r.row):] = r.row
+                n_pad[i] = bucket - r.used
+                temps[i] = r.temperature
+            key_data = np.stack(
+                [
+                    np.asarray(jax.random.key_data(jax.random.key(r.seed)))
+                    for r in reqs
+                ]
+            )
+
+            first, cache = prefill_fn(self.model, total)(
+                self.params, jnp.asarray(prompt), jnp.asarray(key_data),
+                jnp.asarray(temps), jnp.asarray(n_pad),
+            )
+            tok = first
+            first_host = np.asarray(first)
+            produced = 1
+            for i, r in enumerate(reqs):
+                r.push({"token_ids": [int(first_host[i])]})
+                if r.n_new <= 1:
+                    r.push(None)
+
+            dc = decode_chunk_fn(self.model, self.chunk)
+            n_pad_j, temps_j, keys_j = (
+                jnp.asarray(n_pad), jnp.asarray(temps), jnp.asarray(key_data)
+            )
+            pos, step = bucket, 1
+            while produced < n_new_max:
+                self.chunk_calls += 1
+                toks, cache, tok = dc(
+                    self.params, cache, tok, jnp.int32(pos),
+                    n_pad_j, temps_j, keys_j, jnp.int32(step),
+                )
+                toks_host = np.asarray(toks)
+                got = toks_host.shape[1]
+                for i, r in enumerate(reqs):
+                    want = r.n_new - produced
+                    if want > 0:
+                        r.push(
+                            {"token_ids":
+                                 toks_host[i, : min(want, got)].tolist()}
+                        )
+                        if want <= got:
+                            r.push(None)
+                pos += got
+                step += got
+                produced += got
+            # Safety net: every waiter MUST get a terminator. The
+            # collector only batches window-compatible requests, so
+            # this fires only if that invariant is ever broken — a
+            # loud error beats a silently-truncated hang.
+            for r in reqs:
+                if r.n_new > n_new_max:
+                    _log.error(
+                        "request truncated at %d/%d tokens (batch window "
+                        "exhausted) — collector grouping bug?",
+                        n_new_max, r.n_new,
+                    )
+                    r.push(RuntimeError(
+                        f"generation truncated at {n_new_max}/{r.n_new} "
+                        "tokens (incompatible batch)"
+                    ))
+        except Exception as e:  # noqa: BLE001 — delivered to every waiter
+            _log.error("generation batch of %d failed: %s", len(reqs), e)
+            for r in reqs:
+                try:
+                    r.push(e)
+                except Exception:  # a dead loop must not mask others
+                    pass
+
+    # -- asyncio batcher ---------------------------------------------------
+    async def start(self) -> None:
+        if self._task is None:
+            self._queue = asyncio.Queue()
+            self._task = asyncio.create_task(
+                self._collect_loop(), name="genbatcher"
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._queue is not None:
+            while not self._queue.empty():
+                req = self._queue.get_nowait()
+                req.push(RuntimeError("generation engine stopped"))
+
+    def _compatible(self, group: list, r) -> bool:
+        """Can ``r`` join ``group`` without clamping anyone? The batch
+        decodes to ``max(n_new)`` from a ``max(bucket)``-wide prompt;
+        both maxima together must still fit the model's window (each
+        request alone always does — ``_encode`` guarantees it)."""
+        bucket = max(len(r.row), *(len(g.row) for g in group))
+        n_new = max(r.n_new, *(g.n_new for g in group))
+        return bucket + n_new <= self.model.max_positions
+
+    async def _collect_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        carry: list = []  # window-incompatible leftovers, served next
+        reqs: list = []
+        try:
+            while True:
+                reqs = carry or [await self._queue.get()]
+                carry = []
+                if self.max_wait_s > 0:
+                    deadline = loop.time() + self.max_wait_s
+                    while len(reqs) < self.max_batch:
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        try:
+                            nxt = await asyncio.wait_for(
+                                self._queue.get(), timeout
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                        if self._compatible(reqs, nxt):
+                            reqs.append(nxt)
+                        else:
+                            carry.append(nxt)
+                            break  # keep the window short; serve it next
+                else:
+                    while (
+                        len(reqs) < self.max_batch
+                        and not self._queue.empty()
+                    ):
+                        nxt = self._queue.get_nowait()
+                        if self._compatible(reqs, nxt):
+                            reqs.append(nxt)
+                        else:
+                            carry.append(nxt)
+                            break
+                # One batch decodes at a time (single device stream);
+                # later arrivals batch together while this one runs.
+                await loop.run_in_executor(None, self._run_batch, reqs)
+                reqs = []
+        finally:
+            # Cancellation (stop()) or a collector crash must not
+            # strand waiters already popped off the queue.
+            err = RuntimeError("generation engine stopped")
+            for r in (*reqs, *carry):
+                try:
+                    r.push(err)
+                except Exception:
+                    pass
+
+    async def submit(
+        self,
+        text: str,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> GenRequest:
+        """Queue one prompt for batched decode; consume ``req.queue``
+        for ``{"token_ids": [...]}`` chunks until the ``None``
+        sentinel (exceptions are delivered in-band)."""
+        if self._task is None:
+            raise RuntimeError("generation engine not started")
+        n_new = int(max_new_tokens or self.default_max_new_tokens)
+        req = self._encode(
+            text, n_new, float(temperature), int(seed),
+            asyncio.get_running_loop(),
+        )
+        self.requests += 1
+        await self._queue.put(req)
+        return req
+
+    # -- synchronous single-shot (tests, bench, CLI) -----------------------
     def generate_text(
         self,
         text: str,
@@ -375,40 +643,37 @@ class TextGenerationEngine:
         temperature: float = 0.0,
         seed: int = 0,
     ) -> dict:
-        """One prompt → generated continuation (text + ids)."""
+        """One prompt → generated continuation (text + ids), decoded
+        through the same chunked programs the batcher uses (so there
+        is exactly one decode implementation to trust)."""
         n_new = int(max_new_tokens or self.default_max_new_tokens)
-        raw = self.tokenizer.token_ids(text)
-        limit = self.model.max_positions - n_new
-        if limit <= 0:
-            raise ValueError(
-                f"max_new_tokens={n_new} leaves no room for a prompt "
-                f"(max_positions={self.model.max_positions})"
-            )
-        raw = raw[-limit:] if raw else [self.tokenizer.pad_id]
-        # Left-pad to a bucket so common prompt lengths never
-        # recompile; the model treats every position causally, and
-        # pad-prefix tokens wash out of the final-position logits with
-        # trained models. A prompt longer than the largest bucket gets
-        # its exact length (one-off compile) rather than silent
-        # truncation.
-        bucket = min(max(self._bucket(len(raw)), len(raw)), limit)
-        prompt = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        used = min(len(raw), bucket)
-        prompt[0, -used:] = raw[-used:]
-
-        out = self.model.generate(
-            self.params,
-            jnp.asarray(prompt),
-            max_new_tokens=n_new,
-            temperature=float(temperature),
-            rng=jax.random.key(seed),
-        )
-        out_ids = [int(i) for i in np.asarray(out)[0]]
+        req = self._encode(text, n_new, float(temperature), int(seed), None)
+        out_ids: list[int] = []
+        sink = _SyncSink(req, out_ids)
+        self._run_batch([sink])
+        if sink.error is not None:
+            raise sink.error
         return {
             "text": self.tokenizer.decode(out_ids),
             "token_ids": out_ids,
-            "prompt_tokens": used,  # tokens that actually conditioned
+            "prompt_tokens": req.used,  # tokens that actually conditioned
         }
+
+    def warmup(self) -> None:
+        """Compile the hot programs off the request path: the default
+        (prompt-bucket, cache-length) prefill plus the shared
+        decode-chunk program. Other shape buckets still compile on
+        first use."""
+        bucket = self.prompt_buckets[0]
+        n_new = min(
+            self.default_max_new_tokens, self.model.max_positions - bucket
+        )
+        if n_new < 1:
+            n_new = max(1, self.model.max_positions // 2)
+        self.generate_text("", max_new_tokens=min(n_new, self.chunk + 1))
+        _log.info(
+            "warmed generate: prompt_bucket=%d, chunk=%d", bucket, self.chunk
+        )
 
 
 def _load_meta_only(path):

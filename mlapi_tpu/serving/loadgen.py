@@ -59,12 +59,24 @@ async def _worker(
             writer.write(request_bytes)
             await writer.drain()
             head = await reader.readuntil(b"\r\n\r\n")
-            status = int(head[9:12])  # b"HTTP/1.1 200 ..."
-            # The framework server always emits lowercase header names.
+            # Fast path is hardwired to the in-repo server's output
+            # (HTTP/1.1 status line, lowercase headers); anything else
+            # gets a tolerant parse instead of a silent misparse/stall.
+            if head.startswith(b"HTTP/1."):
+                status = int(head[9:12])  # b"HTTP/1.1 200 ..."
+            else:
+                raise RuntimeError(f"not an HTTP/1.x response: {head[:16]!r}")
             i = head.find(b"content-length:")
+            if i < 0:  # mixed-case emitter (not this repo's server)
+                i = head.lower().find(b"content-length:")
             if i >= 0:
                 j = head.index(b"\r\n", i)
                 await reader.readexactly(int(head[i + 15 : j]))
+            elif b"transfer-encoding" in head.lower():
+                raise RuntimeError(
+                    "loadgen does not speak chunked responses; point it at "
+                    "a non-streaming route"
+                )
             result.latencies_ms.append((time.perf_counter() - t0) * 1e3)
             result.requests += 1
             if status != 200:

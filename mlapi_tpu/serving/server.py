@@ -121,38 +121,86 @@ class Server:
             body_sent = True
             return {"type": "http.request", "body": request.body, "more_body": False}
 
-        response_parts: dict = {"status": 500, "headers": [], "chunks": []}
+        keep_alive = _wants_keep_alive(request)
+        # state: headers buffered until the first body message decides
+        # the framing — a single-shot body gets content-length (the
+        # /predict fast path, one write + one drain per response); a
+        # streamed body (more_body=True) switches to chunked transfer
+        # encoding with a write+drain per chunk so the client sees
+        # data as the handler produces it. HTTP/1.0 clients don't
+        # de-frame chunked encoding, so a stream to them is
+        # close-delimited (raw bytes, connection: close) instead.
+        chunked_ok = request.version != "1.0"
+        state = {"status": 500, "headers": [], "streaming": False,
+                 "started": False}
+
+        def _head(extra: bytes) -> bytes:
+            status = state["status"]
+            phrase = _STATUS_PHRASES.get(status, "Unknown")
+            # Bytes all the way down — response headers arrive as
+            # bytes from ASGI and hit the socket as bytes.
+            head = bytearray(
+                f"HTTP/1.1 {status} {phrase}\r\n".encode("latin-1")
+            )
+            for k, v in state["headers"]:
+                if k.lower() not in (b"content-length", b"transfer-encoding"):
+                    head += k + b": " + v + b"\r\n"
+            head += extra
+            head += (
+                b"connection: keep-alive\r\n\r\n"
+                if keep_alive
+                else b"connection: close\r\n\r\n"
+            )
+            return bytes(head)
 
         async def send(message):
+            nonlocal keep_alive
             if message["type"] == "http.response.start":
-                response_parts["status"] = message["status"]
-                response_parts["headers"] = message.get("headers", [])
-            elif message["type"] == "http.response.body":
-                response_parts["chunks"].append(message.get("body", b""))
+                state["status"] = message["status"]
+                state["headers"] = message.get("headers", [])
+                return
+            if message["type"] != "http.response.body":
+                return
+            body = message.get("body", b"")
+            more = message.get("more_body", False)
+            if not state["started"]:
+                state["started"] = True
+                if not more:
+                    writer.write(
+                        _head(
+                            b"content-length: "
+                            + str(len(body)).encode() + b"\r\n"
+                        )
+                        + body
+                    )
+                    await writer.drain()
+                    return
+                state["streaming"] = True
+                if chunked_ok:
+                    writer.write(_head(b"transfer-encoding: chunked\r\n"))
+                else:
+                    keep_alive = False  # close delimits the 1.0 body
+                    writer.write(_head(b""))
+            if not state["streaming"]:
+                return  # spurious extra message after a completed body
+            if not chunked_ok:
+                if body:
+                    writer.write(body)
+                await writer.drain()
+                return
+            if body:
+                writer.write(
+                    b"%x\r\n" % len(body) + body + b"\r\n"
+                )
+            if not more:
+                writer.write(b"0\r\n\r\n")
+            await writer.drain()
 
         await self.app(scope, receive, send)
-
-        body = b"".join(response_parts["chunks"])
-        keep_alive = _wants_keep_alive(request)
-        status = response_parts["status"]
-        phrase = _STATUS_PHRASES.get(status, "Unknown")
-        # Bytes all the way down — response headers arrive as bytes
-        # from ASGI and go to the socket as bytes; no str round trip.
-        head = bytearray(f"HTTP/1.1 {status} {phrase}\r\n".encode("latin-1"))
-        have_length = False
-        for k, v in response_parts["headers"]:
-            if not have_length and k.lower() == b"content-length":
-                have_length = True
-            head += k + b": " + v + b"\r\n"
-        if not have_length:
-            head += b"content-length: " + str(len(body)).encode() + b"\r\n"
-        head += (
-            b"connection: keep-alive\r\n\r\n"
-            if keep_alive
-            else b"connection: close\r\n\r\n"
-        )
-        writer.write(bytes(head) + body)
-        await writer.drain()
+        if not state["started"]:
+            # App produced no body message at all; close the exchange.
+            writer.write(_head(b"content-length: 0\r\n"))
+            await writer.drain()
         return keep_alive
 
 

@@ -99,3 +99,35 @@ def test_no_pickle_on_disk(tmp_path, params):
         assert not f.name.endswith((".pkl", ".pickle"))
         head = f.read_bytes()[:2]
         assert head != b"\x80\x04", f"pickle protocol header found in {f}"
+
+
+def test_multihost_commit_barrier(tmp_path, params, monkeypatch):
+    """Mocked multi-process save: non-zero processes barrier twice and
+    do NOT write the manifest; process 0 writes it between the
+    barriers; barrier keys carry the full path (same-named leaf dirs
+    under different roots must not cross-match)."""
+    import mlapi_tpu.checkpoint.io as io_mod
+    from jax.experimental import multihost_utils
+
+    seen: list[str] = []
+    monkeypatch.setattr(
+        multihost_utils, "sync_global_devices", lambda key: seen.append(key)
+    )
+    monkeypatch.setattr(io_mod, "_process_count", lambda: 2)
+
+    # Process 1: returns after the barriers without committing.
+    monkeypatch.setattr(io_mod, "_process_index", lambda: 1)
+    p1 = save_checkpoint(tmp_path / "a" / "step_1", params, step=1)
+    assert not (p1 / "MANIFEST.json").exists()
+    assert len(seen) == 2
+    assert seen[0].startswith("ckpt_pre:") and seen[1].startswith("ckpt_post:")
+    assert str(tmp_path / "a" / "step_1") in seen[0]  # full path, not leaf
+
+    # Process 0: commits the manifest between the two barriers.
+    seen.clear()
+    monkeypatch.setattr(io_mod, "_process_index", lambda: 0)
+    p0 = save_checkpoint(tmp_path / "b" / "step_1", params, step=1)
+    assert (p0 / "MANIFEST.json").exists()
+    assert [k.split(":")[0] for k in seen] == ["ckpt_pre", "ckpt_post"]
+    # Keys from different roots with the same leaf dir must differ.
+    assert seen[0] != f"ckpt_pre:{tmp_path / 'a' / 'step_1'}"

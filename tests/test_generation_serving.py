@@ -3,6 +3,7 @@ pattern → checkpoint → TextGenerationEngine via from_checkpoint →
 POST /generate through the ASGI app."""
 
 import asyncio
+import json
 
 import httpx
 import jax
@@ -132,5 +133,97 @@ async def test_generate_over_http(gpt_checkpoint):
             # healthz/metrics exist on the generative app too.
             assert (await client.get("/healthz")).json()["status"] == "ok"
             assert "counters" in (await client.get("/metrics")).json()
+    finally:
+        await app.shutdown()
+
+
+def test_bucket_invariant_outputs(gpt_checkpoint):
+    """The pad prefix must not leak into the result: the same prompt
+    decoded from different pad buckets produces identical tokens
+    (regression for the left-pad masking bug — pads used to be
+    attended and to shift positions)."""
+    e_small = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    e_small.prompt_buckets = (16,)
+    e_big = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    e_big.prompt_buckets = (48,)
+    for temp, seed in ((0.0, 0), (0.9, 5)):
+        a = e_small.generate_text(
+            "abab", max_new_tokens=6, temperature=temp, seed=seed
+        )
+        b = e_big.generate_text(
+            "abab", max_new_tokens=6, temperature=temp, seed=seed
+        )
+        assert a["token_ids"] == b["token_ids"], (temp, seed)
+
+
+async def test_concurrent_requests_coalesce_and_match_single_stream(
+    gpt_checkpoint,
+):
+    """N concurrent /generate requests share a decode batch (few
+    batch_calls) and each row's output equals its single-stream
+    answer — batching must be invisible except in throughput."""
+    engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    app = build_app(engine)
+    await app.startup()
+    try:
+        prompts = ["ab", "abab", "ababab", "ba", "aabb", "abba"]
+        singles = [
+            engine.generate_text(p, max_new_tokens=8, temperature=0.5, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        base_batches = engine.batch_calls
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(
+            transport=transport, base_url="http://test"
+        ) as client:
+            outs = await asyncio.gather(
+                *(
+                    client.post(
+                        "/generate",
+                        json={"text": p, "max_new_tokens": 8,
+                              "temperature": 0.5, "seed": i},
+                    )
+                    for i, p in enumerate(prompts)
+                )
+            )
+        for single, r in zip(singles, outs):
+            assert r.status_code == 200, r.text
+            assert r.json()["token_ids"] == single["token_ids"]
+        # 6 requests -> far fewer than 6 batches (some coalescing).
+        assert engine.batch_calls - base_batches <= 3
+    finally:
+        await app.shutdown()
+
+
+async def test_streaming_ndjson(gpt_checkpoint):
+    """stream=true yields incremental NDJSON chunks whose tokens
+    concatenate to the non-streamed answer, ending with a done line."""
+    engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    app = build_app(engine)
+    await app.startup()
+    try:
+        ref = engine.generate_text("abababab", max_new_tokens=10)
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(
+            transport=transport, base_url="http://test"
+        ) as client:
+            async with client.stream(
+                "POST",
+                "/generate",
+                json={"text": "abababab", "max_new_tokens": 10,
+                      "stream": True},
+            ) as r:
+                assert r.status_code == 200
+                assert r.headers["content-type"] == "application/x-ndjson"
+                lines = []
+                async for line in r.aiter_lines():
+                    if line:
+                        lines.append(json.loads(line))
+        assert len(lines) >= 3  # at least 2 token chunks + done
+        done = lines[-1]
+        assert done["done"] is True
+        streamed = [t for ln in lines[:-1] for t in ln["token_ids"]]
+        assert streamed == ref["token_ids"] == done["token_ids"]
+        assert done["text"] == ref["text"]
     finally:
         await app.shutdown()
