@@ -124,7 +124,10 @@ def save_checkpoint(
     """Write a complete checkpoint at ``path`` (a single step dir)."""
     import orbax.checkpoint as ocp
 
-    path = Path(path).absolute()
+    # resolve(), not absolute(): the path string feeds the multi-host
+    # barrier keys below, so symlinked mounts / '..' segments / cwd
+    # differences across processes must normalise to one spelling.
+    path = Path(path).resolve()
     path.mkdir(parents=True, exist_ok=True)
     config = dict(config or {})
 

@@ -1,22 +1,38 @@
-"""Fused attention kernel (flash-attention style) in Pallas.
+"""Fused attention kernels (flash-attention) in Pallas, both passes.
 
 Why a kernel at all: stock XLA materialises the ``[B, H, L, L]``
 score tensor in HBM between the two attention matmuls once L is big
 enough that fusion gives up — at L=2048, BERT-base shapes, that is
-256 MB of HBM traffic per layer. Here the grid is
-``(B, H, L/block_q)`` and each program computes one q-block's output
-with scores, softmax and the probs·V contraction all resident in
-VMEM: HBM sees only Q/K/V/O.
+256 MB of HBM traffic per layer. Here scores never exist at full
+size anywhere: the forward streams K/V through VMEM in ``block_k``
+tiles with the online-softmax recurrence (running max ``m``, running
+normaliser ``l``, rescaled accumulator), and the backward recomputes
+probabilities tile-by-tile from the saved log-sum-exp instead of
+storing them. HBM sees Q/K/V/O (+ per-row LSE) only, in both
+directions — no ``[L, L]`` tensor in the compiled HLO.
 
-Per-program VMEM footprint is ``block_q·L`` f32 scores plus the K/V
-blocks — ~5 MB at L=4096, ``block_q=128``, ``D=64`` — inside the
-~16 MB budget. Longer sequences belong to the sequence-parallel path
-(``mlapi_tpu.ops.ring_attention``), which composes: each ring step's
-local block attention can itself be this kernel.
+Grid layout (TPU: the grid is iterated sequentially, last dimension
+innermost; VMEM scratch persists across grid steps, which is what
+carries the online-softmax state between K tiles):
+
+- forward:   ``(B, H, L/block_q, L/block_k)`` — one q-tile's output
+  accumulates across the inner k-steps, written at the last k-step.
+- backward dq: same grid; dq accumulates across k-steps.
+- backward dk/dv: ``(B, H, L/block_k, L/block_q)`` — q innermost,
+  dk/dv accumulate across q-steps.
+
+Causal masking skips whole tiles above the diagonal (``pl.when``
+predication), so causal attention does ~half the work.
+
+Per-program VMEM is a few ``block×block`` f32 tiles (~0.5 MB at the
+default 128/128 blocks) — far inside the ~16 MB budget at any L.
+Longer sequences belong to the sequence-parallel path
+(``mlapi_tpu.ops.ring_attention``).
 
 Layout convention matches ``mlapi_tpu.ops.attention``: ``q, k, v``
-are ``[B, L, H, D]``, ``mask`` is binary ``[B, L]`` over keys; both
-matmuls run native-dtype inputs with f32 accumulation on the MXU.
+are ``[B, L, H, D]``, ``mask`` is binary ``[B, L]`` over keys; fully
+masked query rows return zeros (all three attention impls agree).
+Matmuls run native-dtype inputs with f32 accumulation on the MXU.
 """
 
 from __future__ import annotations
@@ -26,105 +42,341 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Python float (not a jax scalar — kernels may not capture traced
 # constants); same finite large-negative as mlapi_tpu.ops.attention.NEG.
 _NEG = -1e30
+# Scratch lane width: TPU vector lanes are 128 wide; the row-state
+# scratch (m, l) is kept lane-replicated so reads/writes stay aligned.
+_LANES = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, causal, block_q):
-    # Block shapes: q [1,1,block_q,D]; k/v [1,1,L,D]; mask [1,1,L].
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    key_mask = mask_ref[0, 0]  # [L] binary
+def _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, shape):
+    """Binary keep-mask for one (q-tile, k-tile) score block."""
+    keep = mask_ref[0, 0][None, :].astype(jnp.float32)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        keep = keep * (q_pos >= k_pos)
+    return keep
 
-    scores = (
-        jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+    *, scale, causal, block_q, block_k,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # Causal: tiles entirely above the diagonal contribute nothing.
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = (
+            jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [block_q, block_k]
+        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        s = s + (1.0 - keep) * _NEG
+
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # exp(NEG - NEG) == 1 on lanes with no valid key; * keep zeroes
+        # them so fully-masked rows come out 0, not NaN.
+        p = jnp.exp(s - m_new) * keep
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        * scale
-    )  # [block_q, L]
-    keep = key_mask[None, :].astype(jnp.float32)
-    if causal:
-        i = pl.program_id(2)
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0
-        )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        keep = keep * (q_pos >= k_pos)
-    scores = scores + (1.0 - keep) * _NEG
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
 
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    # exp(NEG - NEG) == 1 when a row sees no valid key; * keep zeroes
-    # those lanes so fully-masked rows come out 0, not NaN.
-    p = jnp.exp(scores - m) * keep
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        p.astype(q.dtype), v,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) / jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        o_ref[0, 0] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-30))
 
 
-def _forward(q, k, v, mask, causal, scale, block_q, interpret):
+def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     b, l, h, d = q.shape
     # [B, 1, L]: TPU lowering wants the last two block dims tile-
-    # aligned or equal to the array dims; a (1, 1, L) block satisfies
-    # that where a (1, L) block over [B, L] cannot when B > 1.
+    # aligned or equal to the array dims; a (1, 1, block_k) block
+    # satisfies that where a (1, block_k) block over [B, L] cannot
+    # when B > 1.
     mask3 = mask.astype(jnp.float32)[:, None, :]
-
     # [B, L, H, D] -> [B, H, L, D]: heads become a grid dimension.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
-    grid = (b, h, l // block_q)
-    qo_spec = pl.BlockSpec(
-        (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+    grid = (b, h, l // block_q, l // block_k)
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
-    kv_spec = pl.BlockSpec((1, 1, l, d), lambda bi, hi, qi: (bi, hi, 0, 0))
-    mask_spec = pl.BlockSpec((1, 1, l), lambda bi, hi, qi: (bi, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    )
+    mask_spec = pl.BlockSpec(
+        (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+    )
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, causal=causal, block_q=block_q
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
         ),
         grid=grid,
-        in_specs=[qo_spec, kv_spec, kv_spec, mask_spec],
-        out_specs=qo_spec,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        in_specs=[q_spec, kv_spec, kv_spec, mask_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, l), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),       # output acc
+        ],
         interpret=interpret,
     )(qt, kt, vt, mask3)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, causal, scale, block_q, interpret):
-    return _forward(q, k, v, mask, causal, scale, block_q, interpret)
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_s, *, scale, causal, block_q, block_k,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, None]          # [block_q, 1]
+        delta = delta_ref[0, 0][:, None]      # [block_q, 1]
+
+        # All matmuls take native-dtype (bf16) operands with f32
+        # accumulation — the MXU recipe; f32 lives only in the
+        # softmax-recompute elementwise math.
+        s = (
+            jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        s = s + (1.0 - keep) * _NEG
+        # Recompute probabilities from the saved LSE. Masked lanes give
+        # exp(NEG - lse) — large but finite (lse >= NEG + log(eps)) —
+        # then * keep zeroes them, so no NaN even for fully-masked rows.
+        p = jnp.exp(s - lse) * keep
+        dp = jax.lax.dot_general(
+            do, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        ds = p * (dp - delta) * scale
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, block_q, interpret):
-    out = _forward(q, k, v, mask, causal, scale, block_q, interpret)
-    return out, (q, k, v, mask)
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, block_q, block_k,
+):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+
+        # Native-dtype matmul operands, f32 accumulation (MXU recipe).
+        s = (
+            jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        s = s + (1.0 - keep) * _NEG
+        p = jnp.exp(s - lse) * keep            # [block_q, block_k]
+        # dv += pᵀ · dO ; dk += dsᵀ · q — contractions over the q dim,
+        # no explicit transpose materialised.
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, interpret, res, g):
-    # Backward via the differentiable XLA reference (recompute-from-
-    # residuals, flash-attention style): training pays the [L, L]
-    # materialisation only in the grad pass; the serving-critical
-    # forward keeps the fused kernel. A Pallas backward kernel can
-    # replace this without touching callers.
-    from mlapi_tpu.ops.attention import full_attention
+def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
+         interpret):
+    b, l, h, d = q.shape
+    mask3 = mask.astype(jnp.float32)[:, None, :]
+    qt, kt, vt, ot, gt = (
+        x.transpose(0, 2, 1, 3) for x in (q, k, v, out, g)
+    )
+    # delta_i = Σ_d dO_i · O_i — one cheap fused elementwise+reduce in
+    # XLA; saves the backward kernels a dot each per tile.
+    delta = jnp.sum(
+        gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+    )  # [B, H, L]
 
-    q, k, v, mask = res
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    )
+    mask_spec = pl.BlockSpec(
+        (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+    )
 
-    def ref(q, k, v):
-        return full_attention(q, k, v, mask, causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, l // block_q, l // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec,
+                  row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, mask3, gt, lse, delta)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    # dk/dv: k-tiles accumulate over q-tiles — swap the outer/inner
+    # grid roles (index maps see (bi, hi, ki, qi)).
+    q_spec_T = pl.BlockSpec(
+        (1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    )
+    kv_spec_T = pl.BlockSpec(
+        (1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    )
+    mask_spec_T = pl.BlockSpec(
+        (1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)
+    )
+    row_spec_T = pl.BlockSpec(
+        (1, 1, block_q), lambda bi, hi, ki, qi: (bi, hi, qi)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, l // block_k, l // block_q),
+        in_specs=[q_spec_T, kv_spec_T, kv_spec_T, mask_spec_T, q_spec_T,
+                  row_spec_T, row_spec_T],
+        out_specs=[kv_spec_T, kv_spec_T],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, mask3, gt, lse, delta)
+
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
+        interpret,
+    )
     return dq, dk, dv, jnp.zeros_like(mask)
 
 
@@ -132,7 +384,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "block_q", "interpret")
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
 def flash_attention(
     q,
@@ -143,25 +396,32 @@ def flash_attention(
     causal: bool = False,
     scale=None,
     block_q: int = 128,
+    block_k: int = 128,
     interpret: bool = False,
 ):
     """Fused softmax attention. ``q, k, v``: ``[B, L, H, D]``;
     ``mask``: optional binary ``[B, L]`` over keys. Returns
     ``[B, L, H, D]`` in ``q.dtype``.
 
-    Differentiable: the forward runs the Pallas kernel, the backward
-    runs the XLA reference via a custom VJP (see ``_flash_bwd``).
+    Differentiable end to end in Pallas: the forward streams K/V in
+    ``block_k`` tiles with the online-softmax recurrence and saves the
+    per-row log-sum-exp; the backward recomputes probability tiles
+    from it and accumulates dq (k-inner grid) and dk/dv (q-inner
+    grid) — no ``[L, L]`` tensor in HBM in either pass.
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
     """
     b, l, h, d = q.shape
     scale = (1.0 / d**0.5) if scale is None else scale
     block_q = min(block_q, l)
-    if l % block_q:
+    block_k = min(block_k, l)
+    if l % block_q or l % block_k:
         raise ValueError(
-            f"sequence length {l} not divisible by block_q {block_q}"
+            f"sequence length {l} not divisible by blocks "
+            f"({block_q}, {block_k})"
         )
     if mask is None:
         mask = jnp.ones((b, l), jnp.float32)
     return _flash(
-        q, k, v, mask.astype(jnp.float32), causal, scale, block_q, interpret
+        q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
+        interpret,
     )

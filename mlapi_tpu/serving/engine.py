@@ -625,6 +625,15 @@ class TextGenerationEngine:
         sentinel (exceptions are delivered in-band)."""
         if self._task is None:
             raise RuntimeError("generation engine not started")
+        if self._task.done():
+            # A dead collector must fail requests fast, not let them
+            # queue forever; surface what killed it.
+            exc = (
+                None if self._task.cancelled() else self._task.exception()
+            )
+            raise RuntimeError(
+                f"generation collector died: {exc!r}"
+            ) from exc
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         req = self._encode(
             text, n_new, float(temperature), int(seed),

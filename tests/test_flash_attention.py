@@ -63,8 +63,8 @@ def test_rejects_indivisible_block():
 
 
 def test_gradients_match_full_attention():
-    """flash is differentiable (custom VJP: kernel forward, XLA
-    backward) — grads must match the reference."""
+    """flash is differentiable (custom VJP: Pallas kernels in both
+    directions) — grads must match the reference."""
     q, k, v = _qkv(seed=7)
     lengths = np.array([L - 6, 23])
     mask = jnp.asarray(
@@ -116,3 +116,61 @@ def test_compiled_on_tpu_matches():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def test_gradients_match_with_k_tiling_and_causal():
+    """Both backward kernels accumulate across tiles: exercise
+    multiple q- AND k-tiles (4x4 grid) with causal + padding mask —
+    the online-softmax recompute path, not a single-tile degenerate."""
+    q, k, v = _qkv(seed=8)
+    lengths = np.array([L - 6, 23])
+    mask = jnp.asarray(
+        (np.arange(L)[None, :] < lengths[:, None]).astype(np.float32)
+    )
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, mask, causal=True, block_q=16, block_k=16,
+            interpret=True,
+        )
+        return jnp.sum(out ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, mask, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_forward_lse_is_consistent_under_k_tiling():
+    """Forward output must not depend on the k-tile size (the online
+    carry is exact, not approximate)."""
+    q, k, v = _qkv(seed=9)
+    a = flash_attention(q, k, v, block_q=16, block_k=64, interpret=True)
+    b = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.requires_tpu
+def test_compiled_grad_has_no_quadratic_tensor():
+    """VERDICT r1 done-criterion: the compiled grad path must not
+    materialise an [L, L] score tensor in HBM — check the optimized
+    HLO for any buffer with two trailing L-sized dims."""
+    l = 512
+    q, k, v = _qkv(seed=10, dtype=jnp.bfloat16, l=l)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    txt = (
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        .lower(q, k, v)
+        .compile()
+        .as_text()
+    )
+    import re
+
+    quadratic = re.findall(rf"\[(?:\d+,)*{l},{l}\]", txt)
+    assert not quadratic, f"found [L,L] buffers in HLO: {quadratic[:5]}"
