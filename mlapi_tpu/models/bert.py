@@ -229,30 +229,33 @@ class BertClassifier:
 
     # ------------------------------------------------------------------
     def param_shardings(self, layout=None) -> dict:
-        """Megatron-style TP layout over the ``model`` mesh axis."""
-        from mlapi_tpu.parallel import MODEL_AXIS
+        """Megatron-style TP layout; axis names come from the shared
+        ``SpecLayout`` (mesh renames touch one place)."""
+        from mlapi_tpu.parallel import SpecLayout
 
-        col = {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)}
-        row = {"kernel": P(MODEL_AXIS, None), "bias": P()}
+        lo = layout or SpecLayout()
+        col = {"kernel": lo.attn_qkv(), "bias": lo.bias_col()}
+        row = {"kernel": lo.attn_out(), "bias": lo.replicated()}
+        rep = lo.replicated()
         specs = {
             "embeddings": {
-                "word": P(MODEL_AXIS, None),  # vocab-sharded
-                "position": P(),
-                "token_type": P(),
-                "ln_scale": P(),
-                "ln_bias": P(),
+                "word": lo.embedding_rows(),  # vocab-sharded
+                "position": rep,
+                "token_type": rep,
+                "ln_scale": rep,
+                "ln_bias": rep,
             },
-            "pooler": {"kernel": P(), "bias": P()},
-            "classifier": {"kernel": P(), "bias": P()},
+            "pooler": {"kernel": rep, "bias": rep},
+            "classifier": {"kernel": rep, "bias": rep},
         }
         for n in range(self.num_layers):
             specs[f"layer_{n}"] = {
                 "q": dict(col), "k": dict(col), "v": dict(col),
                 "attn_out": dict(row),
-                "ln1_scale": P(), "ln1_bias": P(),
+                "ln1_scale": rep, "ln1_bias": rep,
                 "ffn_up": dict(col),
                 "ffn_down": dict(row),
-                "ln2_scale": P(), "ln2_bias": P(),
+                "ln2_scale": rep, "ln2_bias": rep,
             }
         return specs
 

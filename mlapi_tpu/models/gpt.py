@@ -51,13 +51,20 @@ class GptLM:
     num_heads: int = 4
     max_positions: int = 256
     compute_dtype: str = "bfloat16"
-    # "full" | "flash" (Pallas kernel) — both causal. Ring attention
-    # composes at the ops level for training on a seq-axis mesh.
+    # "full" | "flash" (Pallas kernel) | "ring" (sequence-parallel
+    # over mesh's seq axis; requires ``mesh``) — all causal. Ring
+    # applies to ``apply`` (training/scoring, where the whole sequence
+    # is live); ``generate`` decodes one token at a time against the
+    # KV cache, where there is no sequence dimension to shard.
     attention_impl: str = "full"
+    mesh: object = None  # jax.sharding.Mesh for attention_impl="ring"
+    seq_axis: str = "seq"
 
     def __post_init__(self):
-        if self.attention_impl not in ("full", "flash"):
+        if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.attention_impl == "ring" and self.mesh is None:
+            raise ValueError('attention_impl="ring" requires a mesh')
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide evenly into heads")
 
@@ -143,6 +150,14 @@ class GptLM:
                 return flash_attention(
                     q, k, v, causal=True,
                     interpret=jax.default_backend() != "tpu",
+                )
+        elif self.attention_impl == "ring":
+            from mlapi_tpu.ops import ring_self_attention
+
+            def attend(q, k, v):
+                return ring_self_attention(
+                    self.mesh, q, k, v, causal=True,
+                    seq_axis=self.seq_axis, head_axis="model",
                 )
         else:
             def attend(q, k, v):
@@ -283,26 +298,28 @@ class GptLM:
 
     # ------------------------------------------------------------------
     def param_shardings(self, layout=None) -> dict:
-        """Megatron TP over ``model``: qkv/ffn-up column-sharded,
-        attn-out/ffn-down row-sharded, embeddings vocab-sharded."""
-        from mlapi_tpu.parallel import MODEL_AXIS
+        """Megatron TP: qkv/ffn-up column-sharded, attn-out/ffn-down
+        row-sharded, embeddings vocab-sharded. Axis names come from
+        the shared ``SpecLayout`` (mesh renames touch one place)."""
+        from mlapi_tpu.parallel import SpecLayout
 
-        col = {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)}
-        row = {"kernel": P(MODEL_AXIS, None), "bias": P()}
+        lo = layout or SpecLayout()
+        col = {"kernel": lo.attn_qkv(), "bias": lo.bias_col()}
+        row = {"kernel": lo.attn_out(), "bias": lo.replicated()}
         specs = {
-            "wte": P(MODEL_AXIS, None),
-            "wpe": P(),
-            "ln_f_scale": P(),
-            "ln_f_bias": P(),
+            "wte": lo.embedding_rows(),
+            "wpe": lo.replicated(),
+            "ln_f_scale": lo.replicated(),
+            "ln_f_bias": lo.replicated(),
         }
         for n in range(self.num_layers):
             specs[f"layer_{n}"] = {
                 "qkv": dict(col),
                 "attn_out": dict(row),
-                "ln1_scale": P(), "ln1_bias": P(),
+                "ln1_scale": lo.replicated(), "ln1_bias": lo.replicated(),
                 "ffn_up": dict(col),
                 "ffn_down": dict(row),
-                "ln2_scale": P(), "ln2_bias": P(),
+                "ln2_scale": lo.replicated(), "ln2_bias": lo.replicated(),
             }
         return specs
 

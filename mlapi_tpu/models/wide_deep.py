@@ -119,16 +119,19 @@ class WideDeepClassifier:
 
     def param_shardings(self, layout=None) -> dict:
         """PartitionSpec pytree matching ``init``'s structure: tables
-        sharded over the model axis on the vocab dim, the rest
-        replicated."""
-        from mlapi_tpu.parallel import MODEL_AXIS
+        sharded on the vocab dim, the rest replicated. Axis names come
+        from the shared ``SpecLayout``."""
+        from mlapi_tpu.parallel import SpecLayout
 
+        lo = layout or SpecLayout()
         specs = {
-            "wide_dense": P(),
-            "wide_bias": P(),
-            "wide_tables": P(None, MODEL_AXIS, None),
-            "deep_tables": P(None, MODEL_AXIS, None),
+            "wide_dense": lo.replicated(),
+            "wide_bias": lo.replicated(),
+            "wide_tables": lo.embedding_tables(),
+            "deep_tables": lo.embedding_tables(),
         }
         for i in range(len(self.hidden_dims) + 1):
-            specs[f"deep_{i}"] = {"kernel": P(), "bias": P()}
+            specs[f"deep_{i}"] = {
+                "kernel": lo.replicated(), "bias": lo.replicated()
+            }
         return specs

@@ -10,6 +10,9 @@ hand control of VMEM/MXU beats the XLA default:
   score matrix never touches HBM.
 """
 
-from mlapi_tpu.ops.pallas.flash_attention import flash_attention
+from mlapi_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]

@@ -117,6 +117,16 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-30))
 
 
+def _out_struct(shape, dtype, like):
+    # Inside shard_map, pallas_call outputs must declare which mesh
+    # axes they vary over (vma); mirror the query operand's type so
+    # the kernels compose with the ring/sequence-parallel paths.
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     b, l, h, d = q.shape
     # [B, 1, L]: TPU lowering wants the last two block dims tile-
@@ -150,8 +160,8 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec],
         out_specs=[q_spec, lse_spec],
         out_shape=[
-            jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, l), jnp.float32),
+            _out_struct(qt.shape, q.dtype, q),
+            _out_struct((b, h, l), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -280,17 +290,22 @@ def _bwd_dkv_kernel(
 
 
 def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
-         interpret):
+         interpret, g_lse=None):
     b, l, h, d = q.shape
     mask3 = mask.astype(jnp.float32)[:, None, :]
     qt, kt, vt, ot, gt = (
         x.transpose(0, 2, 1, 3) for x in (q, k, v, out, g)
     )
     # delta_i = Σ_d dO_i · O_i — one cheap fused elementwise+reduce in
-    # XLA; saves the backward kernels a dot each per tile.
+    # XLA; saves the backward kernels a dot each per tile. A cotangent
+    # on the LSE output folds in here exactly: ∂lse_i/∂s_ij = p_ij, so
+    # ds_ij = p_ij·(dp_ij - (delta_i - g_lse_i))·scale — the kernels
+    # need no change.
     delta = jnp.sum(
         gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
     )  # [B, H, L]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec(
         (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
@@ -314,7 +329,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec,
                   row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_shape=_out_struct(qt.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, mask3, gt, lse, delta)
@@ -343,8 +358,8 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
                   row_spec_T, row_spec_T],
         out_specs=[kv_spec_T, kv_spec_T],
         out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, k.dtype),
-            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            _out_struct(kt.shape, k.dtype, q),
+            _out_struct(vt.shape, v.dtype, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -362,20 +377,24 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
-    return out
+    """(out, lse) with a joint VJP — lse cotangents cost nothing extra
+    (they fold into the delta term, see ``_bwd``), which is what lets
+    ring attention compose flash blocks and still train through the
+    log-sum-exp merge."""
+    return _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
 
 
 def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     out, lse = _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, mask, out, lse)
+    return (out, lse), (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, mask, out, lse = res
+    g_o, g_lse = g
     dq, dk, dv = _bwd(
-        q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
-        interpret,
+        q, k, v, mask, out, lse, g_o, causal, scale, block_q, block_k,
+        interpret, g_lse=g_lse,
     )
     return dq, dk, dv, jnp.zeros_like(mask)
 
@@ -410,6 +429,45 @@ def flash_attention(
     grid) — no ``[L, L]`` tensor in HBM in either pass.
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
     """
+    b, l, h, d = q.shape
+    scale = (1.0 / d**0.5) if scale is None else scale
+    block_q = min(block_q, l)
+    block_k = min(block_k, l)
+    if l % block_q or l % block_k:
+        raise ValueError(
+            f"sequence length {l} not divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+    if mask is None:
+        mask = jnp.ones((b, l), jnp.float32)
+    out, _ = _flash(
+        q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
+        interpret,
+    )
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention_with_lse(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    causal: bool = False,
+    scale=None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``[B, H, L]`` — the quantity that lets independently
+    computed attention blocks be merged exactly (numerically safe
+    weighted average). Used by ``ring_attention``'s flash block mode;
+    differentiable through BOTH outputs."""
     b, l, h, d = q.shape
     scale = (1.0 / d**0.5) if scale is None else scale
     block_q = min(block_q, l)

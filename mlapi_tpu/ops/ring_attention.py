@@ -43,6 +43,7 @@ def ring_attention(
     axis_size: int,
     causal: bool = False,
     scale=None,
+    block_impl: str = "einsum",
 ):
     """Blockwise ring attention for ONE device's sequence block.
 
@@ -54,7 +55,20 @@ def ring_attention(
 
     ``axis_size`` must be the static size of ``axis_name`` (it sets
     the ring-step count; ``lax.axis_index`` is traced so it cannot).
+
+    ``block_impl`` picks the per-block attention: ``"einsum"`` (XLA,
+    the default) or ``"flash"`` — each ring step runs the Pallas
+    flash kernel on its local block and the per-block (out, lse)
+    pairs are merged exactly (SP × kernel composition). Both are
+    differentiable (the flash VJP carries lse cotangents).
     """
+    if block_impl == "flash":
+        return _ring_flash(
+            q, k, v, mask, axis_name=axis_name, axis_size=axis_size,
+            causal=causal, scale=scale,
+        )
+    if block_impl != "einsum":
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     b, lb, h, d = q.shape
     scale = (1.0 / d**0.5) if scale is None else scale
     if mask is None:
@@ -129,6 +143,89 @@ def ring_attention(
     return (o / denom).astype(q.dtype)
 
 
+def _ring_flash(q, k, v, mask, *, axis_name, axis_size, causal, scale):
+    """Ring attention whose per-block computation is the Pallas flash
+    kernel: each step computes ``flash(q, k_block, v_block)`` with its
+    log-sum-exp, and blocks merge by the exact lse-weighted average
+
+        m = max(s1, s2); o = (o1·e^{s1-m} + o2·e^{s2-m}) / (e^{s1-m}+e^{s2-m})
+
+    Causal structure is whole-block: a K/V block strictly in the past
+    attends fully (plain flash), the diagonal block runs causal flash
+    (positions align — both offsets are ``my_idx·Lb``), and future
+    blocks are skipped via an lse of -inf-like ``NEG`` so they carry
+    zero merge weight. ``lax.switch`` on the traced block origin keeps
+    it one compiled program.
+    """
+    from mlapi_tpu.ops.pallas import flash_attention_with_lse
+
+    b, lb, h, d = q.shape
+    if mask is None:
+        mask = jnp.ones((b, lb), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    interpret = jax.default_backend() != "tpu"
+    flash = functools.partial(
+        flash_attention_with_lse, scale=scale, interpret=interpret
+    )
+
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def block(src, kb, vb, maskb):
+        """(out, lse) of q against one K/V block."""
+        if not causal:
+            return flash(q, kb, vb, maskb)
+
+        def past(args):
+            kb, vb, maskb = args
+            return flash(q, kb, vb, maskb)
+
+        def diag(args):
+            kb, vb, maskb = args
+            return flash(q, kb, vb, maskb, causal=True)
+
+        def future(args):
+            return (
+                jnp.zeros((b, lb, h, d), q.dtype),
+                jnp.full((b, h, lb), NEG, jnp.float32),
+            )
+
+        # sign(src - my_idx): -1 past, 0 diagonal, +1 future.
+        return jax.lax.switch(
+            jnp.sign(src - my_idx) + 1, [past, diag, future], (kb, vb, maskb)
+        )
+
+    def merge(o1, s1, o2, s2):
+        m = jnp.maximum(s1, s2)
+        w1 = jnp.exp(s1 - m)
+        w2 = jnp.exp(s2 - m)
+        wsum = jnp.maximum(w1 + w2, 1e-30)
+        w1t = (w1 / wsum).transpose(0, 2, 1)[..., None]  # [B,Lb,H,1]
+        w2t = (w2 / wsum).transpose(0, 2, 1)[..., None]
+        o = o1.astype(jnp.float32) * w1t + o2.astype(jnp.float32) * w2t
+        return o.astype(o1.dtype), m + jnp.log(wsum)
+
+    def varying(x):
+        return jax.lax.pcast(x, tuple(jax.typeof(q).vma), to="varying")
+
+    o_acc, lse_acc = block(my_idx, k, v, mask)
+    o_acc, lse_acc = varying(o_acc), varying(lse_acc)
+
+    def body(t, carry):
+        o_acc, lse_acc, kb, vb, maskb = carry
+        kb, vb, maskb = jax.lax.ppermute(
+            (kb, vb, maskb), axis_name, perm=perm
+        )
+        o_b, lse_b = block((my_idx - t) % axis_size, kb, vb, maskb)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_b, lse_b)
+        return o_acc, lse_acc, kb, vb, maskb
+
+    o_acc, *_ = jax.lax.fori_loop(
+        1, axis_size, body, (o_acc, lse_acc, k, v, mask)
+    )
+    return o_acc.astype(q.dtype)
+
+
 def ring_self_attention(
     mesh,
     q,
@@ -141,6 +238,7 @@ def ring_self_attention(
     head_axis: str | None = None,
     causal: bool = False,
     scale=None,
+    block_impl: str = "einsum",
 ):
     """Ring attention over globally-shaped ``[B, L, H, D]`` arrays.
 
@@ -175,6 +273,7 @@ def ring_self_attention(
         axis_size=n,
         causal=causal,
         scale=scale,
+        block_impl=block_impl,
     )
     mapped = jax.shard_map(
         inner,

@@ -45,10 +45,19 @@ class SpecLayout:
 
     # --- embeddings ------------------------------------------------------
     def embedding_rows(self) -> P:
-        """[vocab, dim] table sharded over vocab rows — the Criteo
-        layout: each chip owns a shard of the hash space and lookups
-        become an XLA gather + all-to-all."""
+        """[vocab, dim] table sharded over vocab rows — each chip owns
+        a shard of the vocab/hash space and lookups become an XLA
+        gather + all-to-all."""
         return P(self.model_axis, None)
+
+    def embedding_tables(self) -> P:
+        """[fields, vocab, dim] stacked tables (Criteo Wide&Deep):
+        sharded over the per-field vocab dim, fields replicated."""
+        return P(None, self.model_axis, None)
+
+    def bias_col(self) -> P:
+        """Bias of a column-parallel layer: sharded like its outputs."""
+        return P(self.model_axis)
 
     # --- attention -------------------------------------------------------
     def attn_qkv(self) -> P:

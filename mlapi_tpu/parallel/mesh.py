@@ -68,11 +68,15 @@ def place_params(params, mesh: Mesh, spec_tree=None):
     return jax.tree.map(put, params, spec_tree)
 
 
-def params_for_model(model, params, mesh: Mesh):
+def params_for_model(model, params, mesh: Mesh, layout=None):
     """Place ``params`` using the model's own layout when it has one
-    (``param_shardings``), else fully replicated."""
+    (``param_shardings``), else fully replicated.
+
+    ``layout`` (a ``SpecLayout``) renames the mesh axes consistently
+    across every model — pass it when the mesh doesn't use the default
+    ``data``/``model`` axis names."""
     spec_fn = getattr(model, "param_shardings", None)
-    return place_params(params, mesh, spec_fn() if spec_fn else None)
+    return place_params(params, mesh, spec_fn(layout) if spec_fn else None)
 
 
 def shard_batch_for_mesh(pytree, mesh: Mesh, axis: str = DATA_AXIS):

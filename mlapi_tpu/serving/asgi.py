@@ -161,12 +161,14 @@ class App:
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
         self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
         self.state: dict[str, Any] = {}
+        self._openapi_cache: dict | None = None
 
     # -- registration -----------------------------------------------------
     def route(self, method: str, path: str):
         def deco(fn: Handler) -> Handler:
             body_model = _find_body_model(fn)
             self._routes[(method.upper(), path)] = (fn, body_model)
+            self._openapi_cache = None
             return fn
 
         return deco
@@ -176,6 +178,104 @@ class App:
 
     def get(self, path: str):
         return self.route("GET", path)
+
+    # -- API schema (parity with FastAPI's free /docs + /openapi.json) ----
+    def openapi(self) -> dict:
+        """OpenAPI 3.1 document generated from the registered routes
+        and their pydantic body models — the reference got this for
+        free from ``FastAPI()`` (``main.py:8``); here it is derived
+        from the same route registry the dispatcher uses, so it can't
+        drift from actual behaviour."""
+        if self._openapi_cache is not None:
+            return self._openapi_cache
+        paths: dict[str, dict] = {}
+        schemas: dict[str, Any] = {}
+        for (method, path), (fn, body_model) in sorted(self._routes.items()):
+            if path in ("/openapi.json", "/docs"):
+                continue
+            doc = inspect.getdoc(fn) or ""
+            op: dict[str, Any] = {
+                "summary": doc.splitlines()[0] if doc else path,
+                "operationId": f"{method.lower()}_{fn.__name__}",
+                "responses": {
+                    "200": {
+                        "description": "Successful Response",
+                        "content": {"application/json": {"schema": {}}},
+                    }
+                },
+            }
+            if doc:
+                op["description"] = doc
+            if body_model is not None:
+                schema = body_model.model_json_schema(
+                    ref_template="#/components/schemas/{model}"
+                )
+                schemas.update(schema.pop("$defs", {}))
+                name = schema.get("title", body_model.__name__)
+                schemas[name] = schema
+                op["requestBody"] = {
+                    "required": True,
+                    "content": {
+                        "application/json": {
+                            "schema": {
+                                "$ref": f"#/components/schemas/{name}"
+                            }
+                        }
+                    },
+                }
+                op["responses"]["422"] = {
+                    "description": "Validation Error",
+                    "content": {
+                        "application/json": {
+                            "schema": {
+                                "$ref":
+                                    "#/components/schemas/ValidationError"
+                            }
+                        }
+                    },
+                }
+            extra = getattr(fn, "__openapi__", None)
+            if extra:
+                op.update(extra)
+            paths.setdefault(path, {})[method.lower()] = op
+        if any(
+            "422" in op.get("responses", {})
+            for ops in paths.values()
+            for op in ops.values()
+        ):
+            schemas["ValidationError"] = {
+                "title": "ValidationError",
+                "type": "object",
+                "properties": {
+                    "detail": {"title": "Detail", "type": "array",
+                               "items": {"type": "object"}}
+                },
+            }
+        from mlapi_tpu import __version__
+
+        self._openapi_cache = {
+            "openapi": "3.1.0",
+            "info": {"title": self.title, "version": __version__},
+            "paths": paths,
+            "components": {"schemas": schemas},
+        }
+        return self._openapi_cache
+
+    def install_docs(self) -> None:
+        """Register ``GET /openapi.json`` and ``GET /docs`` (a
+        self-contained HTML API browser — no CDN assets, the serving
+        environment is air-gapped)."""
+
+        @self.get("/openapi.json")
+        async def openapi_json():
+            return self.openapi()
+
+        @self.get("/docs")
+        async def docs():
+            return Response(
+                _DOCS_HTML.replace("__TITLE__", self.title).encode(),
+                content_type="text/html; charset=utf-8",
+            )
 
     def middleware(self, fn: Middleware) -> Middleware:
         self._middleware.append(fn)
@@ -361,3 +461,59 @@ def _body_param_name(fn: Handler) -> str:
 
 def _wants_request(fn: Handler) -> bool:
     return "request" in inspect.signature(fn).parameters
+
+
+# Self-contained API browser: fetches /openapi.json client-side and
+# renders endpoints + schemas. No external assets (air-gapped parity
+# with FastAPI's CDN-backed Swagger page).
+_DOCS_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>__TITLE__ — API docs</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem auto;max-width:56rem;
+      padding:0 1rem;color:#1a1a1a;background:#fafafa}
+ h1{font-size:1.4rem} h2{font-size:1.05rem;margin:0}
+ .ep{border:1px solid #ddd;border-radius:8px;margin:0.8rem 0;
+     background:#fff;overflow:hidden}
+ .hd{display:flex;gap:0.8rem;align-items:center;padding:0.6rem 0.9rem;
+     cursor:pointer}
+ .m{font-weight:700;font-size:0.8rem;padding:0.15rem 0.55rem;
+    border-radius:5px;color:#fff;min-width:3.2rem;text-align:center}
+ .POST{background:#2d7d46}.GET{background:#1d6fb8}
+ .path{font-family:ui-monospace,monospace;font-size:0.95rem}
+ .sum{color:#666;font-size:0.85rem;margin-left:auto}
+ .bd{display:none;padding:0.7rem 0.9rem;border-top:1px solid #eee}
+ .ep.open .bd{display:block}
+ pre{background:#f4f4f4;border-radius:6px;padding:0.7rem;
+     font-size:0.8rem;overflow-x:auto}
+ .lbl{font-size:0.75rem;text-transform:uppercase;letter-spacing:0.05em;
+      color:#888;margin:0.6rem 0 0.2rem}
+ .desc{white-space:pre-wrap;color:#444;font-size:0.85rem}
+</style></head><body>
+<h1>__TITLE__ <span style="color:#aaa;font-weight:400">API</span></h1>
+<p>Schema: <a href="/openapi.json">/openapi.json</a></p>
+<div id="eps">loading…</div>
+<script>
+const deref=(s,root)=>{ if(s&&s.$ref){const n=s.$ref.split('/').pop();
+  return root.components.schemas[n]||s;} return s; };
+fetch('/openapi.json').then(r=>r.json()).then(doc=>{
+  const eps=document.getElementById('eps'); eps.innerHTML='';
+  for(const [path,ops] of Object.entries(doc.paths)){
+    for(const [method,op] of Object.entries(ops)){
+      const d=document.createElement('div'); d.className='ep';
+      let body='';
+      const rb=op.requestBody?.content?.['application/json']?.schema;
+      if(rb){body+='<div class="lbl">request body</div><pre>'+
+        JSON.stringify(deref(rb,doc),null,2)+'</pre>';}
+      d.innerHTML='<div class="hd"><span class="m '+method.toUpperCase()+
+        '">'+method.toUpperCase()+'</span><span class="path">'+path+
+        '</span><span class="sum">'+(op.summary||'')+'</span></div>'+
+        '<div class="bd">'+(op.description?
+        '<div class="desc">'+op.description+'</div>':'')+body+
+        '<div class="lbl">responses</div><pre>'+
+        JSON.stringify(op.responses,null,2)+'</pre></div>';
+      d.querySelector('.hd').onclick=()=>d.classList.toggle('open');
+      eps.appendChild(d);
+    }
+  }
+});
+</script></body></html>"""

@@ -198,3 +198,40 @@ async def test_array_schema_for_unnamed_features(tmp_path):
             assert "expected 16 features" in detail[0]["msg"]
     finally:
         await app.shutdown()
+
+
+async def test_openapi_and_docs(iris_checkpoint):
+    """Parity with FastAPI's free schema surface (reference main.py:8):
+    /openapi.json describes the real routes + body models, /docs is a
+    self-contained HTML page (no CDN — air-gapped)."""
+    iris_engine = InferenceEngine.from_checkpoint(iris_checkpoint)
+    app = build_app(iris_engine)
+    transport = httpx.ASGITransport(app=app)
+    async with httpx.AsyncClient(
+        transport=transport, base_url="http://test"
+    ) as client:
+        r = await client.get("/openapi.json")
+        assert r.status_code == 200
+        doc = r.json()
+        assert doc["openapi"].startswith("3.")
+        assert "/predict" in doc["paths"]
+        post = doc["paths"]["/predict"]["post"]
+        ref = post["requestBody"]["content"]["application/json"]["schema"]
+        name = ref["$ref"].rsplit("/", 1)[1]
+        schema = doc["components"]["schemas"][name]
+        # The Iris feature schema is fully described: 4 required floats.
+        assert set(schema["required"]) == set(iris_engine.feature_names)
+        assert all(
+            schema["properties"][f]["type"] == "number"
+            for f in iris_engine.feature_names
+        )
+        assert "422" in post["responses"]
+        # Multipart route documented via the explicit form contract.
+        files_op = doc["paths"]["/files/"]["post"]
+        assert "multipart/form-data" in files_op["requestBody"]["content"]
+        # Docs page: self-contained HTML that references the schema.
+        d = await client.get("/docs")
+        assert d.status_code == 200
+        assert d.headers["content-type"].startswith("text/html")
+        assert "/openapi.json" in d.text
+        assert "http://" not in d.text.replace("http://test", "")  # no CDN
