@@ -117,6 +117,51 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-30))
 
 
+def _jnp_flash(q, k, v, mask, causal, scale):
+    """Pure-jnp (out, lse) with the kernel's exact conventions —
+    identical masking/NEG/lse semantics, differentiable by plain
+    autodiff (the lse cotangent flows through ``jnp.log``).
+
+    Exists because the Pallas HLO *interpreter* cannot run inside a
+    vma-checked ``shard_map`` (jax 0.9: its internal block slicing
+    mixes the interpreter's unvarying loop indices with varying
+    operands — ``dynamic_slice requires varying manual axes to
+    match``). On CPU tests of the ring x flash composition this path
+    carries the math; the kernels themselves are interpreter-tested
+    outside shard_map (tests/test_flash_attention.py), and on TPU the
+    real kernels run everywhere, shard_map included.
+    """
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+    keep = mask.astype(jnp.float32)[:, None, None, :]
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        tri = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        keep = keep * tri[None, None]
+    s = s + (1.0 - keep) * _NEG
+    m = jnp.max(s, axis=-1)                      # [B,H,Lq]
+    p = jnp.exp(s - m[..., None]) * keep
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    out = (o / denom).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out, lse
+
+
+def _inside_vma_shard_map(x):
+    """True when tracing inside a vma-checked shard_map (the aval
+    carries varying-manual-axes) — static at trace time."""
+    return bool(getattr(jax.typeof(x), "vma", None))
+
+
 def _out_struct(shape, dtype, like):
     # Inside shard_map, pallas_call outputs must declare which mesh
     # axes they vary over (vma); mirror the query operand's type so
@@ -440,6 +485,9 @@ def flash_attention(
         )
     if mask is None:
         mask = jnp.ones((b, l), jnp.float32)
+    if interpret and _inside_vma_shard_map(q):
+        out, _ = _jnp_flash(q, k, v, mask, causal, scale)
+        return out
     out, _ = _flash(
         q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
         interpret,
@@ -479,6 +527,8 @@ def flash_attention_with_lse(
         )
     if mask is None:
         mask = jnp.ones((b, l), jnp.float32)
+    if interpret and _inside_vma_shard_map(q):
+        return _jnp_flash(q, k, v, mask, causal, scale)
     return _flash(
         q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
         interpret,

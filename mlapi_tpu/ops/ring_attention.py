@@ -33,6 +33,22 @@ from jax.sharding import PartitionSpec as P
 from mlapi_tpu.ops.attention import NEG
 
 
+def _varying_like(x, like):
+    """Cast ``x`` to carry ``like``'s varying-manual-axes (vma) type.
+
+    Constants minted inside shard_map are "unvarying"; mixing them
+    with varying values in loop carries / lax.switch branches is a
+    type mismatch in jax 0.9's vma checker. ``lax.pcast`` refuses
+    axes a value already varies over, so cast only the missing ones.
+    """
+    want = getattr(jax.typeof(like), "vma", None) or frozenset()
+    have = getattr(jax.typeof(x), "vma", None) or frozenset()
+    missing = tuple(a for a in want if a not in have)
+    if not missing:
+        return x
+    return jax.lax.pcast(x, missing, to="varying")
+
+
 def ring_attention(
     q,
     k,
@@ -115,7 +131,7 @@ def ring_attention(
     # tracks which mesh axes a value varies over inside shard_map;
     # fresh zeros are "unvarying" and would mismatch the loop carry).
     def varying(x):
-        return jax.lax.pcast(x, tuple(jax.typeof(q).vma), to="varying")
+        return _varying_like(x, q)
 
     # Block 0 (our own K/V) outside the loop, then rotate-and-fold
     # axis_size-1 times — permute first, so no rotation result is ever
@@ -160,8 +176,16 @@ def _ring_flash(q, k, v, mask, *, axis_name, axis_size, causal, scale):
     from mlapi_tpu.ops.pallas import flash_attention_with_lse
 
     b, lb, h, d = q.shape
+
+    # Everything entering flash / the lax.switch must carry q's
+    # varying-manual-axes type: constants minted inside shard_map
+    # (the default mask, the future-branch zeros) are "unvarying"
+    # and would mismatch varying branch outputs / kernel operands.
+    def varying(x):
+        return _varying_like(x, q)
+
     if mask is None:
-        mask = jnp.ones((b, lb), jnp.float32)
+        mask = varying(jnp.ones((b, lb), jnp.float32))
     mask = mask.astype(jnp.float32)
     interpret = jax.default_backend() != "tpu"
     flash = functools.partial(
@@ -186,8 +210,8 @@ def _ring_flash(q, k, v, mask, *, axis_name, axis_size, causal, scale):
 
         def future(args):
             return (
-                jnp.zeros((b, lb, h, d), q.dtype),
-                jnp.full((b, h, lb), NEG, jnp.float32),
+                varying(jnp.zeros((b, lb, h, d), q.dtype)),
+                varying(jnp.full((b, h, lb), NEG, jnp.float32)),
             )
 
         # sign(src - my_idx): -1 past, 0 diagonal, +1 future.
@@ -204,9 +228,6 @@ def _ring_flash(q, k, v, mask, *, axis_name, axis_size, causal, scale):
         w2t = (w2 / wsum).transpose(0, 2, 1)[..., None]
         o = o1.astype(jnp.float32) * w1t + o2.astype(jnp.float32) * w2t
         return o.astype(o1.dtype), m + jnp.log(wsum)
-
-    def varying(x):
-        return jax.lax.pcast(x, tuple(jax.typeof(q).vma), to="varying")
 
     o_acc, lse_acc = block(my_idx, k, v, mask)
     o_acc, lse_acc = varying(o_acc), varying(lse_acc)

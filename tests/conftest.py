@@ -14,12 +14,21 @@ import os
 
 # Force CPU regardless of ambient JAX_PLATFORMS (the dev box tunnels a
 # real TPU chip; unit tests must not depend on it — bench.py does).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Set MLAPI_TPU_TESTS=1 to run on the attached TPU instead — this is
+# how the ``requires_tpu``-marked tests execute for real:
+#   MLAPI_TPU_TESTS=1 pytest tests/ -m requires_tpu
+_ON_TPU = os.environ.get("MLAPI_TPU_TESTS") == "1"
+# Generation warmup compiles (bucket x batch) shape grids — right for
+# serving, wasteful for unit tests. Tests that specifically exercise
+# the full warmup opt back in with warmup(full=True).
+os.environ.setdefault("MLAPI_TPU_WARMUP", "minimal")
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -27,7 +36,8 @@ import pytest  # noqa: E402
 # The dev image's sitecustomize registers the TPU plugin and overwrites
 # the jax_platforms *config* (which beats the env var). Backends are
 # lazy, so re-pinning the config here — before any computation — wins.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
