@@ -36,7 +36,7 @@ from mlapi_tpu.serving.asgi import (
     StreamingResponse,
     json_response,
 )
-from mlapi_tpu.serving.batcher import MicroBatcher
+from mlapi_tpu.serving.batcher import MicroBatcher, OverloadedError
 from mlapi_tpu.serving.engine import InferenceEngine
 from mlapi_tpu.utils.logging import get_logger
 from mlapi_tpu.utils.metrics import MetricsRegistry
@@ -44,6 +44,17 @@ from mlapi_tpu.utils.metrics import MetricsRegistry
 _log = get_logger("serving.app")
 
 MAX_ECHO_RECORDS = 1000
+
+
+def _overloaded_http(e: OverloadedError) -> HTTPError:
+    """Overload → immediate 503 with a Retry-After hint. Shedding at
+    the door keeps latency bounded for the requests that ARE admitted;
+    clients with backoff recover on their own."""
+    return HTTPError(
+        503,
+        str(e),
+        headers={"retry-after": str(int(max(1, e.retry_after_s)))},
+    )
 
 
 def feature_schema(feature_names) -> type[pydantic.BaseModel]:
@@ -64,6 +75,7 @@ def build_app(
     *,
     max_batch: int | None = None,
     max_wait_ms: float = 0.2,
+    max_queue: int | None = None,
     registry: MetricsRegistry | None = None,
 ) -> App:
     app = App(title="mlapi-tpu")
@@ -73,10 +85,17 @@ def build_app(
 
     if engine.kind == "generative":
         batcher = None
+        # The generative engine owns its queue/batch limits; the
+        # app-level knobs apply to it too (engine defaults when None).
+        if max_queue is not None:
+            engine.max_queue = max_queue
+        if max_batch is not None:
+            engine.max_batch = min(max_batch, engine.max_batch)
         _install_generate(app, engine)
     else:
         batcher = MicroBatcher(
-            engine, max_batch=max_batch, max_wait_ms=max_wait_ms
+            engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            **({"max_queue": max_queue} if max_queue is not None else {}),
         )
         app.state["batcher"] = batcher
         _install_predict(app, engine, batcher)
@@ -141,7 +160,10 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
                     }
                 ],
             )
-        label, prob = await batcher.submit(row)
+        try:
+            label, prob = await batcher.submit(row)
+        except OverloadedError as e:
+            raise _overloaded_http(e) from None
         # Hot path: hand-assembled JSON from the per-label pre-escaped
         # bytes — skips json.dumps (with its default-fn machinery) on
         # every request. %.10g is plenty for a softmax probability.
@@ -200,48 +222,65 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
-        gen = await engine.submit(
-            req.text,
-            max_new_tokens=n_new,
-            temperature=req.temperature,
-            seed=req.seed,
-        )
+        try:
+            gen = await engine.submit(
+                req.text,
+                max_new_tokens=n_new,
+                temperature=req.temperature,
+                seed=req.seed,
+            )
+        except OverloadedError as e:
+            raise _overloaded_http(e) from None
 
         if req.stream:
             async def ndjson():
                 ids: list[int] = []
-                while True:
-                    item = await gen.queue.get()
-                    if isinstance(item, Exception):
-                        yield json.dumps(
-                            {"error": str(item)}
-                        ).encode() + b"\n"
-                        return
-                    if item is None:
-                        yield json.dumps(
-                            {
-                                "done": True,
-                                "text": engine.tokenizer.decode(ids),
-                                "token_ids": ids,
-                                "prompt_tokens": gen.used,
-                            }
-                        ).encode() + b"\n"
-                        return
-                    ids.extend(item["token_ids"])
-                    yield json.dumps(item).encode() + b"\n"
+                finished = False
+                try:
+                    while True:
+                        item = await gen.queue.get()
+                        if isinstance(item, Exception):
+                            finished = True
+                            yield json.dumps(
+                                {"error": str(item)}
+                            ).encode() + b"\n"
+                            return
+                        if item is None:
+                            finished = True
+                            yield json.dumps(
+                                {
+                                    "done": True,
+                                    "text": engine.tokenizer.decode(ids),
+                                    "token_ids": ids,
+                                    "prompt_tokens": gen.used,
+                                }
+                            ).encode() + b"\n"
+                            return
+                        ids.extend(item["token_ids"])
+                        yield json.dumps(item).encode() + b"\n"
+                finally:
+                    # Generator closed early (client disconnect →
+                    # server acloses the body iterator): stop the
+                    # decode loop spending device time on this row.
+                    if not finished:
+                        gen.cancel()
 
             return StreamingResponse(
                 ndjson(), content_type="application/x-ndjson"
             )
 
         ids: list[int] = []
-        while True:
-            item = await gen.queue.get()
-            if isinstance(item, Exception):
-                raise item
-            if item is None:
-                break
-            ids.extend(item["token_ids"])
+        try:
+            while True:
+                item = await gen.queue.get()
+                if isinstance(item, Exception):
+                    raise item
+                if item is None:
+                    break
+                ids.extend(item["token_ids"])
+        except asyncio.CancelledError:
+            gen.cancel()  # non-stream handler torn down mid-decode
+            raise
         return {
             "text": engine.tokenizer.decode(ids),
             "token_ids": ids,
@@ -309,6 +348,16 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             async for chunk in it:
                 yield chunk
         finally:
+            # Being closed early (client disconnect) must close the
+            # WRAPPED iterator too — `async for` does not aclose its
+            # source on abnormal exit (PEP 525), and the inner
+            # generator's finally is what cancels the decode work.
+            aclose = getattr(it, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
             key = (request.method, request.path)
             if key not in app._routes:
                 key = None
@@ -386,10 +435,24 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
         if batcher is not None:
             snap["counters"]["batcher.device_calls"] = batcher.device_calls
             snap["counters"]["batcher.requests"] = batcher.requests
+            snap["counters"]["batcher.timeouts"] = batcher.timeouts
+            snap["counters"]["batcher.rejected"] = batcher.rejected
+            # Gauges: the overload early-warning signals — queue depth
+            # and in-flight batches are the first things to move when
+            # offered load exceeds capacity.
+            snap.setdefault("gauges", {})
+            snap["gauges"]["batcher.queue_depth"] = batcher.queue_depth
+            snap["gauges"]["batcher.inflight"] = batcher.inflight
         elif engine.kind == "generative":
             snap["counters"]["generate.requests"] = engine.requests
             snap["counters"]["generate.batch_calls"] = engine.batch_calls
             snap["counters"]["generate.chunk_calls"] = engine.chunk_calls
+            snap["counters"]["generate.rejected"] = engine.rejected
+            snap["counters"]["generate.cancelled_batches"] = (
+                engine.cancelled_batches
+            )
+            snap.setdefault("gauges", {})
+            snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap
 
     return app

@@ -23,6 +23,7 @@ unchanged if that were installed.
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import json
 import traceback
@@ -42,12 +43,18 @@ _log = get_logger("serving.asgi")
 
 
 class HTTPError(Exception):
-    """Raise from a handler to produce a clean JSON error response."""
+    """Raise from a handler to produce a clean JSON error response.
 
-    def __init__(self, status: int, detail: Any):
+    ``headers`` ride along onto the response — e.g. ``Retry-After``
+    on a 503 from the overload-shedding path."""
+
+    def __init__(
+        self, status: int, detail: Any, headers: dict[str, str] | None = None
+    ):
         super().__init__(detail)
         self.status = status
         self.detail = detail
+        self.headers = headers
 
 
 class Request:
@@ -130,11 +137,14 @@ class StreamingResponse(Response):
         self.body_iter = body_iter
 
 
-def json_response(obj: Any, status: int = 200) -> Response:
+def json_response(
+    obj: Any, status: int = 200, headers: dict[str, str] | None = None
+) -> Response:
     return Response(
         json.dumps(obj, separators=(",", ":"), default=_json_default).encode(),
         status=status,
         content_type="application/json",
+        headers=headers,
     )
 
 
@@ -339,7 +349,7 @@ class App:
         try:
             return await call(request)
         except HTTPError as e:
-            return json_response({"detail": e.detail}, e.status)
+            return json_response({"detail": e.detail}, e.status, e.headers)
         except Exception:
             _log.error("unhandled error on %s %s\n%s", request.method,
                         request.path, traceback.format_exc())
@@ -390,12 +400,40 @@ class App:
                                 "more_body": True,
                             }
                         )
+            except (Exception, asyncio.CancelledError) as e:
+                # CancelledError included on purpose: a disconnecting
+                # client surfaces as ConnectionResetError under the
+                # framework server but as task cancellation under ASGI
+                # test transports — both must run the iterator's
+                # finally NOW (it cancels the decode work feeding this
+                # stream) instead of whenever GC gets to the suspended
+                # generator. GeneratorExit/SystemExit stay untouched —
+                # swallowing those and awaiting again is a RuntimeError.
+                if isinstance(e, (ConnectionResetError, BrokenPipeError)):
+                    # Routine: a client walking away from its stream is
+                    # the event the cancellation path exists for, not
+                    # an error worth a traceback.
+                    _log.info(
+                        "client disconnected mid-stream on %s %s",
+                        scope.get("method"), scope.get("path"),
+                    )
+                elif not isinstance(e, asyncio.CancelledError):
+                    _log.error(
+                        "stream aborted on %s %s\n%s", scope.get("method"),
+                        scope.get("path"), traceback.format_exc(),
+                    )
+                aclose = getattr(response.body_iter, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:
+                        pass
+                if isinstance(e, asyncio.CancelledError):
+                    raise
+            try:
+                await send({"type": "http.response.body", "body": b""})
             except Exception:
-                _log.error(
-                    "stream aborted on %s %s\n%s", scope.get("method"),
-                    scope.get("path"), traceback.format_exc(),
-                )
-            await send({"type": "http.response.body", "body": b""})
+                pass  # client is gone; nothing left to tell it
             return
         await send({"type": "http.response.body", "body": response.body})
 

@@ -33,6 +33,17 @@ from mlapi_tpu.utils.logging import get_logger
 _log = get_logger("serving.batcher")
 
 
+class OverloadedError(Exception):
+    """The serving queue is full: shed the request NOW (503 +
+    ``Retry-After``) instead of parking it on an ever-growing queue
+    where it would time out after adding to the overload. Raised by
+    both engines' ``submit``; the app converts it to HTTP."""
+
+    def __init__(self, what: str, retry_after_s: float = 1.0):
+        super().__init__(f"{what} queue full")
+        self.retry_after_s = retry_after_s
+
+
 class MicroBatcher:
     """Coalesces single-row predict requests into batched engine calls."""
 
@@ -59,6 +70,12 @@ class MicroBatcher:
         self.device_calls = 0
         self.requests = 0
         self.timeouts = 0
+        self.rejected = 0
+        self.inflight = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
 
     async def start(self) -> None:
         if self._task is None:
@@ -86,11 +103,20 @@ class MicroBatcher:
                 fut.set_exception(RuntimeError("batcher stopped"))
 
     async def submit(self, row: np.ndarray) -> tuple[str, float]:
-        """Queue one feature row; resolves to (label, probability)."""
+        """Queue one feature row; resolves to (label, probability).
+
+        Raises :class:`OverloadedError` immediately when the queue is
+        full — under overload, fast-fail beats queueing: a blocked
+        ``put`` here would grow latency without bound while every
+        queued request eventually times out anyway."""
         if self._task is None:
             raise RuntimeError("batcher not started")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((np.asarray(row, np.float32), fut))
+        try:
+            self._queue.put_nowait((np.asarray(row, np.float32), fut))
+        except asyncio.QueueFull:
+            self.rejected += 1
+            raise OverloadedError("predict") from None
         self.requests += 1
         return await fut
 
@@ -120,6 +146,7 @@ class MicroBatcher:
             # max_inflight device round trips overlap, while this loop
             # goes straight back to collecting the next batch.
             await self._inflight.acquire()
+            self.inflight += 1
             work = self._dispatch_thread(loop, batch)
             resolver = asyncio.create_task(self._resolve(work, futures))
             self._resolvers.add(resolver)
@@ -184,6 +211,7 @@ class MicroBatcher:
                     f.set_exception(e)
             return
         finally:
+            self.inflight -= 1
             self._inflight.release()
         for f, label, prob in zip(futures, labels, probs):
             if not f.done():

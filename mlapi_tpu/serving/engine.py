@@ -297,6 +297,7 @@ class GenRequest:
 
     __slots__ = (
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
+        "cancelled",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop):
@@ -307,10 +308,17 @@ class GenRequest:
         self.seed = seed
         self.loop = loop
         self.queue: asyncio.Queue = asyncio.Queue()
+        self.cancelled = False    # set when the consumer disconnects
 
     def push(self, item) -> None:
         """Thread-safe enqueue from the decode thread."""
         self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
+
+    def cancel(self) -> None:
+        """Consumer is gone: tell the decode loop to stop spending
+        device time on this row (a plain bool — read cross-thread,
+        worst case one extra chunk decodes)."""
+        self.cancelled = True
 
 
 class _SyncSink:
@@ -323,6 +331,7 @@ class _SyncSink:
         self.temperature, self.seed = req.temperature, req.seed
         self._out = out_ids
         self.error: Exception | None = None
+        self.cancelled = False
 
     def push(self, item) -> None:
         if isinstance(item, Exception):
@@ -370,6 +379,7 @@ class TextGenerationEngine:
         max_batch: int = 8,
         chunk: int = 8,
         max_wait_ms: float = 2.0,
+        max_queue: int = 256,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -387,6 +397,7 @@ class TextGenerationEngine:
         self.max_batch = int(max_batch)
         self.chunk = max(1, int(chunk))
         self.max_wait_s = max_wait_ms / 1e3
+        self.max_queue = int(max_queue)
         if mesh is not None:
             from mlapi_tpu.parallel import params_for_model
 
@@ -401,6 +412,12 @@ class TextGenerationEngine:
         self.requests = 0
         self.batch_calls = 0
         self.chunk_calls = 0
+        self.rejected = 0
+        self.cancelled_batches = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize() if self._queue is not None else 0
 
     # Shared surface with the classification engines (healthz, app).
     @property
@@ -415,12 +432,19 @@ class TextGenerationEngine:
         return self.prompt_buckets[min(i, len(self.prompt_buckets) - 1)]
 
     def _cache_len(self, bucket: int, n_new: int) -> int:
-        """Static KV-cache length for a batch: prompt bucket + new
-        tokens rounded up to a chunk multiple (so one cache shape
-        serves a range of ``max_new_tokens``), clamped to the model's
-        window."""
-        rounded = -(-n_new // self.chunk) * self.chunk
-        return min(self.model.max_positions, bucket + rounded)
+        """Static KV-cache length for a batch, quantized so the
+        program count stays logarithmic: new-token room is at least
+        the default (every ``n_new <= default`` request shares ONE
+        warmed shape) and beyond that rounds up to power-of-two
+        multiples of ``chunk``; clamped to the model's window. A
+        slightly roomier cache costs a few KB of HBM and zero decode
+        steps (the loop stops at the requested token count) — compile
+        ambushes on the request path cost p99."""
+        want = max(n_new, self.default_max_new_tokens)
+        tier = self.chunk
+        while tier < want:
+            tier *= 2
+        return min(self.model.max_positions, bucket + tier)
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop) -> GenRequest:
@@ -457,19 +481,28 @@ class TextGenerationEngine:
             total = self._cache_len(bucket, n_new_max)
             n_new_max = min(n_new_max, total - bucket)
             b = len(reqs)
+            # Pad the BATCH dimension to a power of two: programs are
+            # keyed on batch size, so without padding every distinct
+            # concurrency level compiles its own prefill+decode. Dummy
+            # rows are a 1-token pad prompt (masked out like any pad).
+            b_pad = 1
+            while b_pad < b:
+                b_pad *= 2
 
-            prompt = np.full((b, bucket), self.tokenizer.pad_id, np.int32)
-            n_pad = np.zeros((b,), np.int32)
-            temps = np.zeros((b,), np.float32)
+            prompt = np.full((b_pad, bucket), self.tokenizer.pad_id, np.int32)
+            n_pad = np.full((b_pad,), max(bucket - 1, 0), np.int32)
+            temps = np.zeros((b_pad,), np.float32)
             for i, r in enumerate(reqs):
                 prompt[i, bucket - len(r.row):] = r.row
                 n_pad[i] = bucket - r.used
                 temps[i] = r.temperature
+            zero_key = np.asarray(jax.random.key_data(jax.random.key(0)))
             key_data = np.stack(
                 [
                     np.asarray(jax.random.key_data(jax.random.key(r.seed)))
                     for r in reqs
                 ]
+                + [zero_key] * (b_pad - b)
             )
 
             first, cache = prefill_fn(self.model, total)(
@@ -479,10 +512,12 @@ class TextGenerationEngine:
             tok = first
             first_host = np.asarray(first)
             produced = 1
+            done = [False] * b
             for i, r in enumerate(reqs):
                 r.push({"token_ids": [int(first_host[i])]})
                 if r.n_new <= 1:
                     r.push(None)
+                    done[i] = True
 
             dc = decode_chunk_fn(self.model, self.chunk)
             n_pad_j, temps_j, keys_j = (
@@ -490,6 +525,14 @@ class TextGenerationEngine:
             )
             pos, step = bucket, 1
             while produced < n_new_max:
+                if all(
+                    done[i] or r.cancelled for i, r in enumerate(reqs)
+                ):
+                    # Every remaining consumer disconnected: stop
+                    # burning device time on abandoned work.
+                    if not all(done):
+                        self.cancelled_batches += 1
+                    break
                 self.chunk_calls += 1
                 toks, cache, tok = dc(
                     self.params, cache, tok, jnp.int32(pos),
@@ -498,6 +541,8 @@ class TextGenerationEngine:
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
                 for i, r in enumerate(reqs):
+                    if done[i] or r.cancelled:
+                        continue
                     want = r.n_new - produced
                     if want > 0:
                         r.push(
@@ -506,6 +551,7 @@ class TextGenerationEngine:
                         )
                         if want <= got:
                             r.push(None)
+                            done[i] = True
                 pos += got
                 step += got
                 produced += got
@@ -513,7 +559,9 @@ class TextGenerationEngine:
             # collector only batches window-compatible requests, so
             # this fires only if that invariant is ever broken — a
             # loud error beats a silently-truncated hang.
-            for r in reqs:
+            for i, r in enumerate(reqs):
+                if done[i] or r.cancelled:
+                    continue
                 if r.n_new > n_new_max:
                     _log.error(
                         "request truncated at %d/%d tokens (batch window "
@@ -535,7 +583,7 @@ class TextGenerationEngine:
     # -- asyncio batcher ---------------------------------------------------
     async def start(self) -> None:
         if self._task is None:
-            self._queue = asyncio.Queue()
+            self._queue = asyncio.Queue(maxsize=self.max_queue)
             self._task = asyncio.create_task(
                 self._collect_loop(), name="genbatcher"
             )
@@ -604,9 +652,16 @@ class TextGenerationEngine:
                 reqs = []
         finally:
             # Cancellation (stop()) or a collector crash must not
-            # strand waiters already popped off the queue.
+            # strand waiters — neither those already popped off the
+            # queue NOR those still queued (a handler awaiting
+            # ``gen.queue.get()`` on a queued request would otherwise
+            # hang forever after an unexpected collector death).
             err = RuntimeError("generation engine stopped")
-            for r in (*reqs, *carry):
+            queued = []
+            if self._queue is not None:
+                while not self._queue.empty():
+                    queued.append(self._queue.get_nowait())
+            for r in (*reqs, *carry, *queued):
                 try:
                     r.push(err)
                 except Exception:
@@ -639,8 +694,14 @@ class TextGenerationEngine:
             text, n_new, float(temperature), int(seed),
             asyncio.get_running_loop(),
         )
+        try:
+            self._queue.put_nowait(req)
+        except asyncio.QueueFull:
+            from mlapi_tpu.serving.batcher import OverloadedError
+
+            self.rejected += 1
+            raise OverloadedError("generate", retry_after_s=2.0) from None
         self.requests += 1
-        await self._queue.put(req)
         return req
 
     # -- synchronous single-shot (tests, bench, CLI) -----------------------
@@ -668,20 +729,58 @@ class TextGenerationEngine:
             "prompt_tokens": req.used,  # tokens that actually conditioned
         }
 
-    def warmup(self) -> None:
-        """Compile the hot programs off the request path: the default
-        (prompt-bucket, cache-length) prefill plus the shared
-        decode-chunk program. Other shape buckets still compile on
-        first use."""
-        bucket = self.prompt_buckets[0]
-        n_new = min(
-            self.default_max_new_tokens, self.model.max_positions - bucket
-        )
-        if n_new < 1:
-            n_new = max(1, self.model.max_positions // 2)
-        self.generate_text("", max_new_tokens=min(n_new, self.chunk + 1))
+    def warmup(self, *, full: bool | None = None) -> None:
+        """Compile every (prompt bucket × power-of-two batch) prefill
+        and decode program at the default-``max_new_tokens`` cache
+        tier, off the request path. Combined with batch padding
+        (``_run_batch``) and cache-tier quantization (``_cache_len``),
+        this means NO request with ``n_new <= default_max_new_tokens``
+        ever pays an XLA compile — the classification engine's
+        contract, honoured by generation too. Larger ``n_new`` tiers
+        (power-of-two chunk multiples, log-many) compile on first use.
+
+        ``full=False`` (or env ``MLAPI_TPU_WARMUP=minimal``, used by
+        the CPU test suite) warms only the smallest bucket at batch=1.
+        """
+        import os
+
+        if full is None:
+            full = os.environ.get("MLAPI_TPU_WARMUP", "full") != "minimal"
+        buckets = self.prompt_buckets if full else self.prompt_buckets[:1]
+        # Cover every shape _run_batch can produce: it pads the batch
+        # dim to the NEXT power of two, so for max_batch=6 the grid
+        # must include 8 (batches of 5-6 pad up past max_batch).
+        batches = [1]
+        while full and batches[-1] < self.max_batch:
+            batches.append(batches[-1] * 2)
+        shapes = 0
+        for bucket in buckets:
+            n_new = min(
+                self.default_max_new_tokens,
+                self.model.max_positions - bucket,
+            )
+            if n_new < 1:
+                continue
+            for bsz in batches:
+                sinks = []
+                for _ in range(bsz):
+                    row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
+                    # chunk+1 new tokens: compiles the same prefill
+                    # (cache tier is keyed on max(n_new, default) in
+                    # _cache_len) and the same decode-chunk program as
+                    # a full default-length request, at one decode
+                    # execution instead of default/chunk of them.
+                    req = GenRequest(
+                        row, 1, min(n_new, self.chunk + 1), 0.0, 0, None
+                    )
+                    sinks.append(_SyncSink(req, []))
+                self._run_batch(sinks)
+                if sinks[0].error is not None:
+                    raise sinks[0].error
+                shapes += 1
         _log.info(
-            "warmed generate: prompt_bucket=%d, chunk=%d", bucket, self.chunk
+            "warmed generate: %d (bucket x batch) shapes, chunk=%d",
+            shapes, self.chunk,
         )
 
 

@@ -26,8 +26,10 @@ MAX_BODY_BYTES = 256 * 1024 * 1024
 _STATUS_PHRASES = {
     200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
     408: "Request Timeout", 411: "Length Required", 413: "Payload Too Large",
-    422: "Unprocessable Entity", 431: "Request Header Fields Too Large",
+    422: "Unprocessable Entity", 429: "Too Many Requests",
+    431: "Request Header Fields Too Large",
     500: "Internal Server Error", 501: "Not Implemented",
+    503: "Service Unavailable",
 }
 
 
@@ -183,6 +185,12 @@ class Server:
                     writer.write(_head(b""))
             if not state["streaming"]:
                 return  # spurious extra message after a completed body
+            if writer.transport.is_closing():
+                # Client went away mid-stream. write() on a closing
+                # transport is silently dropped and drain() may not
+                # raise for buffered writes — fail loudly so the app
+                # can cancel the work feeding this stream.
+                raise ConnectionResetError("client disconnected mid-stream")
             if not chunked_ok:
                 if body:
                     writer.write(body)
