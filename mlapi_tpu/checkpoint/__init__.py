@@ -2,6 +2,7 @@
 
 from mlapi_tpu.checkpoint.io import (  # noqa: F401
     CheckpointMeta,
+    gc_checkpoints,
     latest_step,
     load_checkpoint,
     save_checkpoint,

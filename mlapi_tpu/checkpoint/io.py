@@ -142,21 +142,30 @@ def save_checkpoint(
     # checkpoint — so barrier, let only process 0 write it, then
     # barrier again so no process returns (and e.g. reads the path
     # back or reports success) until the manifest actually exists.
-    # Barrier keys carry the FULL path: two concurrent saves of
-    # same-named leaf dirs under different roots (e.g. step_100 in two
-    # experiment dirs) must not cross-match each other's barriers.
+    # Barrier keys must be HOST-INVARIANT: processes may mount the
+    # shared checkpoint filesystem at different points (or resolve
+    # through different symlinks), so the local resolved path cannot
+    # feed the key — each host would derive a different one and
+    # deadlock. The key is built from what every process agrees on:
+    # leaf dir name, step, config hash, and the param-tree signature.
+    # (Two *concurrent* saves of the same config+step into different
+    # roots would cross-match — a far narrower hazard than the
+    # mount-point mismatch, and one no sane launcher produces.)
     # Known limitation: if process 0 dies between the two barriers
     # (manifest write failure, disk full), the other processes block in
     # ckpt_post until the distributed runtime propagates the abort —
     # the same contract as any collective, and strictly safer than
     # returning success without a committed manifest.
     multi = _process_count() > 1
+    cfg_hash = _stable_hash(config)
+    tree_sig = tree_signature(params)
     if multi:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"ckpt_pre:{path}")
+        key = _stable_hash([path.name, int(step), cfg_hash, tree_sig])
+        multihost_utils.sync_global_devices(f"ckpt_pre:{key}")
         if _process_index() != 0:
-            multihost_utils.sync_global_devices(f"ckpt_post:{path}")
+            multihost_utils.sync_global_devices(f"ckpt_post:{key}")
             return path
 
     meta = CheckpointMeta(
@@ -165,8 +174,8 @@ def save_checkpoint(
         step=int(step),
         created_unix=time.time(),
         config=config,
-        config_hash=_stable_hash(config),
-        tree_signature=tree_signature(params),
+        config_hash=cfg_hash,
+        tree_signature=tree_sig,
         vocab=vocab,
     )
     # Manifest last, atomically: its presence is the commit marker.
@@ -174,7 +183,7 @@ def save_checkpoint(
     tmp.write_text(json.dumps(meta.to_json(), indent=2, sort_keys=True))
     tmp.rename(path / _MANIFEST)
     if multi:
-        multihost_utils.sync_global_devices(f"ckpt_post:{path}")
+        multihost_utils.sync_global_devices(f"ckpt_post:{key}")
     return path
 
 
@@ -250,3 +259,37 @@ def latest_step(root: str | os.PathLike) -> Path | None:
 
 def step_dir(root: str | os.PathLike, step: int) -> Path:
     return Path(root) / f"step_{step:08d}"
+
+
+def gc_checkpoints(root: str | os.PathLike, keep_last: int) -> list[Path]:
+    """Delete all but the newest ``keep_last`` COMMITTED ``step_*``
+    dirs under ``root``; returns the deleted paths.
+
+    Only committed checkpoints (manifest present) are touched: an
+    uncommitted dir might be a save in progress on another process —
+    its writer owns it, not the collector. Deletion de-commits first
+    (manifest unlinked before the tree is removed) so a crash
+    mid-delete can never leave a "committed" half-checkpoint behind;
+    multi-host callers run this on process 0 only (the same process
+    that owns manifest writes).
+    """
+    import shutil
+
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    root = Path(root)
+    if not root.exists():
+        return []
+    committed: list[tuple[int, Path]] = []
+    for child in root.iterdir():
+        if child.name.startswith("step_") and (child / _MANIFEST).exists():
+            try:
+                committed.append((int(child.name.removeprefix("step_")), child))
+            except ValueError:
+                continue
+    committed.sort()
+    doomed = [p for _, p in committed[:-keep_last]]
+    for p in doomed:
+        (p / _MANIFEST).unlink()
+        shutil.rmtree(p, ignore_errors=True)
+    return doomed

@@ -32,7 +32,8 @@ _MIX2 = np.uint64(0xBF58476D1CE4E5B9)
 
 def _hash_effect(ids: np.ndarray, feature: int) -> np.ndarray:
     """Stable pseudo-random effect in [-0.5, 0.5) for each (feature, id)."""
-    h = (ids.astype(np.uint64) + np.uint64(feature + 1) * _MIX1) * _MIX2
+    with np.errstate(over="ignore"):  # uint64 wraparound is the mixer
+        h = (ids.astype(np.uint64) + np.uint64(feature + 1) * _MIX1) * _MIX2
     h ^= h >> np.uint64(31)
     return (h % np.uint64(10_000)).astype(np.float32) / 10_000.0 - 0.5
 

@@ -54,6 +54,72 @@ def _demo_iris_checkpoint() -> str:
     return path
 
 
+def _watch_and_reexec(argv) -> int:
+    """Dev loop (the reference's ``uvicorn --reload``,
+    ``README.md:16``): run the server as a child process, poll the
+    package's ``.py`` mtimes, and restart the child on any change.
+    The child carries a marker env var so it serves instead of
+    watching."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import mlapi_tpu
+
+    root = os.path.dirname(os.path.abspath(mlapi_tpu.__file__))
+
+    def snapshot() -> dict:
+        mt = {}
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                if f.endswith(".py"):
+                    p = os.path.join(dirpath, f)
+                    try:
+                        mt[p] = os.stat(p).st_mtime
+                    except OSError:
+                        pass
+        return mt
+
+    env = dict(os.environ, MLAPI_TPU_RELOAD_CHILD="1")
+    cmd = [sys.executable, "-m", "mlapi_tpu.serving", *argv]
+    while True:
+        snap = snapshot()
+        child = subprocess.Popen(cmd, env=env)
+        restart = False
+        try:
+            while True:
+                time.sleep(0.5)
+                if child.poll() is not None:
+                    # A crashed child (e.g. a transient syntax error
+                    # mid-edit) must NOT end the watch — that's the
+                    # state a dev-reload loop exists to recover from.
+                    # Keep watching; the next change respawns it.
+                    _log.warning(
+                        "server exited with code %d; waiting for a "
+                        "source change to restart", child.returncode,
+                    )
+                    while snapshot() == snap:
+                        time.sleep(0.5)
+                    restart = True
+                    break
+                if snapshot() != snap:
+                    _log.info("source change detected; restarting server")
+                    restart = True
+                    break
+        except KeyboardInterrupt:
+            restart = False
+        if child.poll() is None:
+            child.send_signal(signal.SIGTERM)
+            try:
+                child.wait(10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        if not restart:
+            return 0
+
+
 def main(argv=None) -> None:
     from mlapi_tpu.utils.platform import apply_platform_override
 
@@ -74,7 +140,20 @@ def main(argv=None) -> None:
         help="start a jax.profiler server on this port (XProf/TensorBoard "
              "can attach live)",
     )
+    parser.add_argument(
+        "--reload", action="store_true",
+        help="dev loop: restart the server when package sources change",
+    )
     args = parser.parse_args(argv)
+
+    if args.reload:
+        import os
+        import sys
+
+        if os.environ.get("MLAPI_TPU_RELOAD_CHILD") != "1":
+            sys.exit(
+                _watch_and_reexec(argv if argv is not None else sys.argv[1:])
+            )
 
     if args.profiler_port:
         import jax.profiler

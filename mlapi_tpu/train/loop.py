@@ -44,12 +44,20 @@ def make_train_step(
     tx: optax.GradientTransformation,
     *,
     weight_decay: float = 0.0,
+    debug_checks: bool = False,
 ) -> Callable:
     """Build a jit-compiled SGD step ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
 
     ``params`` and ``opt_state`` are donated — the optimizer update
     happens in-place in device memory, no copies.
+
+    ``debug_checks=True`` compiles the step through ``checkify`` with
+    float checks (SURVEY §5 sanitizers row): NaN/inf produced anywhere
+    inside the step — a grad, an optimizer moment, the loss — raises
+    with the location of the first bad op, instead of surfacing N
+    steps later as a non-finite loss. Costs a host sync per step, so
+    it is a debug mode, not the default.
     """
 
     def loss_fn(params, x, y):
@@ -66,14 +74,29 @@ def make_train_step(
             loss = loss + 0.5 * weight_decay * l2
         return loss
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return step
+    if debug_checks:
+        from jax.experimental import checkify
+
+        checked = checkify.checkify(step, errors=checkify.float_checks)
+        # Donation shifts under checkify: the wrapped signature is the
+        # same, but outputs gain the error prefix — jit still donates
+        # the (params, opt_state) inputs safely.
+        jitted = jax.jit(checked, donate_argnums=(0, 1))
+
+        def checked_step(params, opt_state, x, y):
+            err, out = jitted(params, opt_state, x, y)
+            checkify.check_error(err)  # throws with the first bad op
+            return out
+
+        return checked_step
+
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 @functools.lru_cache(maxsize=64)
@@ -82,25 +105,55 @@ def _jitted(apply_fn: Callable) -> Callable:
     return jax.jit(apply_fn)
 
 
-def evaluate(apply_fn: Callable, params, x, y) -> float:
-    """Held-out accuracy (the reference's single metric: ``.score``)."""
-    logits = _jitted(apply_fn)(params, jnp.asarray(x))
-    return float(jnp.mean(jnp.argmax(logits, axis=-1) == jnp.asarray(y)))
+def evaluate(
+    apply_fn: Callable, params, x, y, *, batch_size: int = 4096
+) -> float:
+    """Held-out accuracy (the reference's single metric: ``.score``).
+
+    Evaluates in ``batch_size`` chunks — one whole-test-set jit call
+    OOMs once the eval set or model stops being tiny. The tail chunk
+    pads up to a full batch (one compiled shape, not two) with the pad
+    rows' predictions discarded."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    n = len(x)
+    if n == 0:
+        return float("nan")
+    fn = _jitted(apply_fn)
+    if n <= batch_size:
+        logits = fn(params, jnp.asarray(x))
+        return float(jnp.mean(jnp.argmax(logits, axis=-1) == jnp.asarray(y)))
+    correct = 0
+    for s in range(0, n, batch_size):
+        chunk = x[s : s + batch_size]
+        m = len(chunk)
+        if m < batch_size:
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], batch_size - m, axis=0)]
+            )
+        pred = jnp.argmax(fn(params, jnp.asarray(chunk)), axis=-1)[:m]
+        correct += int(jnp.sum(pred == jnp.asarray(y[s : s + m])))
+    return correct / n
 
 
-def _save_train_state(root, params, opt_state, step: int, run_config: dict) -> None:
+def _save_train_state(
+    root, state: dict, step: int, run_config: dict, keep_last: int = 0
+) -> None:
     """Checkpoint FULL train state (params + optimizer moments) so a
     resumed run continues the same trajectory, not a fresh-optimizer
-    approximation of it."""
-    from mlapi_tpu.checkpoint import save_checkpoint
+    approximation of it. With ``keep_last``, older committed steps are
+    collected after the new one commits."""
+    from mlapi_tpu.checkpoint import gc_checkpoints, save_checkpoint
     from mlapi_tpu.checkpoint.io import step_dir
 
     save_checkpoint(
         step_dir(root, step),
-        {"params": params, "opt_state": list(opt_state)},
+        state,
         step=step,
         config={"kind": "train_state", **run_config},
     )
+    if keep_last and jax.process_index() == 0:
+        gc_checkpoints(root, keep_last)
 
 
 def _maybe_resume(root, params, opt_state, run_config: dict):
@@ -180,8 +233,11 @@ def fit(
     eval_every: int = 0,
     checkpoint_dir: str | None = None,
     save_every: int = 0,
+    keep_last: int = 0,
+    async_save: bool = True,
     resume: bool = True,
     profile_dir: str | None = None,
+    debug_checks: bool = False,
 ) -> TrainResult:
     """Train ``model`` on ``splits``.
 
@@ -196,7 +252,14 @@ def fit(
     optimizer moments) is checkpointed periodically; a rerun resumes
     from the newest committed step and — because minibatch selection
     is a pure function of (seed, step) — replays the exact schedule a
-    never-interrupted run would have seen.
+    never-interrupted run would have seen. ``keep_last=N`` retains
+    only the newest N committed step dirs (older ones are collected
+    after each commit). ``async_save`` (single-process runs) copies
+    state to host synchronously — the step donates those device
+    buffers, so they cannot outlive the loop iteration — then writes
+    to disk on a background thread, keeping the device busy through
+    the tensorstore I/O; at most one save is in flight, and a failed
+    save surfaces on the next save point (or at the end of the run).
 
     ``profile_dir`` wraps the whole loop in a ``jax.profiler.trace``
     (view with TensorBoard/XProf).
@@ -239,7 +302,19 @@ def fit(
                 "resume=False / --no-resume"
             )
 
-    step_fn = make_train_step(model.apply, tx, weight_decay=weight_decay)
+    step_fn = make_train_step(
+        model.apply, tx, weight_decay=weight_decay, debug_checks=debug_checks
+    )
+
+    # Async checkpointing: one background writer, one save in flight.
+    save_pool = None
+    pending_save = None
+    if checkpoint_dir and save_every and async_save and jax.process_count() == 1:
+        import concurrent.futures
+
+        save_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-save"
+        )
 
     # Preserve the dataset's feature dtype: float32 for tabular rows,
     # int32 token ids for text models.
@@ -262,35 +337,62 @@ def fit(
     t0 = time.perf_counter()
     history: list[dict] = []
     loss = float("nan")
-    with profiler_cm:
-        for i in range(start_step, steps):
-            x, y = batch_at(i)
-            if mesh is not None:
-                x, y = shard_batch_for_mesh((x, y), mesh)
-            params, opt_state, loss = step_fn(params, opt_state, x, y)
-            if eval_every and (i + 1) % eval_every == 0:
-                if not np.isfinite(float(loss)):
-                    raise FloatingPointError(
-                        f"non-finite loss {float(loss)} at step {i + 1}"
+    try:
+        with profiler_cm:
+            for i in range(start_step, steps):
+                x, y = batch_at(i)
+                if mesh is not None:
+                    x, y = shard_batch_for_mesh((x, y), mesh)
+                params, opt_state, loss = step_fn(params, opt_state, x, y)
+                if eval_every and (i + 1) % eval_every == 0:
+                    if not np.isfinite(float(loss)):
+                        raise FloatingPointError(
+                            f"non-finite loss {float(loss)} at step {i + 1}"
+                        )
+                    acc = evaluate(
+                        model.apply, params, splits.x_test, splits.y_test
                     )
-                acc = evaluate(model.apply, params, splits.x_test, splits.y_test)
-                history.append(
-                    {"step": i + 1, "loss": float(loss), "test_accuracy": acc}
-                )
-            if (
-                checkpoint_dir
-                and save_every
-                and (i + 1) % save_every == 0
-                and (i + 1) < steps
-            ):
-                if not np.isfinite(float(loss)):
-                    raise FloatingPointError(
-                        f"refusing to checkpoint non-finite loss "
-                        f"{float(loss)} at step {i + 1}"
+                    history.append(
+                        {"step": i + 1, "loss": float(loss),
+                         "test_accuracy": acc}
                     )
-                _save_train_state(
-                    checkpoint_dir, params, opt_state, i + 1, run_config
-                )
+                if (
+                    checkpoint_dir
+                    and save_every
+                    and (i + 1) % save_every == 0
+                    and (i + 1) < steps
+                ):
+                    if not np.isfinite(float(loss)):
+                        raise FloatingPointError(
+                            f"refusing to checkpoint non-finite loss "
+                            f"{float(loss)} at step {i + 1}"
+                        )
+                    state = {"params": params, "opt_state": list(opt_state)}
+                    if save_pool is not None:
+                        if pending_save is not None:
+                            pending_save.result()  # one in flight; fail loud
+                        # Host copy NOW (the next step donates these
+                        # device buffers); disk write overlaps training.
+                        host_state = jax.device_get(state)
+                        pending_save = save_pool.submit(
+                            _save_train_state, checkpoint_dir, host_state,
+                            i + 1, run_config, keep_last,
+                        )
+                    else:
+                        _save_train_state(
+                            checkpoint_dir, state, i + 1, run_config,
+                            keep_last,
+                        )
+    finally:
+        # Join the in-flight save even when the loop raises — a failed
+        # background save must never be silently dropped (if both
+        # failed, the loop's exception stays chained as __context__).
+        if save_pool is not None:
+            try:
+                if pending_save is not None:
+                    pending_save.result()
+            finally:
+                save_pool.shutdown(wait=True)
     wall = time.perf_counter() - t0
     if steps > start_step and not np.isfinite(float(loss)):
         raise FloatingPointError(

@@ -104,8 +104,10 @@ def test_no_pickle_on_disk(tmp_path, params):
 def test_multihost_commit_barrier(tmp_path, params, monkeypatch):
     """Mocked multi-process save: non-zero processes barrier twice and
     do NOT write the manifest; process 0 writes it between the
-    barriers; barrier keys carry the full path (same-named leaf dirs
-    under different roots must not cross-match)."""
+    barriers; barrier keys are HOST-INVARIANT (derived from leaf name
+    + step + config + tree structure, NOT the locally-resolved path —
+    hosts mounting the shared filesystem at different points must
+    derive identical keys or they deadlock)."""
     import mlapi_tpu.checkpoint.io as io_mod
     from jax.experimental import multihost_utils
 
@@ -121,13 +123,26 @@ def test_multihost_commit_barrier(tmp_path, params, monkeypatch):
     assert not (p1 / "MANIFEST.json").exists()
     assert len(seen) == 2
     assert seen[0].startswith("ckpt_pre:") and seen[1].startswith("ckpt_post:")
-    assert str(tmp_path / "a" / "step_1") in seen[0]  # full path, not leaf
+    # Host-invariance: the locally-resolved path must NOT leak into
+    # the key (different mount points would then derive different
+    # keys and deadlock in sync_global_devices).
+    assert str(tmp_path) not in seen[0]
+    keys_a = list(seen)
+
+    # Another "host" saving the same checkpoint under a different
+    # mount prefix derives the SAME keys.
+    seen.clear()
+    mount_b = tmp_path / "mnt"
+    mount_b.mkdir()
+    (mount_b / "a").symlink_to(tmp_path / "a")
+    save_checkpoint(mount_b / "a" / "step_1", params, step=1)
+    assert seen == keys_a
 
     # Process 0: commits the manifest between the two barriers.
     seen.clear()
     monkeypatch.setattr(io_mod, "_process_index", lambda: 0)
-    p0 = save_checkpoint(tmp_path / "b" / "step_1", params, step=1)
+    p0 = save_checkpoint(tmp_path / "b" / "step_2", params, step=2)
     assert (p0 / "MANIFEST.json").exists()
     assert [k.split(":")[0] for k in seen] == ["ckpt_pre", "ckpt_post"]
-    # Keys from different roots with the same leaf dir must differ.
-    assert seen[0] != f"ckpt_pre:{tmp_path / 'a' / 'step_1'}"
+    # A different step must not cross-match the first save's barrier.
+    assert seen[0] != keys_a[0]

@@ -64,3 +64,72 @@ def test_initialize_from_env_is_noop_single_host(monkeypatch):
     monkeypatch.delenv("MLAPI_TPU_COORDINATOR", raising=False)
     monkeypatch.delenv("MLAPI_TPU_MULTIHOST", raising=False)
     assert initialize_from_env() is False
+
+
+def test_keep_last_gc_retains_newest(tmp_path):
+    """keep_last=N: only the N newest committed step dirs survive a
+    run; resume still works from the newest."""
+    mnist = get_dataset("mnist", synthetic_train=512, synthetic_test=64)
+    model = get_model("linear", num_features=784, num_classes=10)
+    ck = tmp_path / "ts"
+    fit(model, mnist, steps=50, batch_size=64, learning_rate=1e-2, seed=1,
+        checkpoint_dir=str(ck), save_every=10, keep_last=2)
+    steps = sorted(p.name for p in ck.iterdir() if p.name.startswith("step_"))
+    # Saves at 10,20,30,40 (save_every skips the final step); keep 2.
+    assert steps == ["step_00000030", "step_00000040"], steps
+
+
+def test_gc_checkpoints_only_touches_committed(tmp_path):
+    from mlapi_tpu.checkpoint import gc_checkpoints, save_checkpoint
+
+    params = {"w": np.zeros((2, 2), np.float32)}
+    for s in (1, 2, 3):
+        save_checkpoint(tmp_path / f"step_{s:08d}", params, step=s)
+    # An uncommitted dir (in-progress save on another process) and a
+    # non-step dir must both be left alone.
+    (tmp_path / "step_00000099").mkdir()
+    (tmp_path / "notes").mkdir()
+    deleted = gc_checkpoints(tmp_path, keep_last=1)
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["notes", "step_00000003", "step_00000099"], names
+    assert len(deleted) == 2
+
+
+def test_async_save_matches_sync_save(tmp_path):
+    """async_save runs the same trajectory and commits the same
+    checkpoints as the synchronous path."""
+    mnist = get_dataset("mnist", synthetic_train=512, synthetic_test=64)
+    model = get_model("linear", num_features=784, num_classes=10)
+    kwargs = dict(batch_size=64, learning_rate=1e-2, seed=2, save_every=10)
+    a = fit(model, mnist, steps=30, checkpoint_dir=str(tmp_path / "sync"),
+            async_save=False, **kwargs)
+    b = fit(model, mnist, steps=30, checkpoint_dir=str(tmp_path / "async"),
+            async_save=True, **kwargs)
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+    sync_steps = sorted(
+        p.name for p in (tmp_path / "sync").iterdir()
+        if p.name.startswith("step_")
+    )
+    async_steps = sorted(
+        p.name for p in (tmp_path / "async").iterdir()
+        if p.name.startswith("step_")
+    )
+    assert sync_steps == async_steps and sync_steps
+
+
+def test_debug_checks_catches_nan(tmp_path):
+    """debug_checks=True turns a NaN inside the step into an
+    immediate checkify error at step 1 (SURVEY §5 sanitizers row) —
+    instead of surfacing steps later as a non-finite loss."""
+
+    class PoisonedSplits:
+        x_train = np.zeros((32, 4), np.float32)
+        y_train = np.zeros((32,), np.int64)
+        x_test = np.zeros((0, 4), np.float32)
+        y_test = np.zeros((0,), np.int64)
+
+    PoisonedSplits.x_train[3, 2] = np.nan  # one bad feature row
+    model = get_model("linear", num_features=4, num_classes=3)
+    with pytest.raises(Exception, match="(?i)nan"):
+        fit(model, PoisonedSplits(), steps=5, debug_checks=True)
