@@ -16,6 +16,18 @@ for scale, its per-request pickle.load alone costs ~1 ms.
 
 The server runs in a subprocess so client and server don't share a
 GIL; the load generator speaks raw sockets (client overhead ~0.01 ms).
+
+Device handling: the accelerator behind this environment's tunnel has
+a history of wedging (``jax.devices()`` hanging, r01/r02). The probe
+runs in a SUBPROCESS with a hard timeout and bounded retries with
+backoff; every attempt (duration, outcome, error) is recorded to
+``BENCH_DIAG.json`` next to this file, then the harness either uses
+the probed backend or falls back to CPU — honestly labelled either way.
+
+Env knobs: ``BENCH_BACKEND=cpu`` skips the probe and forces the CPU
+path (used for round-over-round serving-stack comparisons where the
+accelerator would confound); ``BENCH_DURATION_S``, ``BENCH_CONCURRENCY``,
+``BENCH_PORT``, ``BENCH_PROBE_RETRIES``, ``BENCH_PROBE_TIMEOUT_S``.
 """
 
 import asyncio
@@ -39,6 +51,99 @@ FLOWER = {
     "petal_length": 1.4,
     "petal_width": 0.2,
 }
+
+_PROBE_SRC = """
+import json, sys, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+ds = jax.devices()
+enum_s = time.time() - t0
+# Enumeration alone is NOT health: a wedged tunnel happily lists the
+# chip and then hangs the first real dispatch (observed r03: devices()
+# returned in 0.1 s, a 5-element jit reduction never completed in
+# 240 s). Prove one tiny compile+execute+readback round trip.
+t1 = time.time()
+val = float(jax.jit(lambda x: (x * 2).sum())(jnp.ones((4,))))
+assert val == 8.0, val
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device_count": jax.device_count(),
+    "device_kind": ds[0].device_kind if ds else None,
+    "enum_s": round(enum_s, 2),
+    "compute_s": round(time.time() - t1, 2),
+}))
+"""
+
+
+def probe_device(
+    retries: int | None = None, timeout_s: float | None = None
+) -> tuple[dict | None, dict]:
+    """Ask a subprocess what accelerator JAX sees, with a hard timeout
+    (a wedged device tunnel hangs ``jax.devices()`` indefinitely — the
+    r01/r02 failure mode — and a hang must not take the harness down
+    with it). Returns ``(probe_result_or_None, diagnostics)`` and
+    writes the diagnostics to ``BENCH_DIAG.json``."""
+    retries = retries or int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    timeout_s = timeout_s or float(
+        os.environ.get("BENCH_PROBE_TIMEOUT_S", "90")
+    )
+    diag: dict = {
+        "probe_timeout_s": timeout_s,
+        "attempts": [],
+        "env": {
+            k: os.environ.get(k)
+            for k in ("JAX_PLATFORMS", "MLAPI_TPU_PLATFORM", "TPU_SKIP_MDS_QUERY")
+            if os.environ.get(k) is not None
+        },
+    }
+    result = None
+    for attempt in range(retries):
+        t0 = time.time()
+        rec: dict = {"attempt": attempt + 1}
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                timeout=timeout_s,
+                text=True,
+            )
+            rec["duration_s"] = round(time.time() - t0, 2)
+            rec["returncode"] = out.returncode
+            if out.returncode == 0 and out.stdout.strip():
+                result = json.loads(out.stdout.strip().splitlines()[-1])
+                rec["result"] = result
+                diag["attempts"].append(rec)
+                break
+            rec["stderr_tail"] = out.stderr[-2000:]
+        except subprocess.TimeoutExpired as te:
+            rec["duration_s"] = round(time.time() - t0, 2)
+            rec["error"] = (
+                f"probe subprocess hung >{timeout_s}s in jax device "
+                "init/first dispatch (wedged accelerator tunnel) and was "
+                "killed"
+            )
+            for name in ("stdout", "stderr"):
+                out = getattr(te, name, None)
+                if out:
+                    if isinstance(out, bytes):
+                        out = out.decode(errors="replace")
+                    rec[f"{name}_tail"] = out[-2000:]
+        except Exception as e:  # noqa: BLE001
+            rec["duration_s"] = round(time.time() - t0, 2)
+            rec["error"] = repr(e)
+        diag["attempts"].append(rec)
+        if attempt + 1 < retries:
+            time.sleep(min(5.0 * (attempt + 1), 15.0))  # backoff, then retry
+    diag["outcome"] = result or "unreachable"
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DIAG.json"
+        )
+        with open(path, "w") as f:
+            json.dump(diag, f, indent=2)
+    except OSError:
+        pass
+    return result, diag
 
 
 def wait_healthy(
@@ -81,6 +186,171 @@ def _spawn_server(workdir: str, extra_env: dict | None = None):
     )
 
 
+def _choose_backend() -> tuple[dict | None, str | None, dict]:
+    """Probe the accelerator (or honour ``BENCH_BACKEND``); returns
+    (probe_result, note, env-for-subprocesses)."""
+    forced = os.environ.get("BENCH_BACKEND")
+    if forced:
+        probe, note = {"backend": forced}, "backend forced by BENCH_BACKEND"
+    else:
+        probe, diag = probe_device()
+        note = None
+        if probe is None:
+            note = (
+                "accelerator probe failed "
+                f"({len(diag['attempts'])} attempts, see BENCH_DIAG.json); "
+                "measured on CPU fallback (same serving stack)"
+            )
+    env = {}
+    if probe is None or probe.get("backend") != "tpu":
+        env["MLAPI_TPU_PLATFORM"] = "cpu"
+    return probe, note, env
+
+
+def _write_demo_gpt_checkpoint(workdir: str, env: dict) -> str:
+    """Materialise a small random-weight GPT checkpoint for the
+    /generate bench (decode mechanics don't care about weight values)
+    in a subprocess, so this harness process never initialises jax."""
+    path = os.path.join(workdir, "gpt_ck")
+    src = f"""
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import save_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.text import ByteTokenizer
+CFG = dict(vocab_size=260, hidden_size=128, num_layers=2, num_heads=4,
+           max_positions=256, compute_dtype="float32")
+model = get_model("gpt_lm", **CFG)
+save_checkpoint({path!r}, model.init(jax.random.key(0)), step=1,
+                config={{"model": "gpt_lm", "model_kwargs": CFG,
+                         "tokenizer": ByteTokenizer().fingerprint()}})
+"""
+    subprocess.run(
+        [sys.executable, "-c", src],
+        check=True,
+        env=dict(os.environ, **env),
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "240")),
+    )
+    return path
+
+
+def bench_generate() -> None:
+    """/generate throughput: single-stream vs concurrency-8 batched
+    decode through the full HTTP stack (r1 criterion: batched decode
+    must deliver a multiple of single-stream throughput)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mlapi_tpu.serving.loadgen import run_load
+
+    workdir = tempfile.mkdtemp(prefix="mlapi_tpu_bench_gen_")
+    startup_timeout = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "240"))
+    probe, note_extra, server_env = _choose_backend()
+    try:
+        ck = _write_demo_gpt_checkpoint(workdir, server_env)
+    except subprocess.TimeoutExpired:
+        # Accelerator wedged between the probe and now: go CPU.
+        note_extra = (
+            "accelerator wedged writing the bench checkpoint; measured "
+            "on CPU fallback (same serving stack)"
+        )
+        server_env = {"MLAPI_TPU_PLATFORM": "cpu"}
+        ck = _write_demo_gpt_checkpoint(workdir, server_env)
+
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlapi_tpu.serving",
+            "--checkpoint", ck, "--port", str(PORT),
+        ],
+        stdout=open(os.path.join(workdir, "server.log"), "a"),
+        stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=dict(os.environ, **server_env),
+    )
+    n_new = 32
+    payload = {"text": "the quick brown fox", "max_new_tokens": n_new}
+    try:
+        try:
+            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
+        except RuntimeError:
+            if server_env.get("MLAPI_TPU_PLATFORM") == "cpu":
+                raise  # already the CPU fallback; a respawn can't help
+            # Probe passed its tiny round trip but the server wedged in
+            # warmup (the bigger compiles): same honest CPU fallback as
+            # the /predict bench.
+            server.kill()
+            server.wait()
+            note_extra = (
+                "server failed to come healthy on the probed accelerator; "
+                "measured on CPU fallback (same serving stack)"
+            )
+            server_env = {"MLAPI_TPU_PLATFORM": "cpu"}
+            server = subprocess.Popen(
+                [
+                    sys.executable, "-m", "mlapi_tpu.serving",
+                    "--checkpoint", ck, "--port", str(PORT),
+                ],
+                stdout=open(os.path.join(workdir, "server.log"), "a"),
+                stderr=subprocess.STDOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=dict(os.environ, **server_env),
+            )
+            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
+
+        async def measure():
+            await run_load(  # warm residual shapes
+                "127.0.0.1", PORT, "/generate", payload=payload,
+                concurrency=8, duration_s=4.0,
+            )
+            single = await run_load(
+                "127.0.0.1", PORT, "/generate", payload=payload,
+                concurrency=1, duration_s=8.0,
+            )
+            batched = await run_load(
+                "127.0.0.1", PORT, "/generate", payload=payload,
+                concurrency=8, duration_s=8.0,
+            )
+            return single, batched
+
+        single, batched = asyncio.run(measure())
+        single_tps = single.throughput * n_new
+        batched_tps = batched.throughput * n_new
+        print(
+            json.dumps(
+                {
+                    "metric": "generate_tokens_per_sec",
+                    "value": round(batched_tps, 1),
+                    "unit": "tokens/s",
+                    "vs_baseline": round(
+                        batched_tps / single_tps, 2
+                    ) if single_tps else None,
+                    "extras": {
+                        "max_new_tokens": n_new,
+                        "single_stream_tokens_per_s": round(single_tps, 1),
+                        "batched_c8_tokens_per_s": round(batched_tps, 1),
+                        "batched_over_single": round(
+                            batched_tps / single_tps, 2
+                        ) if single_tps else None,
+                        "single_p50_ms": round(single.quantile(0.5) or -1, 1),
+                        "batched_p50_ms": round(
+                            batched.quantile(0.5) or -1, 1
+                        ),
+                        "errors": single.errors + batched.errors,
+                        "backend": health.get("backend"),
+                        "note": note_extra
+                        or "vs_baseline here = batched/single speedup",
+                    },
+                }
+            )
+        )
+    finally:
+        server.send_signal(signal.SIGTERM)
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mlapi_tpu.serving.loadgen import run_load
@@ -88,16 +358,22 @@ def main() -> None:
     workdir = tempfile.mkdtemp(prefix="mlapi_tpu_bench_")
     startup_timeout = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "180"))
 
-    # Try the attached accelerator first; if it never comes healthy
-    # (e.g. a wedged device tunnel), fall back to CPU so the harness
-    # always reports a number — with the backend recorded honestly.
-    server = _spawn_server(workdir)
+    probe, note_extra, server_env = _choose_backend()
+
+    server = _spawn_server(workdir, server_env)
     try:
         try:
             health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
         except RuntimeError:
+            if server_env.get("MLAPI_TPU_PLATFORM") == "cpu":
+                raise  # already the CPU fallback; a respawn can't help
+            # Probe said healthy but the server still wedged: one CPU retry.
             server.kill()
             server.wait()
+            note_extra = (
+                "server failed to come healthy on the probed accelerator; "
+                "measured on CPU fallback (same serving stack)"
+            )
             server = _spawn_server(workdir, {"MLAPI_TPU_PLATFORM": "cpu"})
             health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
 
@@ -127,6 +403,16 @@ def main() -> None:
 
         single, best = asyncio.run(measure())
         rps_per_chip = best.throughput / max(1, n_chips)
+        if note_extra:
+            note = note_extra
+        elif health.get("backend") == "tpu":
+            note = (
+                "real TPU through a network tunnel: single-stream p50 "
+                "includes one tunnel round trip; server-side overhead is "
+                "~0.1 ms/req"
+            )
+        else:
+            note = "measured on CPU (same serving stack)"
         print(
             json.dumps(
                 {
@@ -145,14 +431,7 @@ def main() -> None:
                         ),
                         "errors": best.errors,
                         "backend": health.get("backend"),
-                        "note": (
-                            "single-stream p50 on this host includes one "
-                            "network-tunnel round trip to the TPU (~65 ms); "
-                            "server-side overhead is ~0.1 ms/req"
-                            if health.get("backend") == "tpu"
-                            else "accelerator unavailable; measured on CPU "
-                                 "fallback (same serving stack)"
-                        ),
+                        "note": note,
                     },
                 }
             )
@@ -166,4 +445,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--generate" in sys.argv:
+        bench_generate()
+    elif "--train" in sys.argv:
+        # Training throughput/MFU rows (one JSON line per preset);
+        # the full implementation lives in mlapi_tpu.train.bench.
+        _, _, env = _choose_backend()
+        os.environ.update(env)
+        subprocess.run(
+            [sys.executable, "-m", "mlapi_tpu.train", "--bench"],
+            check=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=dict(os.environ),
+        )
+    else:
+        main()

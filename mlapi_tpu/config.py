@@ -145,6 +145,43 @@ register_preset(
     )
 )
 
+# Real-data anchors for the configs 2-3 model families: the MNIST /
+# Fashion-MNIST files cannot be fetched in this air-gapped build, so
+# the same linear / MLP architectures also train on the REAL
+# handwritten-digits scans scikit-learn bundles (datasets/digits.py) —
+# published accuracies that mean something, next to the clearly-marked
+# synthetic rows.
+register_preset(
+    TrainConfig(
+        name="digits-softmax",
+        model="linear",
+        model_kwargs={"num_features": 64, "num_classes": 10},
+        dataset="digits",
+        steps=2000,
+        batch_size=256,
+        learning_rate=1e-3,
+        eval_every=500,
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="digits-mlp",
+        model="mlp",
+        model_kwargs={
+            "num_features": 64,
+            "num_classes": 10,
+            "hidden_dims": [256, 128],
+        },
+        dataset="digits",
+        steps=3000,
+        batch_size=256,
+        learning_rate=1e-3,
+        eval_every=500,
+        mesh_shape=(8, 1),
+    )
+)
+
 register_preset(
     TrainConfig(
         name="criteo-widedeep",

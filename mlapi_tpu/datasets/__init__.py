@@ -77,4 +77,5 @@ register_dataset("mnist")(load_mnist)
 register_dataset("fashion_mnist")(load_fashion_mnist)
 
 from mlapi_tpu.datasets.criteo import load_criteo  # noqa: E402,F401  (self-registers)
+from mlapi_tpu.datasets.digits import load_digits  # noqa: E402,F401  (self-registers)
 from mlapi_tpu.datasets.sst2 import load_sst2  # noqa: E402,F401  (self-registers)

@@ -141,11 +141,21 @@ def main(argv=None) -> None:
 
     apply_platform_override()
     parser = argparse.ArgumentParser("mlapi_tpu.train")
-    group = parser.add_mutually_exclusive_group(required=True)
+    group = parser.add_mutually_exclusive_group()
     group.add_argument(
         "--preset", choices=preset_names(), help="a ladder config by name"
     )
     group.add_argument("--config", help="path to a TrainConfig YAML")
+    parser.add_argument(
+        "--bench", action="store_true",
+        help="measure step time / examples/s / MFU on the attached "
+             "backend (one JSON line per preset; combine with --preset "
+             "to bench one config) instead of training",
+    )
+    parser.add_argument(
+        "--bench-steps", type=int, default=10,
+        help="measured steps per preset in --bench mode",
+    )
     parser.add_argument("--out", help="checkpoint output dir")
     parser.add_argument(
         "--steps", type=int, default=None, help="override config steps"
@@ -163,6 +173,22 @@ def main(argv=None) -> None:
         help="write a jax.profiler trace here (view with TensorBoard)",
     )
     args = parser.parse_args(argv)
+
+    if args.bench:
+        from mlapi_tpu.train.bench import DEFAULT_BENCH_PRESETS, bench_train
+
+        if args.config:
+            targets = [TrainConfig.from_yaml(args.config)]
+        elif args.preset:
+            targets = [args.preset]
+        else:
+            targets = [p for p in DEFAULT_BENCH_PRESETS if p in preset_names()]
+        for t in targets:
+            row = bench_train(t, bench_steps=args.bench_steps)
+            print(json.dumps(row))
+        return
+    if not args.preset and not args.config:
+        parser.error("need --preset, --config, or --bench")
 
     cfg = get_preset(args.preset) if args.preset else TrainConfig.from_yaml(args.config)
     if args.steps is not None:

@@ -1,0 +1,151 @@
+"""Training throughput bench: step time, examples/s, and MFU.
+
+"Actually fast, not just correct" needs a number (VERDICT r2 #3): for
+each ladder preset this measures the steady-state jitted train step —
+the same ``make_train_step`` program ``fit`` runs — and reports:
+
+- ``step_ms``:      wall time per optimizer step (K steps dispatched
+                    back-to-back, one device sync at the end — the
+                    realistic pipeline, since each step consumes the
+                    previous step's donated state).
+- ``examples_per_s``: batch_size / step time.
+- ``flops_per_step``: XLA's own count (``compiled.cost_analysis()``),
+                    not a hand model — includes forward, backward and
+                    the optimizer update.
+- ``mfu``:          flops_per_step / step_time / peak_flops, where
+                    peak is the chip's bf16 matmul peak. Reported only
+                    on TPU (CPU "peak" is not a meaningful basis).
+
+Usage::
+
+    python -m mlapi_tpu.train --bench                  # default presets
+    python -m mlapi_tpu.train --bench --preset sst2-bert --bench-steps 20
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+# Peak dense matmul throughput (bf16, per chip) by device kind. MFU
+# against the bf16 peak is the community convention even when parts of
+# the program run f32; the denominator is what the MXU could do.
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e/Trillium
+}
+
+
+def _peak_for(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_FLOPS.items():
+        if kind.startswith(name) or name.startswith(kind):
+            return peak
+    return None
+
+
+def bench_train(
+    preset,
+    *,
+    bench_steps: int = 10,
+    warmup_steps: int = 3,
+    batch_size: int | None = None,
+    use_mesh: bool = True,
+) -> dict[str, Any]:
+    """Measure the training step of one ladder preset (by name) or an
+    explicit ``TrainConfig`` on the attached backend. Returns a flat
+    dict of numbers (JSON-ready)."""
+    from mlapi_tpu.config import get_preset
+    from mlapi_tpu.datasets import get_dataset
+    from mlapi_tpu.models import get_model
+    from mlapi_tpu.parallel import (
+        create_mesh,
+        params_for_model,
+        shard_batch_for_mesh,
+    )
+    from mlapi_tpu.train.loop import _make_optimizer, make_train_step
+
+    cfg = get_preset(preset) if isinstance(preset, str) else preset
+    splits = get_dataset(cfg.dataset, **cfg.dataset_kwargs)
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    bs = batch_size or cfg.batch_size or min(256, len(splits.x_train))
+
+    mesh = None
+    if use_mesh and cfg.mesh_shape is not None:
+        need = int(np.prod(cfg.mesh_shape))
+        if need <= jax.device_count():
+            mesh = create_mesh(cfg.mesh_shape)
+
+    tx = _make_optimizer(cfg.optimizer, cfg.learning_rate)
+    params = model.init(jax.random.key(cfg.seed))
+    if mesh is not None:
+        params = params_for_model(model, params, mesh)
+        opt_state = jax.jit(tx.init)(params)
+    else:
+        opt_state = tx.init(params)
+    step_fn = make_train_step(model.apply, tx, weight_decay=cfg.weight_decay)
+
+    # One fixed batch, reused: this measures the step program, not the
+    # host data pipeline (which fit's (seed, step)-keyed batching does
+    # off the device critical path anyway).
+    x = np.asarray(splits.x_train[:bs])
+    y = np.asarray(splits.y_train[:bs], np.int32)
+    if len(x) < bs:
+        reps = -(-bs // len(x))
+        x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:bs]
+        y = np.tile(y, reps)[:bs]
+    if mesh is not None:
+        x, y = shard_batch_for_mesh((x, y), mesh)
+
+    # XLA's own flop count for the whole step (fwd + bwd + optimizer).
+    flops = None
+    try:
+        cost = step_fn.lower(params, opt_state, x, y).compile().cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops = float(cost.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        pass
+
+    for _ in range(warmup_steps):
+        params, opt_state, loss = step_fn(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        params, opt_state, loss = step_fn(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+    total = time.perf_counter() - t0
+
+    step_s = total / bench_steps
+    dev = jax.devices()[0]
+    n_dev = mesh.size if mesh is not None else 1
+    peak = _peak_for(dev)
+    mfu = (
+        round(flops / step_s / (peak * n_dev), 4)
+        if (flops and peak and jax.default_backend() == "tpu")
+        else None
+    )
+    return {
+        "preset": cfg.name,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "cpu"),
+        "devices": n_dev,
+        "mesh": list(cfg.mesh_shape) if mesh is not None else None,
+        "batch_size": int(bs),
+        "step_ms": round(step_s * 1e3, 3),
+        "examples_per_s": round(bs / step_s, 1),
+        "flops_per_step": flops,
+        "tflops_per_s": round(flops / step_s / 1e12, 2) if flops else None,
+        "mfu": mfu,
+        "final_loss": float(loss),
+    }
+
+
+DEFAULT_BENCH_PRESETS = ("fashion-mlp", "criteo-widedeep", "sst2-bert")

@@ -5,6 +5,8 @@ reference implements as notebook → pickle → server, SURVEY §3.4)."""
 import dataclasses
 import json
 
+import numpy as np
+
 import pytest
 import yaml
 
@@ -72,3 +74,17 @@ def test_train_cli_mesh_fallback_when_devices_missing(tmp_path):
     )
     summary = train_run(cfg, None)
     assert summary["test_accuracy"] is not None
+
+
+def test_train_bench_reports_throughput():
+    """--bench mode: step time / examples/s rows come back sane for a
+    mesh preset (VERDICT r2 #4: training perf must be measurable)."""
+    from mlapi_tpu.train.bench import bench_train
+
+    row = bench_train("fashion-mlp", bench_steps=2, warmup_steps=1)
+    assert row["preset"] == "fashion-mlp"
+    assert row["step_ms"] > 0
+    assert row["examples_per_s"] > 0
+    assert row["batch_size"] == 256
+    assert row["devices"] == 8 and row["mesh"] == [8, 1]
+    assert np.isfinite(row["final_loss"])
