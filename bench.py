@@ -168,22 +168,52 @@ def wait_healthy(
     raise RuntimeError(f"server never became healthy: {last_err}")
 
 
-def _spawn_server(workdir: str, extra_env: dict | None = None):
+def _spawn_server(
+    workdir: str, extra_env: dict | None = None, args: list[str] | None = None
+):
+    """Start the serving CLI as a subprocess, logging to the workdir.
+    ``args`` defaults to the Iris demo server."""
     env = dict(os.environ, **(extra_env or {}))
-    return subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "mlapi_tpu.serving",
-            "--demo-iris",
-            "--port",
-            str(PORT),
-        ],
-        stdout=open(os.path.join(workdir, "server.log"), "a"),
-        stderr=subprocess.STDOUT,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        env=env,
-    )
+    with open(os.path.join(workdir, "server.log"), "a") as log:
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "mlapi_tpu.serving",
+                *(args if args is not None else ["--demo-iris"]),
+                "--port", str(PORT),
+            ],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+        )
+
+
+def _start_with_cpu_fallback(
+    workdir: str, server_env: dict, startup_timeout: float,
+    args: list[str] | None = None,
+) -> tuple[subprocess.Popen, dict, str | None]:
+    """Spawn the server and wait for health; if a probed-healthy
+    accelerator still wedges during startup (warmup runs much bigger
+    compiles than the probe), kill and retry once on CPU. Returns
+    (server, health, fallback_note_or_None)."""
+    server = _spawn_server(workdir, server_env, args)
+    try:
+        health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
+        return server, health, None
+    except RuntimeError:
+        if server_env.get("MLAPI_TPU_PLATFORM") == "cpu":
+            server.kill()
+            server.wait()
+            raise  # already the CPU fallback; a respawn can't help
+        server.kill()
+        server.wait()
+        note = (
+            "server failed to come healthy on the probed accelerator; "
+            "measured on CPU fallback (same serving stack)"
+        )
+        server = _spawn_server(workdir, {"MLAPI_TPU_PLATFORM": "cpu"}, args)
+        health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
+        return server, health, note
 
 
 def _choose_backend() -> tuple[dict | None, str | None, dict]:
@@ -257,45 +287,13 @@ def bench_generate() -> None:
         server_env = {"MLAPI_TPU_PLATFORM": "cpu"}
         ck = _write_demo_gpt_checkpoint(workdir, server_env)
 
-    server = subprocess.Popen(
-        [
-            sys.executable, "-m", "mlapi_tpu.serving",
-            "--checkpoint", ck, "--port", str(PORT),
-        ],
-        stdout=open(os.path.join(workdir, "server.log"), "a"),
-        stderr=subprocess.STDOUT,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        env=dict(os.environ, **server_env),
-    )
     n_new = 32
     payload = {"text": "the quick brown fox", "max_new_tokens": n_new}
+    server, health, fb_note = _start_with_cpu_fallback(
+        workdir, server_env, startup_timeout, args=["--checkpoint", ck]
+    )
+    note_extra = fb_note or note_extra
     try:
-        try:
-            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
-        except RuntimeError:
-            if server_env.get("MLAPI_TPU_PLATFORM") == "cpu":
-                raise  # already the CPU fallback; a respawn can't help
-            # Probe passed its tiny round trip but the server wedged in
-            # warmup (the bigger compiles): same honest CPU fallback as
-            # the /predict bench.
-            server.kill()
-            server.wait()
-            note_extra = (
-                "server failed to come healthy on the probed accelerator; "
-                "measured on CPU fallback (same serving stack)"
-            )
-            server_env = {"MLAPI_TPU_PLATFORM": "cpu"}
-            server = subprocess.Popen(
-                [
-                    sys.executable, "-m", "mlapi_tpu.serving",
-                    "--checkpoint", ck, "--port", str(PORT),
-                ],
-                stdout=open(os.path.join(workdir, "server.log"), "a"),
-                stderr=subprocess.STDOUT,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                env=dict(os.environ, **server_env),
-            )
-            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
 
         async def measure():
             await run_load(  # warm residual shapes
@@ -360,23 +358,11 @@ def main() -> None:
 
     probe, note_extra, server_env = _choose_backend()
 
-    server = _spawn_server(workdir, server_env)
+    server, health, fb_note = _start_with_cpu_fallback(
+        workdir, server_env, startup_timeout
+    )
+    note_extra = fb_note or note_extra
     try:
-        try:
-            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
-        except RuntimeError:
-            if server_env.get("MLAPI_TPU_PLATFORM") == "cpu":
-                raise  # already the CPU fallback; a respawn can't help
-            # Probe said healthy but the server still wedged: one CPU retry.
-            server.kill()
-            server.wait()
-            note_extra = (
-                "server failed to come healthy on the probed accelerator; "
-                "measured on CPU fallback (same serving stack)"
-            )
-            server = _spawn_server(workdir, {"MLAPI_TPU_PLATFORM": "cpu"})
-            health = wait_healthy(PORT, timeout_s=startup_timeout, proc=server)
-
         assert health["status"] == "ok", health
         n_chips = int(health.get("device_count", 1))
 
@@ -452,11 +438,27 @@ if __name__ == "__main__":
         # the full implementation lives in mlapi_tpu.train.bench.
         _, _, env = _choose_backend()
         os.environ.update(env)
-        subprocess.run(
-            [sys.executable, "-m", "mlapi_tpu.train", "--bench"],
-            check=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            env=dict(os.environ),
-        )
+        cmd = [sys.executable, "-m", "mlapi_tpu.train", "--bench"]
+        if env.get("MLAPI_TPU_PLATFORM") == "cpu":
+            # BERT-base fwd+bwd on the CPU fallback takes unboundedly
+            # long on a small host; bench the presets that finish.
+            for preset in ("fashion-mlp", "criteo-widedeep"):
+                subprocess.run(
+                    [*cmd, "--preset", preset],
+                    check=True,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    env=dict(os.environ),
+                    timeout=float(
+                        os.environ.get("BENCH_TRAIN_TIMEOUT_S", "900")
+                    ),
+                )
+        else:
+            subprocess.run(
+                cmd,
+                check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=dict(os.environ),
+                timeout=float(os.environ.get("BENCH_TRAIN_TIMEOUT_S", "1800")),
+            )
     else:
         main()

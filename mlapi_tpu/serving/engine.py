@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import bisect
+import functools
 from typing import Sequence
 
 import jax
@@ -340,6 +341,27 @@ class _SyncSink:
             self._out.extend(item["token_ids"])
 
 
+@functools.cache
+def _compact_fn():
+    """Jitted leading-dim gather over the whole decode state: select
+    the still-active rows (plus dummy repeats up to a power of two)
+    out of the cache/token/param vectors. The gather shrinks the
+    leading dim, so it cannot alias the old buffers — peak HBM during
+    a compaction is briefly old + new cache (then the old one frees).
+    Compiled once per (from, to, cache-tier) shape; compaction halves
+    the batch at most once per chunk, so the shape set is the halving
+    chain the warmup grid covers."""
+
+    def _run(cache, tok, n_pad, temps, keys, sel):
+        gather = lambda a: a[sel]  # noqa: E731
+        return (
+            jax.tree.map(gather, cache),
+            tok[sel], n_pad[sel], temps[sel], keys[sel],
+        )
+
+    return jax.jit(_run)
+
+
 class TextGenerationEngine:
     """Serving engine for generative LMs (``gpt_lm``).
 
@@ -414,6 +436,7 @@ class TextGenerationEngine:
         self.chunk_calls = 0
         self.rejected = 0
         self.cancelled_batches = 0
+        self.compactions = 0
 
     @property
     def queue_depth(self) -> int:
@@ -524,15 +547,54 @@ class TextGenerationEngine:
                 jnp.asarray(n_pad), jnp.asarray(temps), jnp.asarray(key_data)
             )
             pos, step = bucket, 1
-            while produced < n_new_max:
-                if all(
-                    done[i] or r.cancelled for i, r in enumerate(reqs)
-                ):
+            # rows[i]: request i's current row in the (possibly
+            # compacted) device batch. Rows are independent (per-row
+            # mask/positions/PRNG streams), so gathering live rows
+            # into a smaller warmed program changes nothing but cost.
+            rows = list(range(b))
+            b_cur = b_pad
+            while True:
+                live = [
+                    i for i, r in enumerate(reqs)
+                    if not done[i] and not r.cancelled
+                ]
+                if not live:
                     # Every remaining consumer disconnected: stop
                     # burning device time on abandoned work.
                     if not all(done):
                         self.cancelled_batches += 1
                     break
+                # The batch only needs to run as long as a live
+                # request still wants tokens (a finished or cancelled
+                # straggler must not keep the loop decoding to the
+                # global max); n_new_max keeps the cache-window clamp.
+                if produced >= min(
+                    n_new_max, max(reqs[i].n_new for i in live)
+                ):
+                    break
+                want_b = 1
+                while want_b < len(live):
+                    want_b *= 2
+                # At most one halving per chunk: keeps the compaction
+                # shape set to the halving chain (8→4→2→1), which the
+                # warmup grid compiles — an arbitrary (from, to) jump
+                # would compile on the request path.
+                want_b = max(want_b, b_cur // 2)
+                if want_b < b_cur:
+                    # Batch compaction: half (or more) of the rows
+                    # finished — continue in the next-smaller
+                    # power-of-two program on the live rows only.
+                    sel = [rows[i] for i in live]
+                    sel += [sel[0]] * (want_b - len(sel))
+                    cache, tok, n_pad_j, temps_j, keys_j = _compact_fn()(
+                        cache, tok, n_pad_j, temps_j, keys_j,
+                        jnp.asarray(np.asarray(sel, np.int32)),
+                    )
+                    rows = [None] * b
+                    for row, i in enumerate(live):
+                        rows[i] = row
+                    b_cur = want_b
+                    self.compactions += 1
                 self.chunk_calls += 1
                 toks, cache, tok = dc(
                     self.params, cache, tok, jnp.int32(pos),
@@ -540,14 +602,16 @@ class TextGenerationEngine:
                 )
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
-                for i, r in enumerate(reqs):
-                    if done[i] or r.cancelled:
+                for i in live:
+                    r = reqs[i]
+                    if r.cancelled:
                         continue
                     want = r.n_new - produced
                     if want > 0:
                         r.push(
                             {"token_ids":
-                                 toks_host[i, : min(want, got)].tolist()}
+                                 toks_host[rows[i], : min(want, got)]
+                                 .tolist()}
                         )
                         if want <= got:
                             r.push(None)
@@ -761,17 +825,29 @@ class TextGenerationEngine:
             )
             if n_new < 1:
                 continue
+            # Largest n_new that still lands in the default cache tier
+            # (so warm programs are byte-identical to default traffic).
+            tier = self.chunk
+            while tier < self.default_max_new_tokens:
+                tier *= 2
             for bsz in batches:
+                # Row 0 runs two chunks, the rest finish after chunk
+                # one: chunk 1 executes the FULL-width decode program,
+                # then the batch compacts bsz → bsz/2 for chunk 2 —
+                # one _run_batch call compiles the prefill, the
+                # decode-chunk program, and that halving's compaction
+                # gather. Across the grid this covers the whole
+                # halving chain (8→4, 4→2, 2→1). All n_new values stay
+                # within the default cache tier, so these are the
+                # exact programs default traffic reuses.
+                long_n = min(n_new, 2 * self.chunk + 1, tier)
                 sinks = []
-                for _ in range(bsz):
+                for j in range(bsz):
                     row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
-                    # chunk+1 new tokens: compiles the same prefill
-                    # (cache tier is keyed on max(n_new, default) in
-                    # _cache_len) and the same decode-chunk program as
-                    # a full default-length request, at one decode
-                    # execution instead of default/chunk of them.
                     req = GenRequest(
-                        row, 1, min(n_new, self.chunk + 1), 0.0, 0, None
+                        row, 1,
+                        long_n if j == 0 else min(2, long_n),
+                        0.0, 0, None,
                     )
                     sinks.append(_SyncSink(req, []))
                 self._run_batch(sinks)

@@ -28,8 +28,10 @@ def run(
     out: str | None,
     *,
     save_every: int = 0,
+    keep_last: int = 0,
     resume: bool = True,
     profile_dir: str | None = None,
+    debug_checks: bool = False,
 ) -> dict:
     import jax
 
@@ -94,8 +96,10 @@ def run(
         eval_every=cfg.eval_every,
         checkpoint_dir=train_state_dir if save_every else None,
         save_every=save_every,
+        keep_last=keep_last,
         resume=resume,
         profile_dir=profile_dir,
+        debug_checks=debug_checks,
     )
     _log.info(
         "%s: %d steps in %.2fs, final_loss=%.4f, test_accuracy=%s",
@@ -165,6 +169,17 @@ def main(argv=None) -> None:
         help="checkpoint full train state every N steps (enables resume)",
     )
     parser.add_argument(
+        "--keep-last", type=int, default=0,
+        help="retain only the newest N committed train-state checkpoints "
+             "(0 keeps everything)",
+    )
+    parser.add_argument(
+        "--debug-checks", action="store_true",
+        help="compile the step through checkify: NaN/inf anywhere inside "
+             "the step raises at the op that produced it (costs a host "
+             "sync per step)",
+    )
+    parser.add_argument(
         "--no-resume", action="store_true",
         help="ignore existing train-state checkpoints",
     )
@@ -200,8 +215,10 @@ def main(argv=None) -> None:
         cfg,
         args.out,
         save_every=args.save_every,
+        keep_last=args.keep_last,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
+        debug_checks=args.debug_checks,
     )
     print(json.dumps(summary))
 

@@ -227,3 +227,35 @@ async def test_streaming_ndjson(gpt_checkpoint):
         assert done["text"] == ref["text"]
     finally:
         await app.shutdown()
+
+
+def test_mixed_length_batch_compacts_and_matches(gpt_checkpoint):
+    """A short request batched with long ones must not cost the batch
+    its row for the whole decode: once >=half the rows finish, the
+    loop gathers live rows into the next-smaller power-of-two program
+    (batch compaction). Outputs are row-independent, so compaction
+    must be invisible in the tokens."""
+    from mlapi_tpu.serving.engine import _SyncSink
+
+    engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    singles = [
+        engine.generate_text("abab", max_new_tokens=n, temperature=t, seed=s)
+        for n, t, s in ((4, 0.0, 0), (4, 0.7, 1), (4, 0.0, 2), (40, 0.7, 3))
+    ]
+
+    outs = [[] for _ in range(4)]
+    sinks = []
+    for (n, t, s), out in zip(
+        ((4, 0.0, 0), (4, 0.7, 1), (4, 0.0, 2), (40, 0.7, 3)), outs
+    ):
+        req = engine._encode("abab", n, t, s, None)
+        sinks.append(_SyncSink(req, out))
+    engine._run_batch(sinks)
+    for sink in sinks:
+        assert sink.error is None
+
+    # 3 of 4 rows finish after 4 tokens -> the batch compacts 4 -> 1
+    # and keeps decoding only the 40-token row.
+    assert engine.compactions >= 1
+    for single, got in zip(singles, outs):
+        assert got == single["token_ids"]
