@@ -57,6 +57,20 @@ class HTTPError(Exception):
         self.headers = headers
 
 
+class _Route:
+    """One registered route with its handler introspection done once
+    at registration (signature/type-hint walking is far too slow for
+    the per-request path)."""
+
+    __slots__ = ("handler", "body_model", "body_param", "wants_request")
+
+    def __init__(self, handler, body_model, body_param, wants_request):
+        self.handler = handler
+        self.body_model = body_model
+        self.body_param = body_param
+        self.wants_request = wants_request
+
+
 class Request:
     """One HTTP request: ASGI scope + fully-read body."""
 
@@ -166,7 +180,7 @@ class App:
 
     def __init__(self, title: str = "mlapi-tpu"):
         self.title = title
-        self._routes: dict[tuple[str, str], tuple[Handler, type | None]] = {}
+        self._routes: dict[tuple[str, str], _Route] = {}
         self._middleware: list[Middleware] = []
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
         self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
@@ -176,8 +190,16 @@ class App:
     # -- registration -----------------------------------------------------
     def route(self, method: str, path: str):
         def deco(fn: Handler) -> Handler:
+            # All handler introspection happens HERE, once: signature
+            # walking + get_type_hints per request was ~30% of
+            # event-loop time under load (profiled at c64).
             body_model = _find_body_model(fn)
-            self._routes[(method.upper(), path)] = (fn, body_model)
+            self._routes[(method.upper(), path)] = _Route(
+                fn,
+                body_model,
+                _body_param_name(fn) if body_model is not None else None,
+                _wants_request(fn),
+            )
             self._openapi_cache = None
             return fn
 
@@ -200,7 +222,8 @@ class App:
             return self._openapi_cache
         paths: dict[str, dict] = {}
         schemas: dict[str, Any] = {}
-        for (method, path), (fn, body_model) in sorted(self._routes.items()):
+        for (method, path), route in sorted(self._routes.items()):
+            fn, body_model = route.handler, route.body_model
             if path in ("/openapi.json", "/docs"):
                 continue
             doc = inspect.getdoc(fn) or ""
@@ -311,7 +334,8 @@ class App:
             if any(p == request.path for _, p in self._routes):
                 return json_response({"detail": "Method Not Allowed"}, 405)
             return json_response({"detail": "Not Found"}, 404)
-        handler, body_model = self._routes[key]
+        route = self._routes[key]
+        handler, body_model = route.handler, route.body_model
 
         kwargs: dict[str, Any] = {}
         if body_model is not None:
@@ -332,9 +356,9 @@ class App:
                     return json_response({"detail": "invalid JSON body"}, 400)
                 # FastAPI-compatible 422 shape.
                 return json_response({"detail": errors}, 422)
-            kwargs[_body_param_name(handler)] = payload
+            kwargs[route.body_param] = payload
 
-        if _wants_request(handler):
+        if route.wants_request:
             kwargs["request"] = request
 
         result = await handler(**kwargs)

@@ -24,6 +24,7 @@ pickle-load + two matmuls inline on the event loop (``main.py:19-22``).
 from __future__ import annotations
 
 import asyncio
+import queue
 import threading
 
 import numpy as np
@@ -31,6 +32,72 @@ import numpy as np
 from mlapi_tpu.utils.logging import get_logger
 
 _log = get_logger("serving.batcher")
+
+
+class _WorkerPool:
+    """Reusable daemon worker threads that heal around wedged device
+    calls: ``submit`` hands work to an idle worker, or spawns a fresh
+    one when none is idle. A worker stuck inside a device call (lost
+    transport RPC) simply never returns to the idle set — it is out of
+    circulation, and the next batch gets a new thread — which keeps
+    the original per-batch-thread recovery property without paying a
+    thread start per batch (~50 µs each, ~20% of event-loop time at
+    full load). Steady-state thread count equals peak concurrent
+    batches (≤ the batcher's max_inflight)."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._work: queue.SimpleQueue = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._spawned = 0
+
+    def submit(self, fn) -> None:
+        with self._lock:
+            spawn = self._idle == 0
+            if spawn:
+                self._spawned += 1
+                n = self._spawned
+            else:
+                self._idle -= 1
+            work = self._work
+        if spawn:
+            threading.Thread(
+                target=self._run, args=(work,),
+                name=f"{self._name}-{n}", daemon=True,
+            ).start()
+        work.put(fn)
+
+    def close(self) -> None:
+        """Release every live worker. Workers are bound to the queue
+        they were spawned with; swapping in a fresh queue makes stale
+        sentinels (destined for forever-wedged workers) and any stale
+        work die with the old queue instead of poisoning a restarted
+        pool."""
+        with self._lock:
+            n = self._spawned
+            self._spawned = 0
+            self._idle = 0
+            old = self._work
+            self._work = queue.SimpleQueue()
+        for _ in range(n):
+            old.put(None)
+
+    def _run(self, work: queue.SimpleQueue) -> None:
+        while True:
+            fn = work.get()
+            if fn is None:
+                return  # pool closed
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — workers must survive
+                _log.exception("dispatch worker error")
+            finally:
+                with self._lock:
+                    if work is self._work:
+                        self._idle += 1
+                    else:
+                        return  # pool closed while we were busy
 
 
 class OverloadedError(Exception):
@@ -66,6 +133,7 @@ class MicroBatcher:
         self._inflight: asyncio.Semaphore | None = None
         self._task: asyncio.Task | None = None
         self._resolvers: set[asyncio.Task] = set()
+        self._pool = _WorkerPool("tpu-dispatch")
         # Stats (read by /metrics and the coalescing test).
         self.device_calls = 0
         self.requests = 0
@@ -97,6 +165,7 @@ class MicroBatcher:
             self._task = None
         if self._resolvers:
             await asyncio.gather(*list(self._resolvers), return_exceptions=True)
+        self._pool.close()  # release idle dispatch workers
         while not self._queue.empty():
             _, fut = self._queue.get_nowait()
             if not fut.done():
@@ -153,15 +222,11 @@ class MicroBatcher:
             resolver.add_done_callback(self._resolvers.discard)
 
     def _dispatch_thread(self, loop, batch: np.ndarray) -> asyncio.Future:
-        """Run one device call on its own daemon thread.
-
-        A dedicated thread per batch (not a fixed pool): if a call
-        wedges (lost transport RPC), only that thread is stranded —
-        after the watchdog fires, fresh batches still get fresh
-        threads, so the batcher recovers instead of exhausting a pool
-        whose every worker is stuck. Steady-state thread count equals
-        in-flight batches (≤ max_inflight).
-        """
+        """Run one device call on a pool worker thread. The pool heals
+        around wedged calls (see :class:`_WorkerPool`): a stranded
+        worker stays stranded, and fresh batches get fresh threads —
+        the batcher recovers instead of exhausting a fixed pool whose
+        every worker is stuck."""
         fut: asyncio.Future = loop.create_future()
         self.device_calls += 1
 
@@ -173,9 +238,7 @@ class MicroBatcher:
             else:
                 loop.call_soon_threadsafe(self._finish_future, fut, out, None)
 
-        threading.Thread(
-            target=runner, name="tpu-dispatch", daemon=True
-        ).start()
+        self._pool.submit(runner)
         return fut
 
     @staticmethod

@@ -260,15 +260,21 @@ async def _read_request(reader: asyncio.StreamReader) -> _ParsedRequest | None:
             raise HttpProtocolError(400, f"malformed header line: {line!r}")
         headers.append((key.strip().lower(), value.strip()))
 
-    hmap = dict(headers)
+    # Framing headers via one linear scan (no dict build per request).
+    te = clen = None
+    for k, v in headers:
+        if k == b"content-length":
+            clen = v
+        elif k == b"transfer-encoding":
+            te = v
     body = b""
-    if b"transfer-encoding" in hmap:
-        if hmap[b"transfer-encoding"].lower() != b"chunked":
+    if te is not None:
+        if te.lower() != b"chunked":
             raise HttpProtocolError(501, "unsupported transfer-encoding")
         body = await _read_chunked(reader)
-    elif b"content-length" in hmap:
+    elif clen is not None:
         try:
-            n = int(hmap[b"content-length"])
+            n = int(clen)
         except ValueError:
             raise HttpProtocolError(400, "bad content-length") from None
         if n > MAX_BODY_BYTES:
@@ -313,7 +319,13 @@ async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
 
 
 def _wants_keep_alive(request: _ParsedRequest) -> bool:
-    conn = dict(request.headers).get(b"connection", b"").lower()
+    # Linear scan, no dict build: this runs per request and a request
+    # carries a handful of headers. No early break — duplicates keep
+    # the dict's last-wins semantics.
+    conn = b""
+    for k, v in request.headers:
+        if k == b"connection":
+            conn = v.lower()
     if request.version == "1.0":
         return conn == b"keep-alive"
     return conn != b"close"
