@@ -295,6 +295,15 @@ def bench_generate() -> None:
     note_extra = fb_note or note_extra
     try:
 
+        # Mixed workload: short and long requests in one batch — the
+        # case batch compaction exists for (short rows finish, the
+        # batch halves onto the live rows instead of decoding dead
+        # rows to the global max).
+        mixed = [
+            {"text": "the quick brown fox", "max_new_tokens": m}
+            for m in (8, 8, 8, n_new)
+        ]
+
         async def measure():
             await run_load(  # warm residual shapes
                 "127.0.0.1", PORT, "/generate", payload=payload,
@@ -308,11 +317,26 @@ def bench_generate() -> None:
                 "127.0.0.1", PORT, "/generate", payload=payload,
                 concurrency=8, duration_s=8.0,
             )
-            return single, batched
+            mixed_r = await run_load(
+                "127.0.0.1", PORT, "/generate", payload=mixed,
+                concurrency=8, duration_s=8.0,
+            )
+            return single, batched, mixed_r
 
-        single, batched = asyncio.run(measure())
+        single, batched, mixed_r = asyncio.run(measure())
         single_tps = single.throughput * n_new
         batched_tps = batched.throughput * n_new
+        # Weight by ACTUAL completions per template: closed-loop
+        # workers finish short requests at a higher rate, so the
+        # offered mix's mean would overstate tokens/s.
+        mixed_tokens = sum(
+            count * mixed[idx]["max_new_tokens"]
+            for idx, count in mixed_r.per_template.items()
+        )
+        mixed_tps = (
+            mixed_tokens / mixed_r.wall_seconds
+            if mixed_r.wall_seconds else 0.0
+        )
         print(
             json.dumps(
                 {
@@ -333,7 +357,14 @@ def bench_generate() -> None:
                         "batched_p50_ms": round(
                             batched.quantile(0.5) or -1, 1
                         ),
-                        "errors": single.errors + batched.errors,
+                        "mixed_tokens_per_s": round(mixed_tps, 1),
+                        "mixed_req_per_s": round(mixed_r.throughput, 1),
+                        "mixed_p50_ms": round(
+                            mixed_r.quantile(0.5) or -1, 1
+                        ),
+                        "errors": (
+                            single.errors + batched.errors + mixed_r.errors
+                        ),
                         "backend": health.get("backend"),
                         "note": note_extra
                         or "vs_baseline here = batched/single speedup",

@@ -253,6 +253,8 @@ class GptLM:
         temperature=0.0,
         rng: jax.Array | None = None,
         pad_lens=None,
+        top_k=0,
+        top_p=1.0,
     ):
         """Greedy (``temperature=0``) or sampled generation.
 
@@ -263,7 +265,10 @@ class GptLM:
 
         ``temperature`` may be a float or a per-row ``[B]`` array; it
         is a *traced* argument, so a client cycling temperatures never
-        forces recompilation. ``pad_lens`` (``[B]`` int) marks how many
+        forces recompilation. ``top_k``/``top_p`` (scalar or per-row,
+        traced likewise) restrict sampling to the k highest logits /
+        the smallest nucleus reaching cumulative probability p —
+        ``0``/``1.0`` disable them. ``pad_lens`` (``[B]`` int) marks how many
         left-pad tokens each row carries: pads are masked out of
         attention and position embeddings are shifted, so bucketed
         serving produces bucket-invariant outputs. Sampling uses one
@@ -292,8 +297,11 @@ class GptLM:
             if pad_lens is None
             else jnp.asarray(pad_lens, jnp.int32)
         )
+        top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+        top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
         return _generate_fn(self, max_new_tokens)(
-            params, prompt_ids, jax.random.key_data(row_keys), temps, n_pad
+            params, prompt_ids, jax.random.key_data(row_keys), temps, n_pad,
+            top_k, top_p,
         )
 
     # ------------------------------------------------------------------
@@ -324,19 +332,78 @@ class GptLM:
         return specs
 
 
-def _pick_token(temps, logits, key_data, step):
+_FILTERED = -1e30  # finite stand-in for -inf (f32-safe; prob == 0)
+
+
+def _filter_top_k_top_p(scaled, top_k, top_p):
+    """Per-row nucleus filtering on temperature-scaled logits
+    ``[B, V]``: keep the ``top_k[b]`` highest logits (``<= 0`` or
+    ``>= V`` disables), then the smallest prefix of the sorted
+    distribution whose cumulative probability reaches ``top_p[b]``
+    (``<= 0`` or ``>= 1`` disables; the argmax token always
+    survives). Both are traced vectors, so no program is keyed on
+    them; cost is two per-row sorts — noise next to the decode
+    matmuls."""
+    v = scaled.shape[-1]
+
+    def _one(lg, k, p):
+        s = jnp.sort(lg)[::-1]  # descending — the ONE sort per row
+        k_eff = jnp.clip(k, 1, v)
+        kth = jax.lax.dynamic_index_in_dim(s, k_eff - 1, keepdims=False)
+        apply_k = (k > 0) & (k < v)
+        lg = jnp.where(apply_k, jnp.where(lg >= kth, lg, _FILTERED), lg)
+        # The k-filtered sorted vector is s with positions >= k_eff
+        # masked — no second sort. (Ties at the kth logit: lg keeps
+        # all tied tokens while the positional mask counts exactly k
+        # toward the nucleus — the same keep-the-ties behavior a
+        # re-sort would give, since thr only tightens.)
+        s2 = jnp.where(
+            apply_k & (jnp.arange(v) >= k_eff), _FILTERED, s
+        )
+        probs = jax.nn.softmax(s2)
+        cum = jnp.cumsum(probs)
+        keep = (cum - probs) < p  # prefix mask; index 0 always kept
+        thr = jnp.min(jnp.where(keep, s2, jnp.inf))
+        apply_p = (p > 0.0) & (p < 1.0)
+        return jnp.where(
+            apply_p, jnp.where(lg >= thr, lg, _FILTERED), lg
+        )
+
+    return jax.vmap(_one)(scaled, top_k, top_p)
+
+
+def _pick_token(temps, logits, key_data, step, top_k=None, top_p=None):
     """Next token per row: greedy where ``temps[b] <= 0``, else sampled
-    from ``logits / temps[b]`` with the row's own PRNG stream
-    (``fold_in(row_key, step)``) — a row's tokens do not depend on
+    from ``logits / temps[b]`` — optionally top-k/top-p (nucleus)
+    filtered — with the row's own PRNG stream
+    (``fold_in(row_key, step)``): a row's tokens do not depend on
     which batch slot it landed in."""
+    b = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / safe_t[:, None]
+    if top_k is None:
+        top_k = jnp.zeros((b,), jnp.int32)
+    if top_p is None:
+        top_p = jnp.ones((b,), jnp.float32)
+    v = logits.shape[-1]
+    need = jnp.any((top_k > 0) & (top_k < v)) | jnp.any(
+        (top_p > 0.0) & (top_p < 1.0)
+    )
+    # cond, not where: batches with no filtering requested (greedy /
+    # plain temperature) skip the per-row sorts at runtime.
+    scaled = jax.lax.cond(
+        need,
+        lambda s: _filter_top_k_top_p(s, top_k, top_p),
+        lambda s: s,
+        scaled,
+    )
     keys = jax.vmap(
         lambda kd: jax.random.fold_in(jax.random.wrap_key_data(kd), step)
     )(key_data)
     sampled = jax.vmap(
         lambda k, lg: jax.random.categorical(k, lg)
-    )(keys, logits / safe_t[:, None]).astype(jnp.int32)
+    )(keys, scaled).astype(jnp.int32)
     return jnp.where(temps > 0.0, sampled, greedy)
 
 
@@ -391,7 +458,7 @@ def _prefill_core(model: GptLM, params, prompt_ids, n_pad, total_len: int):
 
 def _decode_scan(
     model: GptLM, params, cache, tok, pos, n_pad, temps, key_data,
-    n_steps: int, step0,
+    n_steps: int, step0, top_k=None, top_p=None,
 ):
     """``n_steps`` cached decode steps under one ``lax.scan``.
 
@@ -407,7 +474,7 @@ def _decode_scan(
         logits, cache = model.decode_step(
             params, cache, tok[:, None], pos, n_pad
         )
-        nxt = _pick_token(temps, logits, key_data, i)
+        nxt = _pick_token(temps, logits, key_data, i, top_k, top_p)
         return (cache, nxt, pos + 1), nxt
 
     (cache, tok, _), toks = jax.lax.scan(
@@ -422,17 +489,17 @@ def _generate_fn(model: GptLM, max_new_tokens: int):
     token count); temperature, pad widths, and PRNG keys are traced
     arguments (the key as raw uint32 data — see ``generate``)."""
 
-    def _run(params, prompt_ids, key_data, temps, n_pad):
+    def _run(params, prompt_ids, key_data, temps, n_pad, top_k, top_p):
         p = prompt_ids.shape[1]
         cache, first_logits = _prefill_core(
             model, params, prompt_ids, n_pad, p + max_new_tokens
         )
-        first = _pick_token(temps, first_logits, key_data, 0)
+        first = _pick_token(temps, first_logits, key_data, 0, top_k, top_p)
         if max_new_tokens == 1:
             return first[:, None]
         rest, _, _ = _decode_scan(
             model, params, cache, first, jnp.int32(p), n_pad, temps,
-            key_data, max_new_tokens - 1, jnp.int32(1),
+            key_data, max_new_tokens - 1, jnp.int32(1), top_k, top_p,
         )
         return jnp.concatenate([first[:, None], rest], axis=1)
 
@@ -448,11 +515,11 @@ def prefill_fn(model: GptLM, total_len: int):
     the serving engine's compile count stays bounded by shape buckets,
     not by request parameters."""
 
-    def _run(params, prompt_ids, key_data, temps, n_pad):
+    def _run(params, prompt_ids, key_data, temps, n_pad, top_k, top_p):
         cache, logits = _prefill_core(
             model, params, prompt_ids, n_pad, total_len
         )
-        return _pick_token(temps, logits, key_data, 0), cache
+        return _pick_token(temps, logits, key_data, 0, top_k, top_p), cache
 
     return jax.jit(_run)
 
@@ -465,10 +532,11 @@ def decode_chunk_fn(model: GptLM, chunk: int):
     each chunk updates it in place (no per-chunk HBM copy); callers
     must use the returned cache handle."""
 
-    def _run(params, cache, tok, pos, n_pad, temps, key_data, step0):
+    def _run(params, cache, tok, pos, n_pad, temps, key_data, step0,
+             top_k, top_p):
         return _decode_scan(
             model, params, cache, tok, pos, n_pad, temps, key_data,
-            chunk, step0,
+            chunk, step0, top_k, top_p,
         )
 
     return jax.jit(_run, donate_argnums=(1,))

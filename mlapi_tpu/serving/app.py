@@ -186,6 +186,8 @@ def _install_generate(app: App, engine) -> None:
         text=(str, ...),
         max_new_tokens=(int | None, None),
         temperature=(float, 0.0),
+        top_k=(int, 0),
+        top_p=(float, 1.0),
         seed=(int, 0),
         stream=(bool, False),
     )
@@ -222,12 +224,38 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
+        if req.top_k < 0:
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["top_k"],
+                        "msg": "must be >= 0 (0 disables)",
+                        "input": req.top_k,
+                    }
+                ],
+            )
+        if not 0.0 < req.top_p <= 1.0:
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["top_p"],
+                        "msg": "must be in (0, 1] (1.0 disables)",
+                        "input": req.top_p,
+                    }
+                ],
+            )
         try:
             gen = await engine.submit(
                 req.text,
                 max_new_tokens=n_new,
                 temperature=req.temperature,
                 seed=req.seed,
+                top_k=req.top_k,
+                top_p=req.top_p,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None

@@ -298,16 +298,19 @@ class GenRequest:
 
     __slots__ = (
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
-        "cancelled",
+        "cancelled", "top_k", "top_p",
     )
 
-    def __init__(self, row, used, n_new, temperature, seed, loop):
+    def __init__(self, row, used, n_new, temperature, seed, loop,
+                 top_k=0, top_p=1.0):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
         self.temperature = temperature
         self.seed = seed
         self.loop = loop
+        self.top_k = top_k        # 0 disables
+        self.top_p = top_p        # 1.0 disables
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
 
@@ -330,6 +333,7 @@ class _SyncSink:
     def __init__(self, req: "GenRequest", out_ids: list):
         self.row, self.used, self.n_new = req.row, req.used, req.n_new
         self.temperature, self.seed = req.temperature, req.seed
+        self.top_k, self.top_p = req.top_k, req.top_p
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
@@ -352,12 +356,9 @@ def _compact_fn():
     the batch at most once per chunk, so the shape set is the halving
     chain the warmup grid covers."""
 
-    def _run(cache, tok, n_pad, temps, keys, sel):
+    def _run(cache, vecs, sel):
         gather = lambda a: a[sel]  # noqa: E731
-        return (
-            jax.tree.map(gather, cache),
-            tok[sel], n_pad[sel], temps[sel], keys[sel],
-        )
+        return jax.tree.map(gather, cache), jax.tree.map(gather, vecs)
 
     return jax.jit(_run)
 
@@ -470,7 +471,7 @@ class TextGenerationEngine:
         return min(self.model.max_positions, bucket + tier)
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
-                loop) -> GenRequest:
+                loop, top_k: int = 0, top_p: float = 1.0) -> GenRequest:
         limit = self.model.max_positions - n_new
         if limit <= 0:
             raise ValueError(
@@ -488,7 +489,9 @@ class TextGenerationEngine:
         row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
         used = min(len(raw), bucket)
         row[-used:] = raw[-used:]
-        return GenRequest(row, used, n_new, temperature, seed, loop)
+        return GenRequest(
+            row, used, n_new, temperature, seed, loop, top_k, top_p
+        )
 
     # -- the batched decode (runs on a worker thread) ----------------------
     def _run_batch(self, reqs: list) -> None:
@@ -515,10 +518,14 @@ class TextGenerationEngine:
             prompt = np.full((b_pad, bucket), self.tokenizer.pad_id, np.int32)
             n_pad = np.full((b_pad,), max(bucket - 1, 0), np.int32)
             temps = np.zeros((b_pad,), np.float32)
+            topk = np.zeros((b_pad,), np.int32)
+            topp = np.ones((b_pad,), np.float32)
             for i, r in enumerate(reqs):
                 prompt[i, bucket - len(r.row):] = r.row
                 n_pad[i] = bucket - r.used
                 temps[i] = r.temperature
+                topk[i] = r.top_k
+                topp[i] = r.top_p
             zero_key = np.asarray(jax.random.key_data(jax.random.key(0)))
             key_data = np.stack(
                 [
@@ -528,9 +535,10 @@ class TextGenerationEngine:
                 + [zero_key] * (b_pad - b)
             )
 
+            topk_j, topp_j = jnp.asarray(topk), jnp.asarray(topp)
             first, cache = prefill_fn(self.model, total)(
                 self.params, jnp.asarray(prompt), jnp.asarray(key_data),
-                jnp.asarray(temps), jnp.asarray(n_pad),
+                jnp.asarray(temps), jnp.asarray(n_pad), topk_j, topp_j,
             )
             tok = first
             first_host = np.asarray(first)
@@ -586,8 +594,10 @@ class TextGenerationEngine:
                     # power-of-two program on the live rows only.
                     sel = [rows[i] for i in live]
                     sel += [sel[0]] * (want_b - len(sel))
-                    cache, tok, n_pad_j, temps_j, keys_j = _compact_fn()(
-                        cache, tok, n_pad_j, temps_j, keys_j,
+                    cache, (tok, n_pad_j, temps_j, keys_j, topk_j,
+                            topp_j) = _compact_fn()(
+                        cache,
+                        (tok, n_pad_j, temps_j, keys_j, topk_j, topp_j),
                         jnp.asarray(np.asarray(sel, np.int32)),
                     )
                     rows = [None] * b
@@ -599,6 +609,7 @@ class TextGenerationEngine:
                 toks, cache, tok = dc(
                     self.params, cache, tok, jnp.int32(pos),
                     n_pad_j, temps_j, keys_j, jnp.int32(step),
+                    topk_j, topp_j,
                 )
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
@@ -738,6 +749,8 @@ class TextGenerationEngine:
         max_new_tokens: int | None = None,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
@@ -756,7 +769,7 @@ class TextGenerationEngine:
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         req = self._encode(
             text, n_new, float(temperature), int(seed),
-            asyncio.get_running_loop(),
+            asyncio.get_running_loop(), int(top_k), float(top_p),
         )
         try:
             self._queue.put_nowait(req)
@@ -776,12 +789,17 @@ class TextGenerationEngine:
         max_new_tokens: int | None = None,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> dict:
         """One prompt → generated continuation (text + ids), decoded
         through the same chunked programs the batcher uses (so there
         is exactly one decode implementation to trust)."""
         n_new = int(max_new_tokens or self.default_max_new_tokens)
-        req = self._encode(text, n_new, float(temperature), int(seed), None)
+        req = self._encode(
+            text, n_new, float(temperature), int(seed), None,
+            int(top_k), float(top_p),
+        )
         out_ids: list[int] = []
         sink = _SyncSink(req, out_ids)
         self._run_batch([sink])

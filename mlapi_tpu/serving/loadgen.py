@@ -23,6 +23,11 @@ class LoadResult:
     errors: int
     wall_seconds: float
     latencies_ms: list[float] = field(repr=False, default_factory=list)
+    # Completions per payload template (mixed-workload runs): template
+    # index -> count. Closed-loop workers complete cheap requests at a
+    # higher rate, so aggregate metrics must weight by ACTUAL
+    # completions, not the offered mix.
+    per_template: dict = field(default_factory=dict)
 
     @property
     def throughput(self) -> float:
@@ -51,6 +56,7 @@ async def _worker(
     request_bytes: bytes,
     stop_at: float,
     result: LoadResult,
+    template_idx: int = 0,
 ) -> None:
     reader, writer = await asyncio.open_connection(host, port)
     try:
@@ -79,6 +85,9 @@ async def _worker(
                 )
             result.latencies_ms.append((time.perf_counter() - t0) * 1e3)
             result.requests += 1
+            result.per_template[template_idx] = (
+                result.per_template.get(template_idx, 0) + 1
+            )
             if status != 200:
                 result.errors += 1
     finally:
@@ -106,20 +115,27 @@ async def run_load(
     port: int,
     path: str,
     *,
-    payload: dict | None = None,
+    payload: dict | list[dict] | None = None,
     concurrency: int = 64,
     duration_s: float = 5.0,
 ) -> LoadResult:
     """``concurrency`` persistent connections, each a closed loop, for
-    ``duration_s`` seconds."""
-    request_bytes = build_request(host, path, payload)
+    ``duration_s`` seconds. A list ``payload`` is distributed
+    round-robin across the workers (mixed-workload benching)."""
+    if isinstance(payload, list):
+        requests = [build_request(host, path, p) for p in payload]
+    else:
+        requests = [build_request(host, path, payload)]
     result = LoadResult(requests=0, errors=0, wall_seconds=0.0)
     stop_at = time.perf_counter() + duration_s
     t0 = time.perf_counter()
     outcomes = await asyncio.gather(
         *(
-            _worker(host, port, request_bytes, stop_at, result)
-            for _ in range(concurrency)
+            _worker(
+                host, port, requests[i % len(requests)], stop_at, result,
+                i % len(requests),
+            )
+            for i in range(concurrency)
         ),
         return_exceptions=True,
     )

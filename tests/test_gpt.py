@@ -136,3 +136,42 @@ def test_tp_sharded_forward(model, params, mesh_2x4):
     sharded = np.asarray(jax.jit(model.apply)(placed, ids))
     ref = np.asarray(jax.jit(model.apply)(params, np.ones((8, 12), np.int32)))
     np.testing.assert_allclose(sharded, ref, atol=1e-4)
+
+
+def test_top_k_one_is_greedy():
+    """top_k=1 collapses sampling to argmax regardless of
+    temperature — and the filter params are traced, so this reuses
+    the same compiled program as unfiltered sampling."""
+    model = get_model("gpt_lm", **TINY)
+    params = model.init(jax.random.key(0))
+    prompt = np.tile(np.arange(10, 18, dtype=np.int32), (2, 1))
+    greedy = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=8, temperature=0.0
+    )
+    k1 = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=8, temperature=1.5,
+        top_k=1,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    # Tiny nucleus: only the argmax token survives the cumulative cut.
+    p_tiny = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=8, temperature=1.5,
+        top_p=1e-6,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+
+
+def test_top_k_restricts_support():
+    """Sampled ids under top_k=k must always be among the k highest
+    logits of the (recomputed) next-token distribution."""
+    model = get_model("gpt_lm", **TINY)
+    params = model.init(jax.random.key(1))
+    prompt = np.tile(np.arange(5, 13, dtype=np.int32), (1, 1))
+    k = 3
+    out = model.generate(
+        params, jnp.asarray(prompt), max_new_tokens=1, temperature=2.0,
+        top_k=k, rng=jax.random.key(7),
+    )
+    logits = np.asarray(model.apply(params, jnp.asarray(prompt)))[0, -1]
+    top = set(np.argsort(logits)[-k:].tolist())
+    assert int(out[0, 0]) in top
