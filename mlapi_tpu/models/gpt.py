@@ -59,12 +59,19 @@ class GptLM:
     attention_impl: str = "full"
     mesh: object = None  # jax.sharding.Mesh for attention_impl="ring"
     seq_axis: str = "seq"
+    # Ring options: per-block attention ("einsum" | "flash") and the
+    # zigzag stripe layout (flash-only; balances causal work to two
+    # half-block units per ring step on every device — ~2x wall time).
+    ring_block_impl: str = "einsum"
+    ring_zigzag: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.attention_impl == "ring" and self.mesh is None:
             raise ValueError('attention_impl="ring" requires a mesh')
+        if self.ring_zigzag and self.ring_block_impl != "flash":
+            raise ValueError('ring_zigzag needs ring_block_impl="flash"')
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide evenly into heads")
 
@@ -158,6 +165,8 @@ class GptLM:
                 return ring_self_attention(
                     self.mesh, q, k, v, causal=True,
                     seq_axis=self.seq_axis, head_axis="model",
+                    block_impl=self.ring_block_impl,
+                    zigzag=self.ring_zigzag,
                 )
         else:
             def attend(q, k, v):

@@ -173,7 +173,8 @@ def _out_struct(shape, dtype, like):
 
 
 def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
-    b, l, h, d = q.shape
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
     # [B, 1, L]: TPU lowering wants the last two block dims tile-
     # aligned or equal to the array dims; a (1, 1, block_k) block
     # satisfies that where a (1, block_k) block over [B, L] cannot
@@ -182,7 +183,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     # [B, L, H, D] -> [B, H, L, D]: heads become a grid dimension.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
-    grid = (b, h, l // block_q, l // block_k)
+    grid = (b, h, lq // block_q, lk // block_k)
     q_spec = pl.BlockSpec(
         (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
@@ -206,7 +207,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         out_specs=[q_spec, lse_spec],
         out_shape=[
             _out_struct(qt.shape, q.dtype, q),
-            _out_struct((b, h, l), jnp.float32, q),
+            _out_struct((b, h, lq), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -336,7 +337,8 @@ def _bwd_dkv_kernel(
 
 def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
          interpret, g_lse=None):
-    b, l, h, d = q.shape
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
     mask3 = mask.astype(jnp.float32)[:, None, :]
     qt, kt, vt, ot, gt = (
         x.transpose(0, 2, 1, 3) for x in (q, k, v, out, g)
@@ -370,7 +372,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(b, h, l // block_q, l // block_k),
+        grid=(b, h, lq // block_q, lk // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec,
                   row_spec],
         out_specs=q_spec,
@@ -398,7 +400,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(b, h, l // block_k, l // block_q),
+        grid=(b, h, lk // block_k, lq // block_q),
         in_specs=[q_spec_T, kv_spec_T, kv_spec_T, mask_spec_T, q_spec_T,
                   row_spec_T, row_spec_T],
         out_specs=[kv_spec_T, kv_spec_T],
@@ -447,6 +449,28 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _prepare(q, k, mask, causal, scale, block_q, block_k):
+    """Shared wrapper preamble: validation, scale default, block
+    clamping, default mask. Returns (mask, scale, block_q, block_k)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if causal and lq != lk:
+        raise ValueError(
+            f"causal attention needs aligned q/k lengths, got {lq} vs {lk}"
+        )
+    scale = (1.0 / d**0.5) if scale is None else scale
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"sequence lengths ({lq}, {lk}) not divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+    if mask is None:
+        mask = jnp.ones((b, lk), jnp.float32)
+    return mask, scale, block_q, block_k
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
@@ -474,17 +498,9 @@ def flash_attention(
     grid) — no ``[L, L]`` tensor in HBM in either pass.
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
     """
-    b, l, h, d = q.shape
-    scale = (1.0 / d**0.5) if scale is None else scale
-    block_q = min(block_q, l)
-    block_k = min(block_k, l)
-    if l % block_q or l % block_k:
-        raise ValueError(
-            f"sequence length {l} not divisible by blocks "
-            f"({block_q}, {block_k})"
-        )
-    if mask is None:
-        mask = jnp.ones((b, l), jnp.float32)
+    mask, scale, block_q, block_k = _prepare(
+        q, k, mask, causal, scale, block_q, block_k
+    )
     if interpret and _inside_vma_shard_map(q):
         out, _ = _jnp_flash(q, k, v, mask, causal, scale)
         return out
@@ -516,17 +532,9 @@ def flash_attention_with_lse(
     computed attention blocks be merged exactly (numerically safe
     weighted average). Used by ``ring_attention``'s flash block mode;
     differentiable through BOTH outputs."""
-    b, l, h, d = q.shape
-    scale = (1.0 / d**0.5) if scale is None else scale
-    block_q = min(block_q, l)
-    block_k = min(block_k, l)
-    if l % block_q or l % block_k:
-        raise ValueError(
-            f"sequence length {l} not divisible by blocks "
-            f"({block_q}, {block_k})"
-        )
-    if mask is None:
-        mask = jnp.ones((b, l), jnp.float32)
+    mask, scale, block_q, block_k = _prepare(
+        q, k, mask, causal, scale, block_q, block_k
+    )
     if interpret and _inside_vma_shard_map(q):
         return _jnp_flash(q, k, v, mask, causal, scale)
     return _flash(

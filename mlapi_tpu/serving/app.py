@@ -224,14 +224,18 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
-        if req.top_k < 0:
+        if not 0 <= req.top_k <= engine.model.vocab_size:
+            # Upper bound matters: an int32-overflowing value would
+            # otherwise blow up inside the coalesced batch and fail
+            # innocent co-batched requests.
             raise HTTPError(
                 422,
                 [
                     {
                         "type": "value_error",
                         "loc": ["top_k"],
-                        "msg": "must be >= 0 (0 disables)",
+                        "msg": f"must be in [0, {engine.model.vocab_size}] "
+                               "(0 disables)",
                         "input": req.top_k,
                     }
                 ],

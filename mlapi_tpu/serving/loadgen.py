@@ -85,11 +85,15 @@ async def _worker(
                 )
             result.latencies_ms.append((time.perf_counter() - t0) * 1e3)
             result.requests += 1
-            result.per_template[template_idx] = (
-                result.per_template.get(template_idx, 0) + 1
-            )
             if status != 200:
                 result.errors += 1
+            else:
+                # Only SUCCESSFUL completions count toward the
+                # per-template tally — a shed/errored request must not
+                # credit its tokens to throughput.
+                result.per_template[template_idx] = (
+                    result.per_template.get(template_idx, 0) + 1
+                )
     finally:
         writer.close()
         try:
