@@ -133,3 +133,89 @@ def test_debug_checks_catches_nan(tmp_path):
     model = get_model("linear", num_features=4, num_classes=3)
     with pytest.raises(Exception, match="(?i)nan"):
         fit(model, PoisonedSplits(), steps=5, debug_checks=True)
+
+
+def test_cli_survives_sigkill_and_resumes(tmp_path):
+    """Crash-consistency end to end through the CLI: SIGKILL the
+    training process mid-run, rerun the same command, and the run
+    resumes from the newest committed step and finishes. This is the
+    real failure-recovery contract — no cooperative shutdown, no
+    atexit hooks, just the commit-marker checkpoint protocol."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    ck = tmp_path / "ts"
+    yaml_cfg = tmp_path / "cfg.yaml"
+    yaml_cfg.write_text(
+        "name: kill-test\n"
+        "model: linear\n"
+        "model_kwargs: {num_features: 784, num_classes: 10}\n"
+        "dataset: mnist\n"
+        "dataset_kwargs: {synthetic_train: 2048, synthetic_test: 128}\n"
+        "steps: 4000\n"
+        "batch_size: 128\n"
+        "learning_rate: 0.01\n"
+        f"checkpoint_dir: {ck}\n"
+    )
+    from pathlib import Path
+
+    repo_root = Path(__file__).resolve().parents[1]
+    env = dict(
+        os.environ,
+        MLAPI_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+    )
+    cmd = [
+        sys.executable, "-m", "mlapi_tpu.train",
+        "--config", str(yaml_cfg),
+        "--save-every", "200", "--keep-last", "2",
+    ]
+    # Log to a file, not a PIPE: an undrained pipe can block the child
+    # in write() before it ever commits a checkpoint.
+    log_path = tmp_path / "run1.log"
+    with open(log_path, "wb") as log:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+            cwd=repo_root,
+        )
+    try:
+        # Wait for at least one COMMITTED checkpoint, then pull the plug.
+        deadline = time.time() + 120
+        committed = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"trainer exited early: {log_path.read_text()[-500:]}"
+                )
+            steps = sorted(ck.glob("step_*/MANIFEST.json"))
+            if steps:
+                committed = steps[-1].parent.name
+                break
+            time.sleep(0.2)
+        assert committed, "no checkpoint committed within 120s"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # Rerun: must resume (not restart) and complete.
+    out = subprocess.run(
+        cmd, env=env, capture_output=True, timeout=300, cwd=repo_root,
+    )
+    assert out.returncode == 0, out.stdout.decode()[-800:]
+    text = out.stdout.decode() + out.stderr.decode()
+    assert "resuming from" in text, text[-800:]
+    import json as _json
+
+    summary = _json.loads(
+        [l for l in out.stdout.decode().splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["steps"] == 4000
+    # keep_last=2 retention held across the crash/resume cycle.
+    kept = sorted(p.name for p in ck.iterdir() if p.name.startswith("step_"))
+    assert len(kept) <= 3, kept  # 2 committed + possibly 1 in-flight
