@@ -114,7 +114,8 @@ def _fwd_kernel(
     def _finish():
         l = l_s[:, :1]
         o_ref[0, 0] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-30))
+        # [block_q, 1] column write — sublane-aligned, no relayout.
+        lse_ref[0, 0] = m_s[:, :1] + jnp.log(jnp.maximum(l_s[:, :1], 1e-30))
 
 
 def _jnp_flash(q, k, v, mask, causal, scale):
@@ -193,8 +194,13 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     mask_spec = pl.BlockSpec(
         (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
     )
+    # LSE rides as [B, H, L, 1]: Mosaic requires the last two block
+    # dims tile-aligned (8, 128) or equal to the array dims; a
+    # (1, 1, block_q) block over [B, H, L] fails that for H > 1,
+    # while (1, 1, block_q, 1) passes (block_q % 8 == 0, trailing
+    # 1 == array dim) and keeps the row state sublane-aligned.
     lse_spec = pl.BlockSpec(
-        (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+        (1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
 
     out, lse = pl.pallas_call(
@@ -207,7 +213,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         out_specs=[q_spec, lse_spec],
         out_shape=[
             _out_struct(qt.shape, q.dtype, q),
-            _out_struct((b, h, lq), jnp.float32, q),
+            _out_struct((b, h, lq, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -216,7 +222,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt, mask3)
-    return out.transpose(0, 2, 1, 3), lse
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 def _bwd_dq_kernel(
@@ -238,8 +244,8 @@ def _bwd_dq_kernel(
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, None]          # [block_q, 1]
-        delta = delta_ref[0, 0][:, None]      # [block_q, 1]
+        lse = lse_ref[0, 0]                   # [block_q, 1] column
+        delta = delta_ref[0, 0]               # [block_q, 1] column
 
         # All matmuls take native-dtype (bf16) operands with f32
         # accumulation — the MXU recipe; f32 lives only in the
@@ -295,8 +301,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0]                   # [block_q, 1] column
+        delta = delta_ref[0, 0]               # [block_q, 1] column
 
         # Native-dtype matmul operands, f32 accumulation (MXU recipe).
         s = (
@@ -353,6 +359,10 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     )  # [B, H, L]
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
+    # Row vectors ride as [B, H, L, 1] (same Mosaic tiling reason as
+    # the forward's LSE output — see _fwd's lse_spec comment).
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
 
     q_spec = pl.BlockSpec(
         (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
@@ -364,7 +374,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
         (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
     )
     row_spec = pl.BlockSpec(
-        (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+        (1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
 
     dq = pl.pallas_call(
@@ -379,7 +389,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
         out_shape=_out_struct(qt.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, mask3, gt, lse, delta)
+    )(qt, kt, vt, mask3, gt, lse4, delta4)
 
     # dk/dv: k-tiles accumulate over q-tiles — swap the outer/inner
     # grid roles (index maps see (bi, hi, ki, qi)).
@@ -393,7 +403,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
         (1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)
     )
     row_spec_T = pl.BlockSpec(
-        (1, 1, block_q), lambda bi, hi, ki, qi: (bi, hi, qi)
+        (1, 1, block_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
     )
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -413,7 +423,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, mask3, gt, lse, delta)
+    )(qt, kt, vt, mask3, gt, lse4, delta4)
 
     return (
         dq.transpose(0, 2, 1, 3),

@@ -186,14 +186,16 @@ def test_cli_survives_sigkill_and_resumes(tmp_path):
         deadline = time.time() + 120
         committed = None
         while time.time() < deadline:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"trainer exited early: {log_path.read_text()[-500:]}"
-                )
+            # Glob BEFORE checking liveness: a trainer that commits and
+            # then exits within one poll interval still counts.
             steps = sorted(ck.glob("step_*/MANIFEST.json"))
             if steps:
                 committed = steps[-1].parent.name
                 break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"trainer exited early: {log_path.read_text()[-500:]}"
+                )
             time.sleep(0.2)
         assert committed, "no checkpoint committed within 120s"
         proc.send_signal(signal.SIGKILL)
