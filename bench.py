@@ -398,8 +398,10 @@ def main() -> None:
         n_chips = int(health.get("device_count", 1))
 
         async def measure():
-            # Warmup, then three measured passes; take the best
-            # (steady-state) throughput run.
+            # Warmup, then measured passes at two offered-load levels
+            # (the device-call pipeline needs ~2x more closed-loop
+            # clients to fill when each call pays a tunnel RTT); take
+            # the best steady-state run, remembering its concurrency.
             await run_load(
                 "127.0.0.1", PORT, "/predict", payload=FLOWER,
                 concurrency=CONCURRENCY, duration_s=2.0,
@@ -408,17 +410,17 @@ def main() -> None:
                 "127.0.0.1", PORT, "/predict", payload=FLOWER,
                 concurrency=1, duration_s=3.0,
             )
-            best = None
-            for _ in range(2):
+            best, best_c = None, CONCURRENCY
+            for conc in (CONCURRENCY, 2 * CONCURRENCY):
                 r = await run_load(
                     "127.0.0.1", PORT, "/predict", payload=FLOWER,
-                    concurrency=CONCURRENCY, duration_s=DURATION_S,
+                    concurrency=conc, duration_s=DURATION_S,
                 )
                 if best is None or r.throughput > best.throughput:
-                    best = r
-            return single, best
+                    best, best_c = r, conc
+            return single, best, best_c
 
-        single, best = asyncio.run(measure())
+        single, best, best_c = asyncio.run(measure())
         rps_per_chip = best.throughput / max(1, n_chips)
         if note_extra:
             note = note_extra
@@ -438,7 +440,7 @@ def main() -> None:
                     "unit": "req/s/chip",
                     "vs_baseline": round(rps_per_chip / TARGET_RPS, 3),
                     "extras": {
-                        "concurrency": CONCURRENCY,
+                        "concurrency": best_c,
                         "chips": n_chips,
                         "total_rps": round(best.throughput, 1),
                         "loaded_p50_ms": round(best.quantile(0.5) or -1, 2),
