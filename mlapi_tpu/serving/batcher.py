@@ -200,8 +200,11 @@ class MicroBatcher:
             # that meant many ~32-row batches queueing behind the
             # slots: measured on the real TPU tunnel at concurrency
             # 512, the reorder alone took 1.6k → 4.0k req/s with
-            # loaded p50 283 → 111 ms; slot-first + 16 slots reaches
-            # 5.5k req/s at concurrency 1024 (event-loop bound).
+            # loaded p50 283 → 111 ms; slot-first + 16 slots measured
+            # 5.5k req/s at concurrency 1024 with an out-of-process
+            # load generator (4.6k through bench.py, whose generator
+            # shares this 1-core box with the server — event-loop
+            # bound either way).
             await self._inflight.acquire()
             rows = []
             try:
