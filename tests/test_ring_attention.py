@@ -301,7 +301,9 @@ def test_ring_flash_real_kernel_on_tpu():
     seq mesh on the real chip exercises the vma plumbing, the kernel
     lowering, and the (out, lse) merge end to end."""
     q, k, v = _qkv(seed=7)
-    mesh = create_mesh((1, 1), axis_names=("data", "seq"))
+    mesh = create_mesh(
+        (1, 1), axis_names=("data", "seq"), devices=jax.devices()[:1]
+    )
     out = ring_self_attention(mesh, q, k, v, block_impl="flash")
     ref = full_attention(q, k, v)
     # MXU f32 dots run bf16 multiplies at default precision; the
@@ -322,7 +324,9 @@ def test_ring_flash_zigzag_grads_real_kernel_on_tpu():
         jnp.asarray(rng.normal(size=(B, Lz, H, D)).astype(np.float32))
         for _ in range(3)
     )
-    mesh = create_mesh((1, 1), axis_names=("data", "seq"))
+    mesh = create_mesh(
+        (1, 1), axis_names=("data", "seq"), devices=jax.devices()[:1]
+    )
 
     def loss_zig(q, k, v):
         out = ring_self_attention(
