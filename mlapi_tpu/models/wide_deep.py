@@ -135,3 +135,19 @@ class WideDeepClassifier:
                 "kernel": lo.replicated(), "bias": lo.replicated()
             }
         return specs
+
+    def optimizer_partitions(self, params: dict) -> dict:
+        """Label pytree for ``train.optimizers.partitioned``: the two
+        embedding stacks take the rowwise-AdaGrad path (the Wide&Deep
+        paper's own AdaGrad recipe; dense Adam moments over [F, V, D]
+        are the step's HBM bottleneck), everything else the base
+        optimizer."""
+        return {
+            k: jax.tree.map(
+                lambda _, lab=(
+                    "embedding" if k.endswith("_tables") else "default"
+                ): lab,
+                v,
+            )
+            for k, v in params.items()
+        }

@@ -201,17 +201,38 @@ def _maybe_resume(root, params, opt_state, run_config: dict):
         )
 
     log.info("resuming from %s", newest)
+    # Mirror the save-side structure EXACTLY (no list()/tuple()
+    # conversions): jax.tree.map preserves tuple/namedtuple treedefs,
+    # and optax states rely on their namedtuple types surviving the
+    # round trip (multi_transform's update does state.inner_states).
     abstract = jax.tree.map(
         lambda a: jax.ShapeDtypeStruct(
             a.shape, a.dtype, sharding=getattr(a, "sharding", None)
         ),
-        {"params": params, "opt_state": list(opt_state)},
+        {"params": params, "opt_state": opt_state},
     )
     state, meta = load_checkpoint(newest, abstract)
-    return state["params"], tuple(state["opt_state"]), meta.step
+    return state["params"], state["opt_state"], meta.step
 
 
-def _make_optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
+def _make_optimizer(
+    name: str, learning_rate: float, *, model=None, params=None
+) -> optax.GradientTransformation:
+    """``name`` is an optax factory (``"adam"``, ``"adamw"``, …) or
+    ``"recsys-<base>"``: embedding tables (as labelled by the model's
+    ``optimizer_partitions``) take rowwise AdaGrad, the rest ``<base>``
+    — see ``mlapi_tpu.train.optimizers``."""
+    if name.startswith("recsys-"):
+        if model is None or not hasattr(model, "optimizer_partitions"):
+            raise ValueError(
+                f"optimizer {name!r} needs a model with "
+                "optimizer_partitions(); "
+                f"{type(model).__name__ if model else 'no model'} has none"
+            )
+        from mlapi_tpu.train.optimizers import partitioned
+
+        base = _make_optimizer(name[len("recsys-"):], learning_rate)
+        return partitioned(model, params, base, learning_rate)
     try:
         factory = getattr(optax, name)
     except AttributeError:
@@ -266,8 +287,8 @@ def fit(
     """
     from mlapi_tpu.parallel import params_for_model, shard_batch_for_mesh
 
-    tx = _make_optimizer(optimizer, learning_rate)
     params = model.init(jax.random.key(seed))
+    tx = _make_optimizer(optimizer, learning_rate, model=model, params=params)
 
     if mesh is not None:
         # Model-declared layout (e.g. Wide&Deep's sharded embedding
@@ -367,7 +388,11 @@ def fit(
                             f"refusing to checkpoint non-finite loss "
                             f"{float(loss)} at step {i + 1}"
                         )
-                    state = {"params": params, "opt_state": list(opt_state)}
+                    # The opt_state pytree is stored AS-IS: converting
+                    # the top level to a list would strip namedtuple
+                    # types (optax.multi_transform's state is one) and
+                    # break the restore-side structure match.
+                    state = {"params": params, "opt_state": opt_state}
                     if save_pool is not None:
                         if pending_save is not None:
                             pending_save.result()  # one in flight; fail loud
