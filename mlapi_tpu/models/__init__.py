@@ -41,8 +41,10 @@ from mlapi_tpu.models import mlp as _mlp  # noqa: E402,F401
 from mlapi_tpu.models import wide_deep as _wide_deep  # noqa: E402,F401
 from mlapi_tpu.models import bert as _bert  # noqa: E402,F401
 from mlapi_tpu.models import gpt as _gpt  # noqa: E402,F401
+from mlapi_tpu.models import llama as _llama  # noqa: E402,F401
 from mlapi_tpu.models.bert import BertClassifier  # noqa: E402,F401
 from mlapi_tpu.models.gpt import GptLM  # noqa: E402,F401
 from mlapi_tpu.models.linear import LinearClassifier  # noqa: E402,F401
+from mlapi_tpu.models.llama import LlamaLM  # noqa: E402,F401
 from mlapi_tpu.models.mlp import MLPClassifier  # noqa: E402,F401
 from mlapi_tpu.models.wide_deep import WideDeepClassifier  # noqa: E402,F401

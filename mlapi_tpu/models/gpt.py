@@ -191,6 +191,46 @@ class GptLM:
             for n in range(self.num_layers)
         }
 
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+        """Full causal forward over a left-padded ``[B, P]`` prompt,
+        writing K/V into a fresh ``[B, total_len, H, D]`` cache — this
+        model family's implementation of the decoder protocol (see
+        :func:`_prefill_core` for the shared contract).
+        """
+        b, p = prompt_ids.shape
+        cache = self.init_cache(b, total_len)
+        cdt = jnp.dtype(self.compute_dtype)
+
+        from mlapi_tpu.ops import full_attention
+
+        pos_idx = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
+        x = params["wte"][prompt_ids] + params["wpe"][pos_idx]
+        mask = (jnp.arange(p)[None, :] >= n_pad[:, None]).astype(jnp.float32)
+        for n in range(self.num_layers):
+            layer = params[f"layer_{n}"]
+            kv_seen = {}
+
+            def attend(q, k, v, *, _kv=kv_seen):
+                _kv["k"], _kv["v"] = k, v
+                return full_attention(q, k, v, mask=mask, causal=True)
+
+            x = self._block(layer, x, attend)
+            cache[f"layer_{n}"] = {
+                "k": jax.lax.dynamic_update_slice(
+                    cache[f"layer_{n}"]["k"], kv_seen["k"].astype(cdt),
+                    (0, 0, 0, 0),
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache[f"layer_{n}"]["v"], kv_seen["v"].astype(cdt),
+                    (0, 0, 0, 0),
+                ),
+            }
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+        last_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
+            jnp.float32
+        )
+        return cache, last_logits
+
     def decode_step(self, params, cache, token_ids, pos, n_pad=None):
         """One decode step: ``[B, 1]`` ids at position ``pos`` (traced
         scalar) → (``[B, V]`` logits, updated cache). The KV for the
@@ -224,26 +264,11 @@ class GptLM:
             layer = params[f"layer_{n}"]
 
             def attend(q, k_new, v_new, *, _n=n):
-                ck = jax.lax.dynamic_update_slice(
-                    cache[f"layer_{_n}"]["k"], k_new.astype(cdt), (0, pos, 0, 0)
+                out, new_cache[f"layer_{_n}"] = cached_attend(
+                    cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
+                    cdt, hd,
                 )
-                cv = jax.lax.dynamic_update_slice(
-                    cache[f"layer_{_n}"]["v"], v_new.astype(cdt), (0, pos, 0, 0)
-                )
-                new_cache[f"layer_{_n}"] = {"k": ck, "v": cv}
-                scores = (
-                    jnp.einsum(
-                        "bqhd,bkhd->bhqk", q, ck,
-                        preferred_element_type=jnp.float32,
-                    )
-                    / hd**0.5
-                )
-                scores = jnp.where(valid, scores, NEG)
-                probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-                return jnp.einsum(
-                    "bhqk,bkhd->bqhd", probs, cv,
-                    preferred_element_type=jnp.float32,
-                ).astype(q.dtype)
+                return out
 
             x = self._block(layer, x, attend)
 
@@ -284,33 +309,10 @@ class GptLM:
         PRNG stream per row (``fold_in(rng, row)``), making each row's
         tokens independent of its batch position.
         """
-        b, p = prompt_ids.shape
-        if p + max_new_tokens > self.max_positions:
-            raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_positions ({self.max_positions})"
-            )
-        rng = jax.random.key(0) if rng is None else rng
-        # The key crosses the jit boundary as raw uint32 data: a typed
-        # key array as a jit argument trips a fastpath buffer-count
-        # bug in this JAX version once other executables exist on a
-        # multi-device host (second identical call INVALID_ARGUMENT).
-        row_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-            jnp.arange(b)
-        )
-        temps = jnp.broadcast_to(
-            jnp.asarray(temperature, jnp.float32), (b,)
-        )
-        n_pad = (
-            jnp.zeros((b,), jnp.int32)
-            if pad_lens is None
-            else jnp.asarray(pad_lens, jnp.int32)
-        )
-        top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
-        top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
-        return _generate_fn(self, max_new_tokens)(
-            params, prompt_ids, jax.random.key_data(row_keys), temps, n_pad,
-            top_k, top_p,
+        return run_generate(
+            self, params, prompt_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, rng=rng, pad_lens=pad_lens,
+            top_k=top_k, top_p=top_p,
         )
 
     # ------------------------------------------------------------------
@@ -416,53 +418,100 @@ def _pick_token(temps, logits, key_data, step, top_k=None, top_p=None):
     return jnp.where(temps > 0.0, sampled, greedy)
 
 
-def _prefill_core(model: GptLM, params, prompt_ids, n_pad, total_len: int):
-    """Full causal forward over a left-padded ``[B, P]`` prompt,
-    writing K/V into a fresh ``[B, total_len, H, D]`` cache.
-
-    Per-row ``n_pad`` pad positions are masked out of attention and
-    position embeddings are shifted so real tokens occupy effective
-    positions ``0..P-1-n_pad[b]``. Returns ``(cache, last_logits)``
-    — every row's last real token sits at index ``P-1`` (right-
-    aligned), so the next-token logits are one static slice.
-
-    One batched forward + cache build is a single fused program;
-    prefilling via P decode-shaped steps would cost P dispatches.
-    """
-    self = model
+def run_generate(
+    model,
+    params,
+    prompt_ids,
+    *,
+    max_new_tokens: int,
+    temperature=0.0,
+    rng: jax.Array | None = None,
+    pad_lens=None,
+    top_k=0,
+    top_p=1.0,
+):
+    """Model-generic generation entry (every decoder family's
+    ``generate`` delegates here) — see ``GptLM.generate`` for the full
+    argument semantics."""
     b, p = prompt_ids.shape
-    cache = self.init_cache(b, total_len)
-    cdt = jnp.dtype(self.compute_dtype)
-
-    from mlapi_tpu.ops import full_attention
-
-    pos_idx = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
-    x = params["wte"][prompt_ids] + params["wpe"][pos_idx]
-    mask = (jnp.arange(p)[None, :] >= n_pad[:, None]).astype(jnp.float32)
-    for n in range(self.num_layers):
-        layer = params[f"layer_{n}"]
-        kv_seen = {}
-
-        def attend(q, k, v, *, _kv=kv_seen):
-            _kv["k"], _kv["v"] = k, v
-            return full_attention(q, k, v, mask=mask, causal=True)
-
-        x = self._block(layer, x, attend)
-        cache[f"layer_{n}"] = {
-            "k": jax.lax.dynamic_update_slice(
-                cache[f"layer_{n}"]["k"], kv_seen["k"].astype(cdt),
-                (0, 0, 0, 0),
-            ),
-            "v": jax.lax.dynamic_update_slice(
-                cache[f"layer_{n}"]["v"], kv_seen["v"].astype(cdt),
-                (0, 0, 0, 0),
-            ),
-        }
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
-    last_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
-        jnp.float32
+    if p + max_new_tokens > model.max_positions:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_positions ({model.max_positions})"
+        )
+    rng = jax.random.key(0) if rng is None else rng
+    # The key crosses the jit boundary as raw uint32 data: a typed
+    # key array as a jit argument trips a fastpath buffer-count
+    # bug in this JAX version once other executables exist on a
+    # multi-device host (second identical call INVALID_ARGUMENT).
+    row_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(b)
     )
-    return cache, last_logits
+    temps = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    n_pad = (
+        jnp.zeros((b,), jnp.int32)
+        if pad_lens is None
+        else jnp.asarray(pad_lens, jnp.int32)
+    )
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    return _generate_fn(model, max_new_tokens)(
+        params, prompt_ids, jax.random.key_data(row_keys), temps, n_pad,
+        top_k, top_p,
+    )
+
+
+def cached_attend(
+    cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None
+):
+    """One decode-time attention over a fixed-shape KV cache, shared
+    by every decoder family: write the new K/V at ``pos``, attend the
+    ``[B, 1]`` query against the whole cache under the ``valid`` mask.
+    ``expand`` broadcasts kv-heads to query heads (GQA families pass
+    their repeat; MHA passes nothing). Returns ``(ctx, new_layer)``.
+    """
+    from mlapi_tpu.ops.attention import NEG
+
+    expand = expand or (lambda t: t)
+    ck = jax.lax.dynamic_update_slice(
+        cache_layer["k"], k_new.astype(cdt), (0, pos, 0, 0)
+    )
+    cv = jax.lax.dynamic_update_slice(
+        cache_layer["v"], v_new.astype(cdt), (0, pos, 0, 0)
+    )
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, expand(ck),
+            preferred_element_type=jnp.float32,
+        )
+        / head_dim**0.5
+    )
+    scores = jnp.where(valid, scores, NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, expand(cv),
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    return ctx, {"k": ck, "v": cv}
+
+
+def _prefill_core(model, params, prompt_ids, n_pad, total_len: int):
+    """Decoder-protocol prefill dispatch: every model family
+    implements ``prefill_core`` (full forward over a left-padded
+    ``[B, P]`` prompt → ``(cache, last_logits)``); everything
+    downstream (``_decode_scan``, ``prefill_fn``, ``decode_chunk_fn``,
+    ``_generate_fn``) is model-generic.
+
+    Contract (see ``GptLM.prefill_core`` for the canonical
+    implementation): per-row ``n_pad`` pad positions are masked out of
+    attention and positions are shifted so real tokens occupy
+    effective positions ``0..P-1-n_pad[b]``; every row's last real
+    token sits at index ``P-1`` (right-aligned), so the next-token
+    logits are one static slice. One batched forward + cache build is
+    a single fused program — prefilling via P decode-shaped steps
+    would cost P dispatches.
+    """
+    return model.prefill_core(params, prompt_ids, n_pad, total_len)
 
 
 def _decode_scan(

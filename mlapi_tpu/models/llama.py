@@ -1,0 +1,342 @@
+"""Llama-style decoder: RMSNorm, rotary positions, SwiGLU, GQA.
+
+Second decoder family in the zoo (reference repo has none —
+`/root/reference` is a serving-only sklearn tutorial; this family
+exists because a complete framework serves the architectures users
+actually deploy). Differences from :class:`mlapi_tpu.models.gpt.GptLM`
+and why they matter on TPU:
+
+- **Rotary position embeddings** instead of a learned ``wpe`` table:
+  positions enter as a per-row rotation of q/k, so the KV cache stores
+  *rotated* keys and decode needs no position-table lookup. Left-pad
+  bucketing composes exactly: row ``b``'s effective position is
+  ``idx - n_pad[b]`` (clamped), the same shift discipline the GPT
+  path proves bucket-invariance with.
+- **Grouped-query attention** (``num_kv_heads < num_heads``): the
+  cache shrinks by the group factor — the serving cache is HBM-
+  resident state per concurrent request, so GQA directly raises the
+  max decode batch. K/V heads are broadcast to query heads with a
+  reshape-free ``jnp.repeat`` at attention time (XLA fuses it).
+- **RMSNorm + SwiGLU, no biases** — fewer, larger fused ops.
+
+The incremental-decoding machinery (prefill program, chunked
+``lax.scan`` decode, per-row sampling streams, top-k/top-p) is SHARED
+with the GPT family via the model-generic helpers in ``gpt.py``
+(``_generate_fn``, ``prefill_fn``, ``decode_chunk_fn``): this class
+plugs in through ``prefill_core``/``decode_step``/``init_cache``, so
+the serving engine (`serving/engine.py::TextGenerationEngine`) works
+unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from mlapi_tpu.models import register_model
+
+
+def _rms_norm(x, scale, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (x32 * inv * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotate ``x [B, L, H, D]`` by per-row-and-position angles.
+
+    ``positions``: ``[B, L]`` int32 effective positions (already
+    n_pad-shifted and clamped by callers). rotate-half convention:
+    pairs are (x[..., :D/2], x[..., D/2:]).
+    """
+    d = x.shape[-1]
+    half = d // 2
+    inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, L, D/2]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)  # [B, L, 1, D/2]
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+@register_model("llama_lm")
+@dataclass(frozen=True)
+class LlamaLM:
+    """Decoder-only causal LM, Llama-family architecture."""
+
+    input_kind = "text"
+
+    vocab_size: int = 512
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    num_kv_heads: int | None = None  # None -> MHA (== num_heads)
+    intermediate_size: int | None = None  # None -> 8/3 * h, 128-rounded
+    max_positions: int = 256
+    rope_theta: float = 10_000.0
+    compute_dtype: str = "bfloat16"
+    # "full" | "flash" | "ring" — same contract as GptLM.apply.
+    attention_impl: str = "full"
+    mesh: object = None
+    seq_axis: str = "seq"
+    ring_block_impl: str = "einsum"
+    ring_zigzag: bool = False
+
+    def __post_init__(self):
+        if self.attention_impl not in ("full", "flash", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.attention_impl == "ring" and self.mesh is None:
+            raise ValueError('attention_impl="ring" requires a mesh')
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide evenly into heads")
+        if self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({self.kv_heads})"
+            )
+        if self.head_dim % 2:
+            raise ValueError("rotary embeddings need an even head_dim")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        return max(128, (8 * self.hidden_size // 3 + 127) // 128 * 128)
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array) -> dict:
+        h, f, v = self.hidden_size, self.ffn_size, self.vocab_size
+        kvh, hd = self.kv_heads, self.head_dim
+        keys = iter(jax.random.split(rng, 2 + 7 * self.num_layers))
+
+        def w(k, shape, scale=0.02):
+            return scale * jax.random.normal(k, shape)
+
+        params = {
+            "wte": w(next(keys), (v, h)),
+            "lm_head": w(next(keys), (h, v)),
+            "rms_f_scale": jnp.ones((h,)),
+        }
+        for n in range(self.num_layers):
+            params[f"layer_{n}"] = {
+                "wq": w(next(keys), (h, h)),
+                "wk": w(next(keys), (h, kvh * hd)),
+                "wv": w(next(keys), (h, kvh * hd)),
+                "wo": w(next(keys), (h, h)),
+                "rms1_scale": jnp.ones((h,)),
+                "w_gate": w(next(keys), (h, f)),
+                "w_up": w(next(keys), (h, f)),
+                "w_down": w(next(keys), (f, h)),
+                "rms2_scale": jnp.ones((h,)),
+            }
+        return jax.tree.map(lambda a: a.astype(jnp.float32), params)
+
+    # ------------------------------------------------------------------
+    def _qkv(self, layer, xn, positions):
+        """Project + rope one block's q/k/v. ``positions`` is the
+        per-row effective position of every residual-stream slot."""
+        cdt = jnp.dtype(self.compute_dtype)
+        b, l, _ = xn.shape
+        nh, kvh, hd = self.num_heads, self.kv_heads, self.head_dim
+        q = (xn @ layer["wq"].astype(cdt)).reshape(b, l, nh, hd)
+        k = (xn @ layer["wk"].astype(cdt)).reshape(b, l, kvh, hd)
+        v = (xn @ layer["wv"].astype(cdt)).reshape(b, l, kvh, hd)
+        return _rope(q, positions, self.rope_theta), _rope(
+            k, positions, self.rope_theta
+        ), v
+
+    def _block(self, layer, x, positions, attend):
+        cdt = jnp.dtype(self.compute_dtype)
+        xn = _rms_norm(x, layer["rms1_scale"]).astype(cdt)
+        q, k, v = self._qkv(layer, xn, positions)
+        ctx = attend(q, k, v).reshape(x.shape[0], x.shape[1], -1)
+        x = x + (ctx @ layer["wo"].astype(cdt)).astype(jnp.float32)
+
+        xn = _rms_norm(x, layer["rms2_scale"]).astype(cdt)
+        gate = jax.nn.silu(
+            (xn @ layer["w_gate"].astype(cdt)).astype(jnp.float32)
+        ).astype(cdt)
+        up = xn @ layer["w_up"].astype(cdt)
+        down = (gate * up) @ layer["w_down"].astype(cdt)
+        return x + down.astype(jnp.float32)
+
+    def _repeat_kv(self, k):
+        group = self.num_heads // self.kv_heads
+        return k if group == 1 else jnp.repeat(k, group, axis=2)
+
+    def apply(self, params: dict, token_ids) -> jax.Array:
+        """``[B, L]`` ids → ``[B, L, V]`` next-token logits (causal)."""
+        from mlapi_tpu.ops import full_attention
+
+        b, l = token_ids.shape
+        x = params["wte"][token_ids]
+        positions = jnp.broadcast_to(jnp.arange(l)[None], (b, l))
+
+        if self.attention_impl == "flash":
+            from mlapi_tpu.ops.pallas import flash_attention
+
+            def attend(q, k, v):
+                return flash_attention(
+                    q, self._repeat_kv(k), self._repeat_kv(v), causal=True,
+                    interpret=jax.default_backend() != "tpu",
+                )
+        elif self.attention_impl == "ring":
+            from mlapi_tpu.ops import ring_self_attention
+
+            def attend(q, k, v):
+                return ring_self_attention(
+                    self.mesh, q, self._repeat_kv(k), self._repeat_kv(v),
+                    causal=True, seq_axis=self.seq_axis, head_axis="model",
+                    block_impl=self.ring_block_impl,
+                    zigzag=self.ring_zigzag,
+                )
+        else:
+            def attend(q, k, v):
+                return full_attention(
+                    q, self._repeat_kv(k), self._repeat_kv(v), causal=True
+                )
+
+        for n in range(self.num_layers):
+            x = self._block(params[f"layer_{n}"], x, positions, attend)
+        x = _rms_norm(x, params["rms_f_scale"])
+        return x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+
+    # -- incremental decoding (shared engine contract) -----------------
+    def init_cache(self, batch: int, max_len: int) -> dict:
+        """``[B, max_len, KVH, D]`` per layer — GQA shrinks this by
+        ``num_heads / num_kv_heads`` vs the query-head count."""
+        cdt = jnp.dtype(self.compute_dtype)
+        return {
+            f"layer_{n}": {
+                "k": jnp.zeros((batch, max_len, self.kv_heads, self.head_dim), cdt),
+                "v": jnp.zeros((batch, max_len, self.kv_heads, self.head_dim), cdt),
+            }
+            for n in range(self.num_layers)
+        }
+
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+        """Full causal forward over a left-padded ``[B, P]`` prompt,
+        writing ROTATED K (and V) into a fresh cache — the dispatch
+        target of ``gpt._prefill_core`` (see that docstring for the
+        padding/alignment contract)."""
+        from mlapi_tpu.ops import full_attention
+
+        b, p = prompt_ids.shape
+        cache = self.init_cache(b, total_len)
+        cdt = jnp.dtype(self.compute_dtype)
+
+        positions = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
+        mask = (jnp.arange(p)[None, :] >= n_pad[:, None]).astype(jnp.float32)
+        x = params["wte"][prompt_ids]
+        for n in range(self.num_layers):
+            layer = params[f"layer_{n}"]
+            kv_seen = {}
+
+            def attend(q, k, v, *, _kv=kv_seen):
+                _kv["k"], _kv["v"] = k, v
+                return full_attention(
+                    q, self._repeat_kv(k), self._repeat_kv(v),
+                    mask=mask, causal=True,
+                )
+
+            x = self._block(layer, x, positions, attend)
+            cache[f"layer_{n}"] = {
+                "k": jax.lax.dynamic_update_slice(
+                    cache[f"layer_{n}"]["k"], kv_seen["k"].astype(cdt),
+                    (0, 0, 0, 0),
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache[f"layer_{n}"]["v"], kv_seen["v"].astype(cdt),
+                    (0, 0, 0, 0),
+                ),
+            }
+        x = _rms_norm(x, params["rms_f_scale"])
+        last_logits = x[:, -1].astype(jnp.float32) @ params["lm_head"].astype(
+            jnp.float32
+        )
+        return cache, last_logits
+
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
+        """One cached decode step — same contract as
+        ``GptLM.decode_step`` (``[B, 1]`` ids at traced cache position
+        ``pos``; per-row ``n_pad`` shifts rotary positions and masks
+        pad keys). The cache write + masked attention is the shared
+        ``gpt.cached_attend``, with GQA's kv-head broadcast plugged in.
+        """
+        from mlapi_tpu.models.gpt import cached_attend
+
+        cdt = jnp.dtype(self.compute_dtype)
+        b = token_ids.shape[0]
+        max_len = cache["layer_0"]["k"].shape[1]
+        if n_pad is None:
+            n_pad = jnp.zeros((b,), jnp.int32)
+
+        idx = jnp.arange(max_len)
+        positions = jnp.maximum(pos - n_pad, 0)[:, None]  # [B, 1]
+        x = params["wte"][token_ids]
+        valid = ((idx[None, :] <= pos) & (idx[None, :] >= n_pad[:, None]))[
+            :, None, None, :
+        ]  # [B, 1, 1, L]
+        new_cache = {}
+
+        for n in range(self.num_layers):
+            layer = params[f"layer_{n}"]
+
+            def attend(q, k_new, v_new, *, _n=n):
+                out, new_cache[f"layer_{_n}"] = cached_attend(
+                    cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
+                    cdt, self.head_dim, expand=self._repeat_kv,
+                )
+                return out
+
+            x = self._block(layer, x, positions, attend)
+
+        x = _rms_norm(x, params["rms_f_scale"])
+        logits = x[:, 0].astype(jnp.float32) @ params["lm_head"].astype(
+            jnp.float32
+        )
+        return logits, new_cache
+
+    def generate(self, params, prompt_ids, **kwargs):
+        """Same surface as ``GptLM.generate`` (the whole prefill +
+        chunked-scan + sampling pipeline is the shared machinery in
+        ``gpt.py``)."""
+        from mlapi_tpu.models.gpt import run_generate
+
+        return run_generate(self, params, prompt_ids, **kwargs)
+
+    # ------------------------------------------------------------------
+    def param_shardings(self, layout=None) -> dict:
+        """Megatron TP: q/k/v/gate/up column-sharded, wo/w_down
+        row-sharded, embeddings + head vocab-sharded."""
+        from mlapi_tpu.parallel import SpecLayout
+
+        lo = layout or SpecLayout()
+        specs = {
+            "wte": lo.embedding_rows(),
+            "lm_head": lo.attn_qkv(),  # [h, V]: column(vocab)-sharded
+            "rms_f_scale": lo.replicated(),
+        }
+        for n in range(self.num_layers):
+            specs[f"layer_{n}"] = {
+                "wq": lo.attn_qkv(),
+                "wk": lo.attn_qkv(),
+                "wv": lo.attn_qkv(),
+                "wo": lo.attn_out(),
+                "rms1_scale": lo.replicated(),
+                "w_gate": lo.attn_qkv(),
+                "w_up": lo.attn_qkv(),
+                "w_down": lo.attn_out(),
+                "rms2_scale": lo.replicated(),
+            }
+        return specs
