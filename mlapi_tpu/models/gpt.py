@@ -244,11 +244,9 @@ class GptLM:
         at effective positions ``0..pos-n_pad[b]`` — a prompt's output
         is identical whichever pad bucket it landed in.
         """
-        from mlapi_tpu.ops.attention import NEG
-
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
-        nh, hd = self.num_heads, self.head_dim
+        hd = self.head_dim
         max_len = cache["layer_0"]["k"].shape[1]
         if n_pad is None:
             n_pad = jnp.zeros((b,), jnp.int32)
@@ -515,7 +513,7 @@ def _prefill_core(model, params, prompt_ids, n_pad, total_len: int):
 
 
 def _decode_scan(
-    model: GptLM, params, cache, tok, pos, n_pad, temps, key_data,
+    model, params, cache, tok, pos, n_pad, temps, key_data,
     n_steps: int, step0, top_k=None, top_p=None,
 ):
     """``n_steps`` cached decode steps under one ``lax.scan``.
@@ -542,7 +540,7 @@ def _decode_scan(
 
 
 @functools.lru_cache(maxsize=256)
-def _generate_fn(model: GptLM, max_new_tokens: int):
+def _generate_fn(model, max_new_tokens: int):
     """One jitted end-to-end generation program per (model config,
     token count); temperature, pad widths, and PRNG keys are traced
     arguments (the key as raw uint32 data — see ``generate``)."""
@@ -565,7 +563,7 @@ def _generate_fn(model: GptLM, max_new_tokens: int):
 
 
 @functools.lru_cache(maxsize=64)
-def prefill_fn(model: GptLM, total_len: int):
+def prefill_fn(model, total_len: int):
     """Jitted prefill + first-token program for incremental decoding:
     ``(params, prompt_ids [B,P], key_data, temps, n_pad)`` →
     ``(first_tok [B], cache)``. Compiled per (model, B, P, total_len);
@@ -583,7 +581,7 @@ def prefill_fn(model: GptLM, total_len: int):
 
 
 @functools.lru_cache(maxsize=64)
-def decode_chunk_fn(model: GptLM, chunk: int):
+def decode_chunk_fn(model, chunk: int):
     """Jitted ``chunk``-step decode program:
     ``(params, cache, tok, pos, n_pad, temps, key_data, step0)`` →
     ``(tokens [B, chunk], cache, last_tok)``. The cache is donated —

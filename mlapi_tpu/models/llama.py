@@ -89,6 +89,10 @@ class LlamaLM:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.attention_impl == "ring" and self.mesh is None:
             raise ValueError('attention_impl="ring" requires a mesh')
+        if self.ring_zigzag and self.ring_block_impl != "flash":
+            raise ValueError('ring_zigzag needs ring_block_impl="flash"')
+        if self.num_kv_heads is not None and self.num_kv_heads < 1:
+            raise ValueError(f"num_kv_heads must be >= 1, got {self.num_kv_heads}")
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide evenly into heads")
         if self.num_heads % self.kv_heads:
@@ -105,7 +109,7 @@ class LlamaLM:
 
     @property
     def kv_heads(self) -> int:
-        return self.num_kv_heads or self.num_heads
+        return self.num_heads if self.num_kv_heads is None else self.num_kv_heads
 
     @property
     def ffn_size(self) -> int:
