@@ -221,3 +221,46 @@ register_preset(
         mesh_shape=(2, 4),  # DP x TP
     )
 )
+
+# Decoder-family LM presets: next-token training on the repo's own
+# documentation (datasets/textlm.py — real English prose, zero-egress),
+# producing checkpoints that serve via /generate. These demonstrate the
+# full generative pipeline (corpus -> fit -> checkpoint -> serving);
+# the corpus is ~50k tokens, so they train in seconds, not to quality.
+register_preset(
+    TrainConfig(
+        name="docs-gpt",
+        model="gpt_lm",
+        model_kwargs={
+            "vocab_size": 260, "hidden_size": 128, "num_layers": 2,
+            "num_heads": 4, "max_positions": 256,
+            "compute_dtype": "float32",
+        },
+        dataset="docs_text",
+        dataset_kwargs={"seq_len": 128},
+        steps=300,
+        batch_size=64,
+        optimizer="adamw",
+        learning_rate=3e-4,
+        eval_every=100,
+    )
+)
+
+register_preset(
+    TrainConfig(
+        name="docs-llama",
+        model="llama_lm",
+        model_kwargs={
+            "vocab_size": 260, "hidden_size": 128, "num_layers": 2,
+            "num_heads": 4, "num_kv_heads": 2, "max_positions": 256,
+            "compute_dtype": "float32",
+        },
+        dataset="docs_text",
+        dataset_kwargs={"seq_len": 128},
+        steps=300,
+        batch_size=64,
+        optimizer="adamw",
+        learning_rate=3e-4,
+        eval_every=100,
+    )
+)

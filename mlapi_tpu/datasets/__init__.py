@@ -79,3 +79,4 @@ register_dataset("fashion_mnist")(load_fashion_mnist)
 from mlapi_tpu.datasets.criteo import load_criteo  # noqa: E402,F401  (self-registers)
 from mlapi_tpu.datasets.digits import load_digits  # noqa: E402,F401  (self-registers)
 from mlapi_tpu.datasets.sst2 import load_sst2  # noqa: E402,F401  (self-registers)
+from mlapi_tpu.datasets.textlm import load_docs_text  # noqa: E402,F401  (self-registers)

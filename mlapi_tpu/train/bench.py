@@ -93,7 +93,15 @@ def bench_train(
         opt_state = jax.jit(tx.init)(params)
     else:
         opt_state = tx.init(params)
-    step_fn = make_train_step(model.apply, tx, weight_decay=cfg.weight_decay)
+    # Same task resolution as fit: explicit dataset marker first,
+    # label-shape fallback — the bench must time the exact program
+    # fit runs (LM presets use the shifted, pad-masked objective).
+    task = splits.extras.get(
+        "task", "lm" if np.asarray(splits.y_train).ndim == 2 else "classify"
+    )
+    step_fn = make_train_step(
+        model.apply, tx, weight_decay=cfg.weight_decay, task=task
+    )
 
     # One fixed batch, reused: this measures the step program, not the
     # host data pipeline (which fit's (seed, step)-keyed batching does
@@ -103,7 +111,7 @@ def bench_train(
     if len(x) < bs:
         reps = -(-bs // len(x))
         x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:bs]
-        y = np.tile(y, reps)[:bs]
+        y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:bs]
     if mesh is not None:
         x, y = shard_batch_for_mesh((x, y), mesh)
 

@@ -45,12 +45,18 @@ def make_train_step(
     *,
     weight_decay: float = 0.0,
     debug_checks: bool = False,
+    task: str = "classify",
 ) -> Callable:
     """Build a jit-compiled SGD step ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
 
     ``params`` and ``opt_state`` are donated — the optimizer update
     happens in-place in device memory, no copies.
+
+    ``task`` selects the objective: ``"classify"`` (softmax CE against
+    ``y`` class ids) or ``"lm"`` (next-token CE — ``y`` is the same
+    ``[B, L]`` id sequence as ``x``, targets are ``y`` shifted one
+    left, pad positions (id 0) masked out of the loss).
 
     ``debug_checks=True`` compiles the step through ``checkify`` with
     float checks (SURVEY §5 sanitizers row): NaN/inf produced anywhere
@@ -59,10 +65,22 @@ def make_train_step(
     steps later as a non-finite loss. Costs a host sync per step, so
     it is a debug mode, not the default.
     """
+    if task not in ("classify", "lm"):
+        raise ValueError(f"unknown task {task!r}")
 
     def loss_fn(params, x, y):
         logits = apply_fn(params, x)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        if task == "lm":
+            targets = y[:, 1:]
+            keep = (targets != 0).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets
+            )
+            loss = jnp.sum(ce * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
         if weight_decay:
             # Penalise weight matrices only (ndim >= 2), never biases —
             # sklearn's LogisticRegression convention.
@@ -134,6 +152,35 @@ def evaluate(
         pred = jnp.argmax(fn(params, jnp.asarray(chunk)), axis=-1)[:m]
         correct += int(jnp.sum(pred == jnp.asarray(y[s : s + m])))
     return correct / n
+
+
+def evaluate_lm(
+    apply_fn: Callable, params, x, *, batch_size: int = 256
+) -> float:
+    """Held-out next-token top-1 accuracy over ``[N, L]`` sequences
+    (pad id 0 positions excluded) — the LM counterpart of
+    :func:`evaluate`, batched for the same OOM reason."""
+    x = np.asarray(x)
+    n = len(x)
+    if n == 0:
+        return float("nan")
+    fn = _jitted(apply_fn)
+    correct = total = 0
+    for s in range(0, n, batch_size):
+        chunk = x[s : s + batch_size]
+        m = len(chunk)
+        if m < batch_size and s > 0:
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], batch_size - m, axis=0)]
+            )
+        pred = np.asarray(
+            jnp.argmax(fn(params, jnp.asarray(chunk)), axis=-1)
+        )[:m, :-1]
+        targets = chunk[:m, 1:]
+        keep = targets != 0
+        correct += int(((pred == targets) & keep).sum())
+        total += int(keep.sum())
+    return correct / max(total, 1)
 
 
 def _save_train_state(
@@ -259,8 +306,15 @@ def fit(
     resume: bool = True,
     profile_dir: str | None = None,
     debug_checks: bool = False,
+    task: str = "auto",
 ) -> TrainResult:
     """Train ``model`` on ``splits``.
+
+    ``task="auto"`` infers the objective from the label shape:
+    ``[B, L]`` sequence labels (LM datasets set ``y == x``) train
+    next-token prediction with pad masking; ``[B]`` class ids train
+    classification. ``test_accuracy`` is next-token top-1 accuracy
+    for LM runs.
 
     ``batch_size=None`` runs full-batch steps (right for tiny convex
     problems like Iris). With ``mesh`` set, the batch is sharded over
@@ -287,6 +341,14 @@ def fit(
     """
     from mlapi_tpu.parallel import params_for_model, shard_batch_for_mesh
 
+    if task == "auto":
+        # Prefer the dataset's explicit marker (extras["task"], set by
+        # LM loaders); fall back to the label-shape heuristic.
+        task = getattr(splits, "extras", {}).get(
+            "task",
+            "lm" if np.asarray(splits.y_train).ndim == 2 else "classify",
+        )
+
     params = model.init(jax.random.key(seed))
     tx = _make_optimizer(optimizer, learning_rate, model=model, params=params)
 
@@ -309,6 +371,7 @@ def fit(
         "weight_decay": weight_decay,
         "batch_size": batch_size,
         "seed": seed,
+        "task": task,
     }
 
     start_step = 0
@@ -324,8 +387,14 @@ def fit(
             )
 
     step_fn = make_train_step(
-        model.apply, tx, weight_decay=weight_decay, debug_checks=debug_checks
+        model.apply, tx, weight_decay=weight_decay,
+        debug_checks=debug_checks, task=task,
     )
+
+    def eval_fn(p):
+        if task == "lm":
+            return evaluate_lm(model.apply, p, splits.x_test)
+        return evaluate(model.apply, p, splits.x_test, splits.y_test)
 
     # Async checkpointing: one background writer, one save in flight.
     save_pool = None
@@ -370,9 +439,7 @@ def fit(
                         raise FloatingPointError(
                             f"non-finite loss {float(loss)} at step {i + 1}"
                         )
-                    acc = evaluate(
-                        model.apply, params, splits.x_test, splits.y_test
-                    )
+                    acc = eval_fn(params)
                     history.append(
                         {"step": i + 1, "loss": float(loss),
                          "test_accuracy": acc}
@@ -424,11 +491,7 @@ def fit(
             f"training ended with non-finite loss {float(loss)}"
         )
 
-    test_acc = (
-        evaluate(model.apply, params, splits.x_test, splits.y_test)
-        if len(splits.x_test)
-        else None
-    )
+    test_acc = eval_fn(params) if len(splits.x_test) else None
     return TrainResult(
         params=params,
         final_loss=float(loss),

@@ -1,0 +1,127 @@
+"""LM training path: docs corpus loader, the lm task in the train
+loop, and the train->checkpoint->/generate pipeline for decoders."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mlapi_tpu.datasets import get_dataset
+from mlapi_tpu.models import get_model
+from mlapi_tpu.train import fit
+from mlapi_tpu.train.loop import evaluate_lm, make_train_step
+
+TINY_GPT = dict(
+    vocab_size=260, hidden_size=32, num_layers=1, num_heads=2,
+    max_positions=64, compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return get_dataset("docs_text", seq_len=64)
+
+
+def test_corpus_shapes_and_provenance(corpus):
+    assert corpus.source == "real"
+    assert corpus.x_train.ndim == 2 and corpus.x_train.shape[1] == 64
+    assert np.array_equal(corpus.x_train, corpus.y_train)  # LM: y == x
+    assert corpus.x_train.max() < 260  # byte tokenizer range
+    assert corpus.extras["tokenizer"]["kind"] == "bytes"
+    assert len(corpus.x_test) >= 1
+
+
+def test_train_test_windows_do_not_overlap():
+    d = get_dataset("docs_text", seq_len=64, stride=32)
+    # Tail split with stride guard: no train window may reach into
+    # the region the test windows cover.
+    flat_test = d.x_test.reshape(-1)
+    first_test_window = d.x_test[0]
+    for w in d.x_train[-4:]:
+        assert not np.array_equal(w, first_test_window)
+    assert len(flat_test)
+
+
+def test_lm_loss_masks_pads():
+    m = get_model("gpt_lm", **TINY_GPT)
+    import optax
+
+    params = m.init(jax.random.key(0))
+    step = make_train_step(m.apply, optax.sgd(0.0), task="lm")
+    x = np.full((2, 8), 7, np.int32)
+    x_padded = x.copy()
+    x_padded[:, 6:] = 0  # pad tail: loss must ignore those targets
+    tx_state = optax.sgd(0.0).init(params)
+    _, _, loss_full = step(params, tx_state, jnp.asarray(x), jnp.asarray(x))
+    p2 = m.init(jax.random.key(0))
+    s2 = optax.sgd(0.0).init(p2)
+    _, _, loss_pad = step(p2, s2, jnp.asarray(x_padded), jnp.asarray(x_padded))
+    assert np.isfinite(float(loss_full)) and np.isfinite(float(loss_pad))
+    # Not asserting equality (different visible context), just that the
+    # pad-masked loss is computed over fewer targets without NaN.
+
+
+def test_make_train_step_rejects_unknown_task():
+    import optax
+
+    m = get_model("gpt_lm", **TINY_GPT)
+    with pytest.raises(ValueError, match="unknown task"):
+        make_train_step(m.apply, optax.sgd(0.1), task="regression")
+
+
+def test_fit_autodetects_lm_and_learns(corpus):
+    m = get_model("gpt_lm", **TINY_GPT)
+    r = fit(
+        m, corpus, steps=60, batch_size=32, learning_rate=1e-3,
+        optimizer="adamw",
+    )
+    assert np.isfinite(r.final_loss)
+    # Next-token accuracy on English bytes: random is ~1/60 over the
+    # used byte alphabet; even 60 steps beats 10%.
+    assert r.test_accuracy > 0.10, r.test_accuracy
+
+
+def test_evaluate_lm_perfect_on_copycat():
+    """Sanity-check the metric itself with a constant-sequence set a
+    trained copy model would ace — using logits rigged to echo the
+    previous token."""
+    x = np.full((4, 10), 9, np.int32)
+
+    def apply_fn(params, ids):
+        return jax.nn.one_hot(ids, 260) * 100.0  # predict current id
+
+    acc = evaluate_lm(apply_fn, {}, x)
+    assert acc == 1.0  # every target equals the previous token
+
+
+def test_docs_preset_cli_end_to_end(tmp_path):
+    """The full pipeline: preset -> fit -> checkpoint (with tokenizer
+    fingerprint) -> generation engine serves it."""
+    from mlapi_tpu.config import TrainConfig
+    from mlapi_tpu.serving.engine import InferenceEngine
+    from mlapi_tpu.train.__main__ import run
+
+    cfg = TrainConfig(
+        name="docs-gpt-test",
+        model="gpt_lm",
+        model_kwargs=dict(TINY_GPT),
+        dataset="docs_text",
+        dataset_kwargs={"seq_len": 64},
+        steps=5,
+        batch_size=16,
+        optimizer="adamw",
+        learning_rate=1e-3,
+    )
+    out = tmp_path / "ck"
+    run(cfg, out=str(out))
+    eng = InferenceEngine.from_checkpoint(out)
+    assert hasattr(eng.model, "generate")
+    gen = np.asarray(
+        eng.model.generate(
+            eng.params,
+            jnp.asarray([[10, 11, 12]], jnp.int32),
+            max_new_tokens=4,
+        )
+    )
+    assert gen.shape == (1, 4)
