@@ -190,8 +190,32 @@ def _install_generate(app: App, engine) -> None:
         top_p=(float, 1.0),
         seed=(int, 0),
         stream=(bool, False),
+        stop=(str | list[str] | None, None),
     )
     hard_cap = engine.model.max_positions - 1
+
+    def _norm_stops(stop) -> list[str]:
+        stops = [stop] if isinstance(stop, str) else list(stop or [])
+        if len(stops) > 4 or any(not 0 < len(s) <= 64 for s in stops):
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["stop"],
+                        "msg": "up to 4 stop strings of 1-64 chars",
+                        "input": stop,
+                    }
+                ],
+            )
+        return stops
+
+    def _first_stop(text: str, stops: list[str]):
+        """(cut_index, stop) of the earliest stop occurrence, or
+        ``None``. Generation halts at the FIRST match across all stop
+        strings."""
+        hits = [(text.find(s), s) for s in stops if s in text]
+        return min(hits) if hits else None
 
     @app.post("/generate")
     async def generate(req: schema):  # type: ignore[valid-type]
@@ -252,6 +276,7 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
+        stops = _norm_stops(req.stop)
         try:
             gen = await engine.submit(
                 req.text,
@@ -289,6 +314,34 @@ def _install_generate(app: App, engine) -> None:
                             ).encode() + b"\n"
                             return
                         ids.extend(item["token_ids"])
+                        if stops:
+                            # One decode per chunk, reused for the
+                            # match and the done frame (decoding the
+                            # full prefix each chunk is already
+                            # O(n^2)-ish; don't triple it).
+                            text = engine.tokenizer.decode(ids)
+                            hit = _first_stop(text, stops)
+                            if hit is not None:
+                                # Stop matched: end the stream with the
+                                # truncated authoritative text and free
+                                # the decode row (cancel → the batch
+                                # compacts it away). Chunks already
+                                # streamed may extend past the stop at
+                                # chunk granularity; the done frame is
+                                # the source of truth.
+                                finished = True
+                                gen.cancel()
+                                cut, s = hit
+                                yield json.dumps(
+                                    {
+                                        "done": True,
+                                        "text": text[:cut],
+                                        "token_ids": ids,
+                                        "prompt_tokens": gen.used,
+                                        "stopped": s,
+                                    }
+                                ).encode() + b"\n"
+                                return
                         yield json.dumps(item).encode() + b"\n"
                 finally:
                     # Generator closed early (client disconnect →
@@ -302,6 +355,7 @@ def _install_generate(app: App, engine) -> None:
             )
 
         ids: list[int] = []
+        stopped = None
         try:
             while True:
                 item = await gen.queue.get()
@@ -310,14 +364,25 @@ def _install_generate(app: App, engine) -> None:
                 if item is None:
                     break
                 ids.extend(item["token_ids"])
+                if stops:
+                    text = engine.tokenizer.decode(ids)
+                    hit = _first_stop(text, stops)
+                    if hit is not None:
+                        gen.cancel()  # free the decode row early
+                        stopped = hit
+                        break
         except asyncio.CancelledError:
             gen.cancel()  # non-stream handler torn down mid-decode
             raise
-        return {
-            "text": engine.tokenizer.decode(ids),
+        text = engine.tokenizer.decode(ids)
+        out = {
+            "text": text if stopped is None else text[: stopped[0]],
             "token_ids": ids,
             "prompt_tokens": gen.used,
         }
+        if stopped is not None:
+            out["stopped"] = stopped[1]
+        return out
 
 
 def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> None:

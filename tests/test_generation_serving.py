@@ -259,3 +259,75 @@ def test_mixed_length_batch_compacts_and_matches(gpt_checkpoint):
     assert engine.compactions >= 1
     for single, got in zip(singles, outs):
         assert got == single["token_ids"]
+
+
+async def test_stop_sequences(gpt_checkpoint):
+    """stop strings truncate the authoritative text at the first match
+    and cancel the decode row early (both response modes)."""
+    engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    # Pin the decode chunk: the auto-RTT choice could pick 16 on a
+    # slow host, and the early-cancel assertion below needs the stop
+    # to land before max_new_tokens tokens have been pushed.
+    engine.chunk = 4
+    app = build_app(engine)
+    await app.startup()
+    try:
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(
+            transport=transport, base_url="http://test"
+        ) as client:
+            # The repeater model continues 'abab…'; stopping on "ba"
+            # must cut at the first boundary: one 'a' of generated
+            # text survives (prompt ends in 'b' → continuation
+            # 'ababab…' hits "ba" at index 1).
+            r = await client.post(
+                "/generate",
+                json={"text": "abababab", "max_new_tokens": 12,
+                      "stop": "ba"},
+            )
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert body["stopped"] == "ba"
+            assert body["text"] == "a"
+            # Fewer than max tokens were emitted before the cut —
+            # the row was cancelled, not decoded to 12.
+            assert len(body["token_ids"]) < 12
+
+            # List form + no match: runs to max_new_tokens, no
+            # "stopped" key.
+            r2 = await client.post(
+                "/generate",
+                json={"text": "abababab", "max_new_tokens": 6,
+                      "stop": ["zz", "qq"]},
+            )
+            body2 = r2.json()
+            assert "stopped" not in body2
+            assert len(body2["token_ids"]) == 6
+
+            # Streaming: the done frame carries the truncated text
+            # and the stop reason.
+            async with client.stream(
+                "POST", "/generate",
+                json={"text": "abababab", "max_new_tokens": 12,
+                      "stop": ["ba"], "stream": True},
+            ) as resp:
+                lines = [
+                    json.loads(l) async for l in resp.aiter_lines() if l
+                ]
+            done = lines[-1]
+            assert done["done"] is True
+            assert done["stopped"] == "ba"
+            assert done["text"] == "a"
+
+            # Validation: too many / empty stop strings are a 422.
+            bad = await client.post(
+                "/generate",
+                json={"text": "x", "stop": ["a", "b", "c", "d", "e"]},
+            )
+            assert bad.status_code == 422
+            bad2 = await client.post(
+                "/generate", json={"text": "x", "stop": [""]}
+            )
+            assert bad2.status_code == 422
+    finally:
+        await app.shutdown()
