@@ -231,6 +231,28 @@ def _choose_backend() -> tuple[dict | None, str | None, dict]:
                 f"({len(diag['attempts'])} attempts, see BENCH_DIAG.json); "
                 "measured on CPU fallback (same serving stack)"
             )
+            # The chip comes and goes (wedged r01-r02, alive the
+            # morning of r03, wedged again that afternoon). If real-
+            # TPU numbers were captured while it was up, point at
+            # them so a fallback run doesn't read as "never measured".
+            try:
+                with open(
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json",
+                    )
+                ) as f:
+                    pub = json.load(f).get("published", {})
+                tpu_row = pub.get("serving_predict", {})
+                if tpu_row.get("backend") == "tpu":
+                    note += (
+                        "; most recent recorded on-TPU measurement: "
+                        f"{tpu_row.get('req_per_s_per_chip')} req/s/chip "
+                        f"(round {pub.get('round')}, {pub.get('date')} - "
+                        "BASELINE.json.published)"
+                    )
+            except Exception:  # noqa: BLE001 — the note is best-effort;
+                pass           # a malformed file must not kill the bench
     env = {}
     if probe is None or probe.get("backend") != "tpu":
         env["MLAPI_TPU_PLATFORM"] = "cpu"

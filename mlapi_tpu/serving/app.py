@@ -212,10 +212,10 @@ def _install_generate(app: App, engine) -> None:
 
     def _first_stop(text: str, stops: list[str]):
         """(cut_index, stop) of the earliest stop occurrence, or
-        ``None``. Generation halts at the FIRST match across all stop
-        strings."""
-        hits = [(text.find(s), s) for s in stops if s in text]
-        return min(hits) if hits else None
+        ``None``. Generation halts at the FIRST match; same-index ties
+        go to the LONGEST stop (deterministic, not lexicographic)."""
+        hits = [(i, s) for s in stops if (i := text.find(s)) != -1]
+        return min(hits, key=lambda h: (h[0], -len(h[1])), default=None)
 
     @app.post("/generate")
     async def generate(req: schema):  # type: ignore[valid-type]
@@ -356,6 +356,7 @@ def _install_generate(app: App, engine) -> None:
 
         ids: list[int] = []
         stopped = None
+        text = None
         try:
             while True:
                 item = await gen.queue.get()
@@ -371,10 +372,12 @@ def _install_generate(app: App, engine) -> None:
                         gen.cancel()  # free the decode row early
                         stopped = hit
                         break
+                    text = None  # ids will grow; don't reuse
         except asyncio.CancelledError:
             gen.cancel()  # non-stream handler torn down mid-decode
             raise
-        text = engine.tokenizer.decode(ids)
+        if text is None:
+            text = engine.tokenizer.decode(ids)
         out = {
             "text": text if stopped is None else text[: stopped[0]],
             "token_ids": ids,
