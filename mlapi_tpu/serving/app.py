@@ -372,7 +372,8 @@ def _install_generate(app: App, engine) -> None:
                         gen.cancel()  # free the decode row early
                         stopped = hit
                         break
-                    text = None  # ids will grow; don't reuse
+                    # text stays valid: every path that exits the loop
+                    # does so before ids grows past this decode.
         except asyncio.CancelledError:
             gen.cancel()  # non-stream handler torn down mid-decode
             raise

@@ -194,3 +194,17 @@ def test_learns_copy_task(model):
         if i == 0:
             l0 = float(l)
     assert float(l) < 0.1 * l0, (l0, float(l))
+
+
+def test_ring_attention_backend_matches_full(model, params):
+    """attention_impl='ring' (sequence-parallel) is a drop-in backend
+    for this family too — logits must match the full-attention model
+    with the same params."""
+    from mlapi_tpu.parallel import create_mesh
+
+    mesh = create_mesh((2, 4), axis_names=("data", "seq"))
+    ring = get_model("llama_lm", **TINY, attention_impl="ring", mesh=mesh)
+    ids = np.random.default_rng(9).integers(0, 64, (2, 32)).astype(np.int32)
+    ref = np.asarray(jax.jit(model.apply)(params, ids))
+    out = np.asarray(jax.jit(ring.apply)(params, ids))
+    np.testing.assert_allclose(out, ref, atol=2e-4)
