@@ -177,4 +177,9 @@ def bench_train(
     }
 
 
-DEFAULT_BENCH_PRESETS = ("fashion-mlp", "criteo-widedeep", "sst2-bert")
+# docs-gpt rides along so training perf covers the LM objective too
+# (next-token CE over [B, L, V] logits — a different program shape
+# than the classifier steps).
+DEFAULT_BENCH_PRESETS = (
+    "fashion-mlp", "criteo-widedeep", "sst2-bert", "docs-gpt",
+)
