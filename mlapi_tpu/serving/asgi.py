@@ -331,8 +331,27 @@ class App:
     async def _dispatch(self, request: Request) -> Response:
         key = (request.method, request.path)
         if key not in self._routes:
-            if any(p == request.path for _, p in self._routes):
-                return json_response({"detail": "Method Not Allowed"}, 405)
+            allowed = sorted(
+                m for m, p in self._routes if p == request.path
+            )
+            if allowed:
+                # OPTIONS is supported on every registered path (the
+                # auto-answer below), so advertise it too.
+                allow = ", ".join([*allowed, "OPTIONS"])
+                if request.method == "OPTIONS":
+                    # RFC 9110 §9.3.7: advertise the supported
+                    # methods. A 204 carries no body and (per §8.6,
+                    # enforced by the server's framing) no
+                    # Content-Length; content-type would be noise.
+                    resp = Response(b"", status=204, headers={"allow": allow})
+                    resp.headers.pop("content-type", None)
+                    return resp
+                # RFC 9110 §15.5.6: 405 MUST carry an Allow header.
+                return json_response(
+                    {"detail": "Method Not Allowed"},
+                    405,
+                    headers={"allow": allow},
+                )
             return json_response({"detail": "Not Found"}, 404)
         route = self._routes[key]
         handler, body_model = route.handler, route.body_model

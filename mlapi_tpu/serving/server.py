@@ -24,7 +24,8 @@ _log = get_logger("serving.server")
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 256 * 1024 * 1024
 _STATUS_PHRASES = {
-    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    200: "OK", 204: "No Content", 304: "Not Modified",
+    400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
     408: "Request Timeout", 411: "Length Required", 413: "Payload Too Large",
     422: "Unprocessable Entity", 429: "Too Many Requests",
     431: "Request Header Fields Too Large",
@@ -168,13 +169,18 @@ class Server:
             if not state["started"]:
                 state["started"] = True
                 if not more:
-                    writer.write(
-                        _head(
-                            b"content-length: "
-                            + str(len(body)).encode() + b"\r\n"
+                    if state["status"] in (204, 304):
+                        # RFC 9110 §8.6: no Content-Length (and no
+                        # body) on 204/304.
+                        writer.write(_head(b""))
+                    else:
+                        writer.write(
+                            _head(
+                                b"content-length: "
+                                + str(len(body)).encode() + b"\r\n"
+                            )
+                            + body
                         )
-                        + body
-                    )
                     await writer.drain()
                     return
                 state["streaming"] = True

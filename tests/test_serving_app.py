@@ -235,3 +235,19 @@ async def test_openapi_and_docs(iris_checkpoint):
         assert d.headers["content-type"].startswith("text/html")
         assert "/openapi.json" in d.text
         assert "http://" not in d.text.replace("http://test", "")  # no CDN
+
+
+async def test_405_carries_allow_header_and_options_works(client):
+    """RFC 9110: 405 MUST list allowed methods; OPTIONS advertises
+    them without invoking the handler."""
+    r = await client.get("/predict")
+    assert r.status_code == 405
+    assert r.headers.get("allow") == "POST, OPTIONS"
+    o = await client.request("OPTIONS", "/predict")
+    assert o.status_code == 204
+    assert o.headers.get("allow") == "POST, OPTIONS"
+    assert "content-type" not in o.headers
+    # /healthz is GET-only; POST to it must advertise GET.
+    p = await client.post("/healthz", json={})
+    assert p.status_code == 405
+    assert p.headers.get("allow") == "GET, OPTIONS"
