@@ -190,8 +190,10 @@ class LlamaLM:
             from mlapi_tpu.ops.pallas import flash_attention
 
             def attend(q, k, v):
+                # The kernel is GQA-native: raw kv heads go straight
+                # in, no repeated K/V tensor in HBM.
                 return flash_attention(
-                    q, self._repeat_kv(k), self._repeat_kv(v), causal=True,
+                    q, k, v, causal=True,
                     interpret=jax.default_backend() != "tpu",
                 )
         elif self.attention_impl == "ring":

@@ -34,6 +34,9 @@ Longer sequences belong to the sequence-parallel path
 Layout convention matches ``mlapi_tpu.ops.attention``: ``q, k, v``
 are ``[B, L, H, D]``, ``mask`` is binary ``[B, L]`` over keys; fully
 masked query rows return zeros (all three attention impls agree).
+Grouped-query attention is native on the forward: ``k``/``v`` may
+carry ``H / group`` heads and the kv BlockSpec indexes ``hi //
+group`` — the repeated K/V tensor never exists in HBM.
 Matmuls run native-dtype inputs with f32 accumulation on the MXU.
 """
 
@@ -134,6 +137,10 @@ def _jnp_flash(q, k, v, mask, causal, scale):
     outside shard_map (tests/test_flash_attention.py), and on TPU the
     real kernels run everywhere, shard_map included.
     """
+    if k.shape[2] != q.shape[2]:  # GQA: broadcast kv heads
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     s = (
         jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -178,6 +185,11 @@ def _out_struct(shape, dtype, like):
 def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    # GQA: k/v may carry fewer heads than q (validated in _prepare);
+    # the kv BlockSpec indexes `hi // group`, so each query head
+    # streams its group's K/V block straight from HBM — no repeated
+    # K/V tensor is ever materialised.
+    group = h // k.shape[2]
     # [B, 1, L]: TPU lowering wants the last two block dims tile-
     # aligned or equal to the array dims; a (1, 1, block_k) block
     # satisfies that where a (1, block_k) block over [B, L] cannot
@@ -191,7 +203,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
     kv_spec = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
     )
     mask_spec = pl.BlockSpec(
         (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
@@ -451,10 +463,23 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, mask, out, lse = res
     g_o, g_lse = g
+    # GQA backward: run the kernels at full query-head width (repeat
+    # K/V) and fold each group's dk/dv back onto its shared kv head.
+    # The FORWARD never materialises the repeat (the kv BlockSpec
+    # indexes hi // group); making the backward repeat-free too needs
+    # a dkv grid reorder (the group's non-consecutive output-block
+    # revisits) — recorded as a next step, training-path only.
+    group = q.shape[2] // k.shape[2]
+    kf = jnp.repeat(k, group, axis=2) if group > 1 else k
+    vf = jnp.repeat(v, group, axis=2) if group > 1 else v
     dq, dk, dv = _bwd(
-        q, k, v, mask, out, lse, g_o, causal, scale, block_q, block_k,
+        q, kf, vf, mask, out, lse, g_o, causal, scale, block_q, block_k,
         interpret, g_lse=g_lse,
     )
+    if group > 1:
+        b, lk, _, d = dk.shape
+        dk = dk.reshape(b, lk, k.shape[2], group, d).sum(3)
+        dv = dv.reshape(b, lk, v.shape[2], group, d).sum(3)
     return dq, dk, dv, jnp.zeros_like(mask)
 
 
@@ -468,7 +493,7 @@ def _fit_block(requested: int, length: int) -> int:
     return b
 
 
-def _prepare(q, k, mask, causal, scale, block_q, block_k):
+def _prepare(q, k, v, mask, causal, scale, block_q, block_k):
     """Shared wrapper preamble: validation, scale default, block
     clamping, default mask. Returns (mask, scale, block_q, block_k)."""
     b, lq, h, d = q.shape
@@ -476,6 +501,15 @@ def _prepare(q, k, mask, causal, scale, block_q, block_k):
     if causal and lq != lk:
         raise ValueError(
             f"causal attention needs aligned q/k lengths, got {lq} vs {lk}"
+        )
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"k and v head counts disagree: {k.shape[2]} vs {v.shape[2]}"
+        )
+    if h % k.shape[2]:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads "
+            f"({k.shape[2]}) for grouped-query attention"
         )
     scale = (1.0 / d**0.5) if scale is None else scale
     # Fit each block to its sequence: clamp, then halve until it
@@ -518,7 +552,7 @@ def flash_attention(
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
     """
     mask, scale, block_q, block_k = _prepare(
-        q, k, mask, causal, scale, block_q, block_k
+        q, k, v, mask, causal, scale, block_q, block_k
     )
     if interpret and _inside_vma_shard_map(q):
         out, _ = _jnp_flash(q, k, v, mask, causal, scale)
@@ -552,7 +586,7 @@ def flash_attention_with_lse(
     weighted average). Used by ``ring_attention``'s flash block mode;
     differentiable through BOTH outputs."""
     mask, scale, block_q, block_k = _prepare(
-        q, k, mask, causal, scale, block_q, block_k
+        q, k, v, mask, causal, scale, block_q, block_k
     )
     if interpret and _inside_vma_shard_map(q):
         return _jnp_flash(q, k, v, mask, causal, scale)

@@ -208,3 +208,13 @@ def test_ring_attention_backend_matches_full(model, params):
     ref = np.asarray(jax.jit(model.apply)(params, ids))
     out = np.asarray(jax.jit(ring.apply)(params, ids))
     np.testing.assert_allclose(out, ref, atol=2e-4)
+
+
+def test_flash_attention_backend_matches_full(model, params):
+    """attention_impl='flash' feeds raw GQA kv heads to the kernel
+    (no repeated K/V tensor) — logits must match the full backend."""
+    flash = get_model("llama_lm", **TINY, attention_impl="flash")
+    ids = np.random.default_rng(11).integers(0, 64, (2, 32)).astype(np.int32)
+    ref = np.asarray(jax.jit(model.apply)(params, ids))
+    out = np.asarray(jax.jit(flash.apply)(params, ids))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
