@@ -57,19 +57,41 @@ _NEG = -1e30
 _LANES = 128
 
 
-def _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, shape):
-    """Binary keep-mask for one (q-tile, k-tile) score block."""
+def _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, shape,
+               window=None):
+    """Binary keep-mask for one (q-tile, k-tile) score block.
+    ``window`` (causal-only) keeps keys within the last ``window``
+    positions of each query: ``q_pos - k_pos < window``."""
     keep = mask_ref[0, 0][None, :].astype(jnp.float32)
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
         keep = keep * (q_pos >= k_pos)
+        if window is not None:
+            keep = keep * (q_pos - k_pos < window)
     return keep
+
+
+def _tile_live(causal, window, qi, ki, block_q, block_k):
+    """Static-shape predicate: does this (q-tile, k-tile) pair contain
+    ANY attendable position? Causal skips tiles above the diagonal;
+    a window additionally skips tiles entirely older than the oldest
+    key any query in the tile can see. NOTE: ``pl.when`` predicates
+    the MXU compute only — dead tiles still pay their K/V copies and
+    a sequential grid step, so wall time is reduced but not to
+    O(L·window); that needs a shrunken, offset inner k-grid
+    (``ceil(window/block_k)+1`` steps), the recorded next step."""
+    live = (qi + 1) * block_q > ki * block_k if causal else True
+    if causal and window is not None:
+        live = jnp.logical_and(
+            live, (ki + 1) * block_k + window > qi * block_q + 1
+        )
+    return live
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s,
-    *, scale, causal, block_q, block_k,
+    *, scale, causal, block_q, block_k, window=None,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -80,8 +102,8 @@ def _fwd_kernel(
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    # Causal: tiles entirely above the diagonal contribute nothing.
-    run = (qi + 1) * block_q > ki * block_k if causal else True
+    # Causal/window: tiles with no attendable position are skipped.
+    run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -96,7 +118,9 @@ def _fwd_kernel(
             )
             * scale
         )  # [block_q, block_k]
-        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        keep = _keep_tile(
+            mask_ref, causal, qi, ki, block_q, block_k, s.shape, window
+        )
         s = s + (1.0 - keep) * _NEG
 
         m_prev = m_s[:, :1]
@@ -123,7 +147,7 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_s[:, :1] + jnp.log(jnp.maximum(l_s[:, :1], 1e-30))
 
 
-def _jnp_flash(q, k, v, mask, causal, scale):
+def _jnp_flash(q, k, v, mask, causal, scale, window=None):
     """Pure-jnp (out, lse) with the kernel's exact conventions —
     identical masking/NEG/lse semantics, differentiable by plain
     autodiff (the lse cotangent flows through ``jnp.log``).
@@ -150,7 +174,10 @@ def _jnp_flash(q, k, v, mask, causal, scale):
     keep = mask.astype(jnp.float32)[:, None, None, :]
     if causal:
         lq, lk = q.shape[1], k.shape[1]
-        tri = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        dist = jnp.arange(lq)[:, None] - jnp.arange(lk)[None, :]
+        tri = dist >= 0
+        if window is not None:
+            tri = tri & (dist < window)
         keep = keep * tri[None, None]
     s = s + (1.0 - keep) * _NEG
     m = jnp.max(s, axis=-1)                      # [B,H,Lq]
@@ -182,7 +209,8 @@ def _out_struct(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
+         window=None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     # GQA: k/v may carry fewer heads than q (validated in _prepare);
@@ -220,7 +248,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec],
@@ -241,7 +269,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_s, *, scale, causal, block_q, block_k,
+    dq_s, *, scale, causal, block_q, block_k, window=None,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -250,7 +278,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    run = (qi + 1) * block_q > ki * block_k if causal else True
+    run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -272,7 +300,9 @@ def _bwd_dq_kernel(
             )
             * scale
         )
-        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        keep = _keep_tile(
+            mask_ref, causal, qi, ki, block_q, block_k, s.shape, window
+        )
         s = s + (1.0 - keep) * _NEG
         # Recompute probabilities from the saved LSE. Masked lanes give
         # exp(NEG - lse) — large but finite (lse >= NEG + log(eps)) —
@@ -298,6 +328,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, block_q, block_k,
+    window=None,
 ):
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
@@ -307,7 +338,7 @@ def _bwd_dkv_kernel(
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    run = (qi + 1) * block_q > ki * block_k if causal else True
+    run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -327,7 +358,9 @@ def _bwd_dkv_kernel(
             )
             * scale
         )
-        keep = _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, s.shape)
+        keep = _keep_tile(
+            mask_ref, causal, qi, ki, block_q, block_k, s.shape, window
+        )
         s = s + (1.0 - keep) * _NEG
         p = jnp.exp(s - lse) * keep            # [block_q, block_k]
         # dv += pᵀ · dO ; dk += dsᵀ · q — contractions over the q dim,
@@ -356,7 +389,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
-         interpret, g_lse=None):
+         interpret, g_lse=None, window=None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     mask3 = mask.astype(jnp.float32)[:, None, :]
@@ -394,7 +427,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid=(b, h, lq // block_q, lk // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec,
@@ -422,7 +455,7 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid=(b, h, lk // block_k, lq // block_q),
         in_specs=[q_spec_T, kv_spec_T, kv_spec_T, mask_spec_T, q_spec_T,
@@ -446,21 +479,27 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret,
+           window=None):
     """(out, lse) with a joint VJP — lse cotangents cost nothing extra
     (they fold into the delta term, see ``_bwd``), which is what lets
     ring attention compose flash blocks and still train through the
     log-sum-exp merge."""
-    return _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+    return _fwd(
+        q, k, v, mask, causal, scale, block_q, block_k, interpret, window
+    )
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
+               window=None):
+    out, lse = _fwd(
+        q, k, v, mask, causal, scale, block_q, block_k, interpret, window
+    )
     return (out, lse), (q, k, v, mask, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, mask, out, lse = res
     g_o, g_lse = g
     # GQA backward: run the kernels at full query-head width (repeat
@@ -474,7 +513,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     vf = jnp.repeat(v, group, axis=2) if group > 1 else v
     dq, dk, dv = _bwd(
         q, kf, vf, mask, out, lse, g_o, causal, scale, block_q, block_k,
-        interpret, g_lse=g_lse,
+        interpret, g_lse=g_lse, window=window,
     )
     if group > 1:
         b, lk, _, d = dk.shape
@@ -493,7 +532,8 @@ def _fit_block(requested: int, length: int) -> int:
     return b
 
 
-def _prepare(q, k, v, mask, causal, scale, block_q, block_k):
+def _prepare(q, k, v, mask, causal, scale, block_q, block_k,
+             window=None):
     """Shared wrapper preamble: validation, scale default, block
     clamping, default mask. Returns (mask, scale, block_q, block_k)."""
     b, lq, h, d = q.shape
@@ -501,6 +541,11 @@ def _prepare(q, k, v, mask, causal, scale, block_q, block_k):
     if causal and lq != lk:
         raise ValueError(
             f"causal attention needs aligned q/k lengths, got {lq} vs {lk}"
+        )
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            "window requires causal=True and window >= 1 "
+            f"(got causal={causal}, window={window})"
         )
     if k.shape[2] != v.shape[2]:
         raise ValueError(
@@ -526,7 +571,9 @@ def _prepare(q, k, v, mask, causal, scale, block_q, block_k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "window"
+    ),
 )
 def flash_attention(
     q,
@@ -539,6 +586,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    window: int | None = None,
 ):
     """Fused softmax attention. ``q, k, v``: ``[B, L, H, D]``;
     ``mask``: optional binary ``[B, L]`` over keys. Returns
@@ -552,21 +600,23 @@ def flash_attention(
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
     """
     mask, scale, block_q, block_k = _prepare(
-        q, k, v, mask, causal, scale, block_q, block_k
+        q, k, v, mask, causal, scale, block_q, block_k, window
     )
     if interpret and _inside_vma_shard_map(q):
-        out, _ = _jnp_flash(q, k, v, mask, causal, scale)
+        out, _ = _jnp_flash(q, k, v, mask, causal, scale, window)
         return out
     out, _ = _flash(
         q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
-        interpret,
+        interpret, window,
     )
     return out
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "window"
+    ),
 )
 def flash_attention_with_lse(
     q,
@@ -579,6 +629,7 @@ def flash_attention_with_lse(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    window: int | None = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``[B, H, L]`` — the quantity that lets independently
@@ -586,11 +637,11 @@ def flash_attention_with_lse(
     weighted average). Used by ``ring_attention``'s flash block mode;
     differentiable through BOTH outputs."""
     mask, scale, block_q, block_k = _prepare(
-        q, k, v, mask, causal, scale, block_q, block_k
+        q, k, v, mask, causal, scale, block_q, block_k, window
     )
     if interpret and _inside_vma_shard_map(q):
-        return _jnp_flash(q, k, v, mask, causal, scale)
+        return _jnp_flash(q, k, v, mask, causal, scale, window)
     return _flash(
         q, k, v, mask.astype(jnp.float32), causal, scale, block_q, block_k,
-        interpret,
+        interpret, window,
     )

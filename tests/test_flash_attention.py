@@ -241,3 +241,81 @@ def test_gqa_compiled_on_tpu_matches():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def _windowed_reference(q, k, v, window, mask=None):
+    """Oracle: full attention with an explicit sliding-window mask."""
+    import mlapi_tpu.ops.attention as att
+
+    lq, lk = q.shape[1], k.shape[1]
+    dist = np.arange(lq)[:, None] - np.arange(lk)[None, :]
+    win = (dist >= 0) & (dist < window)
+    keep = np.broadcast_to(win, (q.shape[0],) + win.shape).astype(np.float32)
+    if mask is not None:
+        keep = keep * np.asarray(mask)[:, None, :]
+    s = np.einsum(
+        "bqhd,bkhd->bhqk", np.asarray(q, np.float32), np.asarray(k, np.float32)
+    ) / q.shape[-1] ** 0.5
+    s = s + (1.0 - keep[:, None]) * att.NEG
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p * keep[:, None]
+    denom = np.maximum(p.sum(-1, keepdims=True), 1e-30)
+    return np.einsum(
+        "bhqk,bkhd->bqhd", p / denom, np.asarray(v, np.float32)
+    )
+
+
+def test_sliding_window_matches_masked_reference():
+    q, k, v = _qkv(seed=31)
+    out = flash_attention(
+        q, k, v, causal=True, window=10, block_q=16, block_k=16,
+        interpret=True,
+    )
+    ref = _windowed_reference(q, k, v, 10)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_sliding_window_with_padding_mask_and_grads():
+    q, k, v = _qkv(seed=32)
+    lengths = np.array([L - 4, 37])
+    mask = jnp.asarray(
+        (np.arange(L)[None, :] < lengths[:, None]).astype(np.float32)
+    )
+    out = flash_attention(
+        q, k, v, mask, causal=True, window=12, block_q=16, block_k=16,
+        interpret=True,
+    )
+    ref = _windowed_reference(q, k, v, 12, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    # Grads: keys outside every query's window must get ZERO gradient.
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, window=8, block_q=16, block_k=16,
+                interpret=True,
+            )[:, -1]  # only the last query row contributes
+            ** 2
+        )
+
+    dk = jax.grad(loss, argnums=1)(q, k, v)
+    dk = np.asarray(dk)
+    assert np.abs(dk[:, : L - 8]).max() == 0.0  # outside the last row's window
+    assert np.abs(dk[:, L - 8 :]).max() > 0.0
+
+
+def test_window_tile_skip_is_exact_at_tile_boundaries():
+    """Window == block size: whole tiles drop; result still exact."""
+    q, k, v = _qkv(seed=33)
+    out = flash_attention(
+        q, k, v, causal=True, window=16, block_q=16, block_k=16,
+        interpret=True,
+    )
+    ref = _windowed_reference(q, k, v, 16)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(seed=34)
+    with pytest.raises(ValueError, match="window requires causal"):
+        flash_attention(q, k, v, window=8, interpret=True)
