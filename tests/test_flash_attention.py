@@ -319,3 +319,47 @@ def test_window_requires_causal():
     q, k, v = _qkv(seed=34)
     with pytest.raises(ValueError, match="window requires causal"):
         flash_attention(q, k, v, window=8, interpret=True)
+
+
+def test_randomized_differential_sweep():
+    """Fuzz the kernel against the einsum oracle across random
+    (shape, mask, GQA group, window, blocks, causal) configs — one
+    seeded float32 sweep, so failures reproduce exactly (bf16
+    numerics are covered separately by the requires_tpu tests)."""
+    rng = np.random.default_rng(2026)
+    for trial in range(12):
+        b = int(rng.integers(1, 3))
+        lq = int(rng.choice([16, 32, 48, 64]))
+        h = int(rng.choice([2, 4]))
+        d = int(rng.choice([8, 16]))
+        group = int(rng.choice([1, 2]))
+        kvh = h // group
+        causal = bool(rng.integers(0, 2))
+        window = (
+            int(rng.choice([8, 16])) if causal and rng.integers(0, 2) else None
+        )
+        bq = int(rng.choice([16, 32]))
+        ks = jax.random.split(jax.random.key(trial), 3)
+        q = jax.random.normal(ks[0], (b, lq, h, d))
+        k = jax.random.normal(ks[1], (b, lq, kvh, d))
+        v = jax.random.normal(ks[2], (b, lq, kvh, d))
+        lengths = rng.integers(1, lq + 1, size=b)
+        mask = jnp.asarray(
+            (np.arange(lq)[None, :] < lengths[:, None]).astype(np.float32)
+        )
+        out = flash_attention(
+            q, k, v, mask, causal=causal, window=window,
+            block_q=bq, block_k=bq, interpret=True,
+        )
+        # Oracle: the shared references (no third masking copy).
+        kf = jnp.repeat(k, group, axis=2) if group > 1 else k
+        vf = jnp.repeat(v, group, axis=2) if group > 1 else v
+        if causal:
+            ref = _windowed_reference(q, kf, vf, window or lq, mask=mask)
+        else:
+            ref = np.asarray(full_attention(q, kf, vf, mask))
+        np.testing.assert_allclose(
+            np.asarray(out), ref, atol=2e-5,
+            err_msg=f"trial {trial}: b={b} l={lq} h={h} d={d} "
+                    f"group={group} causal={causal} window={window} bq={bq}",
+        )
