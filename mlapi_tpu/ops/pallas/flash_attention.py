@@ -72,15 +72,26 @@ def _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, shape,
     return keep
 
 
+def _window_k_tile(qi, ki, block_q, block_k, nkw):
+    """Physical k-tile index for window-relative step ``ki`` of a
+    shrunken k-grid: the last ``nkw`` tiles ending at the q-tile's
+    diagonal tile. May be negative (caller clamps + skips)."""
+    last = (qi * block_q + block_q - 1) // block_k
+    return last - (nkw - 1) + ki
+
+
 def _tile_live(causal, window, qi, ki, block_q, block_k):
     """Static-shape predicate: does this (q-tile, k-tile) pair contain
     ANY attendable position? Causal skips tiles above the diagonal;
     a window additionally skips tiles entirely older than the oldest
-    key any query in the tile can see. NOTE: ``pl.when`` predicates
-    the MXU compute only — dead tiles still pay their K/V copies and
-    a sequential grid step, so wall time is reduced but not to
-    O(L·window); that needs a shrunken, offset inner k-grid
-    (``ceil(window/block_k)+1`` steps), the recorded next step."""
+    key any query in the tile can see. The windowed FORWARD normally
+    bypasses this predicate — its k-grid is shrunken to the live
+    tiles (``_window_k_tile``), so steady-state q-tiles do
+    O(window/block_k) steps in compute AND copies — but falls back to
+    the full grid + this predicate when the window covers most of the
+    sequence (nkw == nk_full). The backward kernels always run the
+    full grid with this compute-only skip (their grid reorder is the
+    remaining step)."""
     live = (qi + 1) * block_q > ki * block_k if causal else True
     if causal and window is not None:
         live = jnp.logical_and(
@@ -91,7 +102,7 @@ def _tile_live(causal, window, qi, ki, block_q, block_k):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s,
-    *, scale, causal, block_q, block_k, window=None,
+    *, scale, causal, block_q, block_k, window=None, windowed_grid=False,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -102,8 +113,18 @@ def _fwd_kernel(
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    # Causal/window: tiles with no attendable position are skipped.
-    run = _tile_live(causal, window, qi, ki, block_q, block_k)
+    if windowed_grid:
+        # Shrunken k-grid: ki is WINDOW-RELATIVE. The physical k-tile
+        # is the same expression the BlockSpec index map uses; tiles
+        # whose unclamped index is negative are duplicates of tile 0
+        # (index maps can't go below 0) and must not contribute twice.
+        kb_raw = _window_k_tile(qi, ki, block_q, block_k, nk)
+        kb = jnp.maximum(kb_raw, 0)
+        run = kb_raw >= 0
+    else:
+        kb = ki
+        # Causal/window: tiles with no attendable position are skipped.
+        run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -119,7 +140,7 @@ def _fwd_kernel(
             * scale
         )  # [block_q, block_k]
         keep = _keep_tile(
-            mask_ref, causal, qi, ki, block_q, block_k, s.shape, window
+            mask_ref, causal, qi, kb, block_q, block_k, s.shape, window
         )
         s = s + (1.0 - keep) * _NEG
 
@@ -226,16 +247,52 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
     # [B, L, H, D] -> [B, H, L, D]: heads become a grid dimension.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
 
-    grid = (b, h, lq // block_q, lk // block_k)
+    nk_full = lk // block_k
+    # Sliding window: walk only the k-tiles a q-tile can see — the
+    # last nkw tiles ending at its diagonal tile. nkw is the EXACT
+    # worst case over q-tile alignments (enumerated over the
+    # gcd(block_q, block_k) residue classes — everything here is
+    # static at trace time), so for aligned blocks no q-tile pays a
+    # spare inner step. Early q-tiles whose unclamped tile index is
+    # negative still occupy their grid steps (the index map clamps to
+    # tile 0 and its copy happens; only the compute is skipped) — the
+    # O(L·window) claim is about the common steady-state q-tiles.
+    if causal and window is not None:
+        import math
+
+        g = math.gcd(block_q, block_k)
+        max_tiles = 0
+        for r in range(0, block_k, g):
+            first = (r - window + 1) // block_k  # floor; may be < 0
+            last = (r + block_q - 1) // block_k
+            max_tiles = max(max_tiles, last - first + 1)
+        nkw = min(nk_full, max_tiles)
+    else:
+        nkw = nk_full
+    windowed_grid = nkw < nk_full
+    grid = (b, h, lq // block_q, nkw)
     q_spec = pl.BlockSpec(
         (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
-    kv_spec = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-    )
-    mask_spec = pl.BlockSpec(
-        (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
-    )
+    if windowed_grid:
+        def _kmap(bi, hi, qi, ki):
+            kb = _window_k_tile(qi, ki, block_q, block_k, nkw)
+            return (bi, hi // group, jnp.maximum(kb, 0), 0)
+
+        def _mmap(bi, hi, qi, ki):
+            kb = _window_k_tile(qi, ki, block_q, block_k, nkw)
+            return (bi, 0, jnp.maximum(kb, 0))
+
+        kv_spec = pl.BlockSpec((1, 1, block_k, d), _kmap)
+        mask_spec = pl.BlockSpec((1, 1, block_k), _mmap)
+    else:
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, d),
+            lambda bi, hi, qi, ki: (bi, hi // group, ki, 0),
+        )
+        mask_spec = pl.BlockSpec(
+            (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
+        )
     # LSE rides as [B, H, L, 1]: Mosaic requires the last two block
     # dims tile-aligned (8, 128) or equal to the array dims; a
     # (1, 1, block_q) block over [B, H, L] fails that for H > 1,
@@ -249,6 +306,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, window=window,
+            windowed_grid=windowed_grid,
         ),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec],

@@ -339,6 +339,7 @@ def test_randomized_differential_sweep():
             int(rng.choice([8, 16])) if causal and rng.integers(0, 2) else None
         )
         bq = int(rng.choice([16, 32]))
+        bk = int(rng.choice([16, 32]))  # mismatched blocks included
         ks = jax.random.split(jax.random.key(trial), 3)
         q = jax.random.normal(ks[0], (b, lq, h, d))
         k = jax.random.normal(ks[1], (b, lq, kvh, d))
@@ -349,7 +350,7 @@ def test_randomized_differential_sweep():
         )
         out = flash_attention(
             q, k, v, mask, causal=causal, window=window,
-            block_q=bq, block_k=bq, interpret=True,
+            block_q=bq, block_k=bk, interpret=True,
         )
         # Oracle: the shared references (no third masking copy).
         kf = jnp.repeat(k, group, axis=2) if group > 1 else k
@@ -361,5 +362,59 @@ def test_randomized_differential_sweep():
         np.testing.assert_allclose(
             np.asarray(out), ref, atol=2e-5,
             err_msg=f"trial {trial}: b={b} l={lq} h={h} d={d} "
-                    f"group={group} causal={causal} window={window} bq={bq}",
+                    f"group={group} causal={causal} window={window} "
+                    f"bq={bq} bk={bk}",
+        )
+
+
+def test_window_grid_covers_every_live_tile():
+    """White-box: the shrunken k-grid's physical tiles must cover
+    every tile containing an attendable key, for all q-tiles and a
+    sweep of (window, block) combinations."""
+    from mlapi_tpu.ops.pallas.flash_attention import _window_k_tile
+
+    for bq, bk, window, l in [
+        (16, 16, 8, 64), (16, 16, 16, 64), (32, 16, 24, 128),
+        (16, 32, 40, 128), (32, 32, 32, 256), (16, 16, 50, 128),
+    ]:
+        import math
+
+        nk_full = l // bk
+        g = math.gcd(bq, bk)
+        max_tiles = 0
+        for r in range(0, bk, g):
+            first = (r - window + 1) // bk
+            last = (r + bq - 1) // bk
+            max_tiles = max(max_tiles, last - first + 1)
+        nkw = min(nk_full, max_tiles)  # mirrors _fwd's exact bound
+        for qi in range(l // bq):
+            visited = {
+                max(0, int(_window_k_tile(qi, ki, bq, bk, nkw)))
+                for ki in range(nkw)
+                if int(_window_k_tile(qi, ki, bq, bk, nkw)) >= 0
+            }
+            # Tiles that contain at least one key some query attends:
+            need = set()
+            for qp in range(qi * bq, (qi + 1) * bq):
+                lo, hi = max(0, qp - window + 1), qp
+                need |= {t for t in range(lo // bk, hi // bk + 1)}
+            assert need <= visited, (
+                f"bq={bq} bk={bk} window={window} qi={qi}: "
+                f"missing tiles {sorted(need - visited)}"
+            )
+
+
+def test_window_with_mismatched_blocks_matches_reference():
+    """The shrunken k-grid's diagonal-tile arithmetic differs per
+    q-tile alignment when block_q != block_k — exercise both
+    directions through the actual kernel."""
+    q, k, v = _qkv(seed=35)
+    for bq, bk in [(16, 32), (32, 16)]:
+        out = flash_attention(
+            q, k, v, causal=True, window=24, block_q=bq, block_k=bk,
+            interpret=True,
+        )
+        ref = _windowed_reference(q, k, v, 24)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, atol=1e-5, err_msg=f"bq={bq} bk={bk}"
         )
