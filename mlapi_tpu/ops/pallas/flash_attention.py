@@ -17,9 +17,12 @@ carries the online-softmax state between K tiles):
 
 - forward:   ``(B, H, L/block_q, L/block_k)`` — one q-tile's output
   accumulates across the inner k-steps, written at the last k-step.
-- backward dq: same grid; dq accumulates across k-steps.
-- backward dk/dv: ``(B, H, L/block_k, L/block_q)`` — q innermost,
-  dk/dv accumulate across q-steps.
+- backward dq: ``(B, H, L/block_q, k-tiles)``; dq accumulates across
+  the (window-shrunken, when windowed) k-steps.
+- backward dk/dv: ``(B, KVH, L/block_k, group × q-tiles)`` — one kv
+  head's whole query group accumulates consecutively into its
+  KVH-wide dk/dv block (GQA-native; no repeated K/V in either pass),
+  with the inner q-range window-shrunken when windowed.
 
 Causal masking skips whole tiles above the diagonal (``pl.when``
 predication), so causal attention does ~half the work.
@@ -43,6 +46,7 @@ Matmuls run native-dtype inputs with f32 accumulation on the MXU.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +76,31 @@ def _keep_tile(mask_ref, causal, qi, ki, block_q, block_k, shape,
     return keep
 
 
+def _live_k_tiles(block_q, block_k, window):
+    """Exact worst-case number of k-tiles any q-tile can see under a
+    causal window — enumerated over the gcd residue classes of q-tile
+    alignments (all static at trace time). Single source of truth for
+    the forward and dq shrunken grids."""
+    g = math.gcd(block_q, block_k)
+    best = 0
+    for r in range(0, block_k, g):
+        first = (r - window + 1) // block_k  # floor; may be < 0
+        last = (r + block_q - 1) // block_k
+        best = max(best, last - first + 1)
+    return best
+
+
+def _live_q_tiles(block_q, block_k, window):
+    """Exact worst-case number of q-tiles any k-tile can feed (the
+    dkv grid's inner extent), offset from the k-tile's first live
+    q-tile ``(ki * block_k) // block_q``."""
+    g = math.gcd(block_q, block_k)
+    best = 0
+    for r in range(0, block_q, g):
+        best = max(best, (r + block_k + window - 2) // block_q + 1)
+    return best
+
+
 def _window_k_tile(qi, ki, block_q, block_k, nkw):
     """Physical k-tile index for window-relative step ``ki`` of a
     shrunken k-grid: the last ``nkw`` tiles ending at the q-tile's
@@ -84,14 +113,12 @@ def _tile_live(causal, window, qi, ki, block_q, block_k):
     """Static-shape predicate: does this (q-tile, k-tile) pair contain
     ANY attendable position? Causal skips tiles above the diagonal;
     a window additionally skips tiles entirely older than the oldest
-    key any query in the tile can see. The windowed FORWARD normally
-    bypasses this predicate — its k-grid is shrunken to the live
-    tiles (``_window_k_tile``), so steady-state q-tiles do
-    O(window/block_k) steps in compute AND copies — but falls back to
+    key any query in the tile can see. Windowed kernels normally
+    bypass this predicate — all three grids shrink to the live tiles
+    (``_live_k_tiles`` / ``_live_q_tiles``), so steady-state tiles do
+    O(window/block) steps in compute AND copies — and fall back to
     the full grid + this predicate when the window covers most of the
-    sequence (nkw == nk_full). The backward kernels always run the
-    full grid with this compute-only skip (their grid reorder is the
-    remaining step)."""
+    sequence."""
     live = (qi + 1) * block_q > ki * block_k if causal else True
     if causal and window is not None:
         live = jnp.logical_and(
@@ -258,15 +285,7 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
     # tile 0 and its copy happens; only the compute is skipped) — the
     # O(L·window) claim is about the common steady-state q-tiles.
     if causal and window is not None:
-        import math
-
-        g = math.gcd(block_q, block_k)
-        max_tiles = 0
-        for r in range(0, block_k, g):
-            first = (r - window + 1) // block_k  # floor; may be < 0
-            last = (r + block_q - 1) // block_k
-            max_tiles = max(max_tiles, last - first + 1)
-        nkw = min(nk_full, max_tiles)
+        nkw = min(nk_full, _live_k_tiles(block_q, block_k, window))
     else:
         nkw = nk_full
     windowed_grid = nkw < nk_full
@@ -328,15 +347,23 @@ def _fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_s, *, scale, causal, block_q, block_k, window=None,
+    windowed_grid=False,
 ):
-    qi, ki = pl.program_id(2), pl.program_id(3)
+    qi, kr = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
-    @pl.when(ki == 0)
+    @pl.when(kr == 0)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    run = _tile_live(causal, window, qi, ki, block_q, block_k)
+    if windowed_grid:
+        # Shrunken inner k-grid, same mapping as the forward.
+        kb_raw = _window_k_tile(qi, kr, block_q, block_k, nk)
+        ki = jnp.maximum(kb_raw, 0)
+        run = kb_raw >= 0
+    else:
+        ki = kr
+        run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -378,7 +405,7 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kr == nk - 1)
     def _finish():
         dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
@@ -386,17 +413,34 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, block_q, block_k,
-    window=None,
+    window=None, nq_eff, nq_total, windowed_grid=False,
 ):
-    ki, qi = pl.program_id(2), pl.program_id(3)
-    nq = pl.num_programs(3)
+    """dk/dv for ONE kv head: the grid is (B, KVH, k-tiles, inner)
+    with inner = group * nq_eff — all of a kv head's query heads and
+    q-tiles accumulate consecutively into its dk/dv block (the
+    revisit pattern Pallas requires), which is what makes the
+    backward GQA-native with no repeated K/V tensor. With a window,
+    nq_eff is the exact per-k-tile live q-tile bound and the q index
+    map offsets from the k-tile's first live q-tile."""
+    ki, gq = pl.program_id(2), pl.program_id(3)
+    n_inner = pl.num_programs(3)
 
-    @pl.when(qi == 0)
+    @pl.when(gq == 0)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    run = _tile_live(causal, window, qi, ki, block_q, block_k)
+    qr = gq % nq_eff
+    if windowed_grid:
+        qt_raw = (ki * block_k) // block_q + qr
+        qi = jnp.minimum(qt_raw, nq_total - 1)
+        run = jnp.logical_and(
+            qt_raw < nq_total,
+            _tile_live(causal, window, qi, ki, block_q, block_k),
+        )
+    else:
+        qi = qr
+        run = _tile_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _step():
@@ -440,7 +484,7 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(gq == n_inner - 1)
     def _finish():
         dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
@@ -450,10 +494,11 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
          interpret, g_lse=None, window=None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    kvh = k.shape[2]
+    group = h // kvh
     mask3 = mask.astype(jnp.float32)[:, None, :]
-    qt, kt, vt, ot, gt = (
-        x.transpose(0, 2, 1, 3) for x in (q, k, v, out, g)
-    )
+    qt, ot, gt = (x.transpose(0, 2, 1, 3) for x in (q, out, g))
+    kt, vt = (x.transpose(0, 2, 1, 3) for x in (k, v))  # [B, KVH, L, D]
     # delta_i = Σ_d dO_i · O_i — one cheap fused elementwise+reduce in
     # XLA; saves the backward kernels a dot each per tile. A cotangent
     # on the LSE output folds in here exactly: ∂lse_i/∂s_ij = p_ij, so
@@ -469,25 +514,44 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     lse4 = lse[..., None]
     delta4 = delta[..., None]
 
+    nq = lq // block_q
+    nk_full = lk // block_k
+    windowed = causal and window is not None
+
+    # -- dq: q-tiles accumulate over (a shrunken set of) k-tiles ------
+    nkq = (
+        min(nk_full, _live_k_tiles(block_q, block_k, window))
+        if windowed
+        else nk_full
+    )
+    dq_windowed = nkq < nk_full
+
+    def _kb(qi, kr):
+        if dq_windowed:
+            return jnp.maximum(_window_k_tile(qi, kr, block_q, block_k, nkq), 0)
+        return kr
+
     q_spec = pl.BlockSpec(
-        (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        (1, 1, block_q, d), lambda bi, hi, qi, kr: (bi, hi, qi, 0)
     )
     kv_spec = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+        (1, 1, block_k, d),
+        lambda bi, hi, qi, kr: (bi, hi // group, _kb(qi, kr), 0),
     )
     mask_spec = pl.BlockSpec(
-        (1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)
+        (1, 1, block_k), lambda bi, hi, qi, kr: (bi, 0, _kb(qi, kr))
     )
     row_spec = pl.BlockSpec(
-        (1, 1, block_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        (1, 1, block_q, 1), lambda bi, hi, qi, kr: (bi, hi, qi, 0)
     )
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, window=window,
+            windowed_grid=dq_windowed,
         ),
-        grid=(b, h, lq // block_q, lk // block_k),
+        grid=(b, h, nq, nkq),
         in_specs=[q_spec, kv_spec, kv_spec, mask_spec, q_spec, row_spec,
                   row_spec],
         out_specs=q_spec,
@@ -496,26 +560,50 @@ def _bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qt, kt, vt, mask3, gt, lse4, delta4)
 
-    # dk/dv: k-tiles accumulate over q-tiles — swap the outer/inner
-    # grid roles (index maps see (bi, hi, ki, qi)).
+    # -- dk/dv: GQA-native grid (B, KVH, k-tiles, group * q-tiles) ----
+    # Every (query head, q-tile) of one kv head accumulates
+    # CONSECUTIVELY into its dk/dv block — the revisit pattern Pallas
+    # requires — so no repeated K/V tensor is needed. With a window,
+    # the inner q-range shrinks to the exact per-alignment bound of
+    # live q-tiles, offset from each k-tile's first.
+    nq_eff = (
+        min(nq, _live_q_tiles(block_q, block_k, window))
+        if windowed
+        else nq
+    )
+    dkv_windowed = nq_eff < nq
+
+    def _hq(kvi, gq):
+        return kvi * group + gq // nq_eff
+
+    def _qt(ki, gq):
+        if dkv_windowed:
+            return jnp.minimum(
+                (ki * block_k) // block_q + gq % nq_eff, nq - 1
+            )
+        return gq % nq_eff
+
     q_spec_T = pl.BlockSpec(
-        (1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+        (1, 1, block_q, d),
+        lambda bi, kvi, ki, gq: (bi, _hq(kvi, gq), _qt(ki, gq), 0),
     )
     kv_spec_T = pl.BlockSpec(
-        (1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+        (1, 1, block_k, d), lambda bi, kvi, ki, gq: (bi, kvi, ki, 0)
     )
     mask_spec_T = pl.BlockSpec(
-        (1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)
+        (1, 1, block_k), lambda bi, kvi, ki, gq: (bi, 0, ki)
     )
     row_spec_T = pl.BlockSpec(
-        (1, 1, block_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+        (1, 1, block_q, 1),
+        lambda bi, kvi, ki, gq: (bi, _hq(kvi, gq), _qt(ki, gq), 0),
     )
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, window=window,
+            nq_eff=nq_eff, nq_total=nq, windowed_grid=dkv_windowed,
         ),
-        grid=(b, h, lk // block_k, lq // block_q),
+        grid=(b, kvh, nk_full, group * nq_eff),
         in_specs=[q_spec_T, kv_spec_T, kv_spec_T, mask_spec_T, q_spec_T,
                   row_spec_T, row_spec_T],
         out_specs=[kv_spec_T, kv_spec_T],
@@ -560,23 +648,13 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret,
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, mask, out, lse = res
     g_o, g_lse = g
-    # GQA backward: run the kernels at full query-head width (repeat
-    # K/V) and fold each group's dk/dv back onto its shared kv head.
-    # The FORWARD never materialises the repeat (the kv BlockSpec
-    # indexes hi // group); making the backward repeat-free too needs
-    # a dkv grid reorder (the group's non-consecutive output-block
-    # revisits) — recorded as a next step, training-path only.
-    group = q.shape[2] // k.shape[2]
-    kf = jnp.repeat(k, group, axis=2) if group > 1 else k
-    vf = jnp.repeat(v, group, axis=2) if group > 1 else v
+    # GQA is native in BOTH backward kernels now: the dkv grid runs
+    # per kv head with its whole group accumulating consecutively, so
+    # no repeated K/V tensor exists in the backward either.
     dq, dk, dv = _bwd(
-        q, kf, vf, mask, out, lse, g_o, causal, scale, block_q, block_k,
+        q, k, v, mask, out, lse, g_o, causal, scale, block_q, block_k,
         interpret, g_lse=g_lse, window=window,
     )
-    if group > 1:
-        b, lk, _, d = dk.shape
-        dk = dk.reshape(b, lk, k.shape[2], group, d).sum(3)
-        dv = dv.reshape(b, lk, v.shape[2], group, d).sum(3)
     return dq, dk, dv, jnp.zeros_like(mask)
 
 

@@ -377,16 +377,10 @@ def test_window_grid_covers_every_live_tile():
         (16, 16, 8, 64), (16, 16, 16, 64), (32, 16, 24, 128),
         (16, 32, 40, 128), (32, 32, 32, 256), (16, 16, 50, 128),
     ]:
-        import math
+        from mlapi_tpu.ops.pallas.flash_attention import _live_k_tiles
 
         nk_full = l // bk
-        g = math.gcd(bq, bk)
-        max_tiles = 0
-        for r in range(0, bk, g):
-            first = (r - window + 1) // bk
-            last = (r + bq - 1) // bk
-            max_tiles = max(max_tiles, last - first + 1)
-        nkw = min(nk_full, max_tiles)  # mirrors _fwd's exact bound
+        nkw = min(nk_full, _live_k_tiles(bq, bk, window))
         for qi in range(l // bq):
             visited = {
                 max(0, int(_window_k_tile(qi, ki, bq, bk, nkw)))
