@@ -293,7 +293,7 @@ def bench_generate() -> None:
     decode through the full HTTP stack (r1 criterion: batched decode
     must deliver a multiple of single-stream throughput)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from mlapi_tpu.serving.loadgen import run_load
+    from mlapi_tpu.serving.loadgen import build_request, run_load
 
     workdir = tempfile.mkdtemp(prefix="mlapi_tpu_bench_gen_")
     startup_timeout = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "240"))
@@ -326,6 +326,21 @@ def bench_generate() -> None:
             for m in (8, 8, 8, n_new)
         ]
 
+        short = {"text": "hi there", "max_new_tokens": 4}
+
+        async def scrape_metrics() -> dict:
+            reader, writer = await asyncio.open_connection("127.0.0.1", PORT)
+            try:
+                writer.write(build_request("127.0.0.1", "/metrics"))
+                await writer.drain()
+                head = await reader.readuntil(b"\r\n\r\n")
+                i = head.lower().find(b"content-length:")
+                j = head.index(b"\r\n", i)
+                body = await reader.readexactly(int(head[i + 15: j]))
+                return json.loads(body)
+            finally:
+                writer.close()
+
         async def measure():
             await run_load(  # warm residual shapes
                 "127.0.0.1", PORT, "/generate", payload=payload,
@@ -343,9 +358,36 @@ def bench_generate() -> None:
                 "127.0.0.1", PORT, "/generate", payload=mixed,
                 concurrency=8, duration_s=8.0,
             )
-            return single, batched, mixed_r
+            # Head-of-line probe: short requests' latency WHILE long
+            # generations continuously occupy the decode loop. With
+            # continuous batching the shorts are admitted into the
+            # running batch at a chunk boundary; without it each short
+            # waits for a whole long batch to finish.
+            shorts_alone = await run_load(
+                "127.0.0.1", PORT, "/generate", payload=short,
+                concurrency=2, duration_s=4.0,
+            )
+            before = await scrape_metrics()
+            longs, shorts_holb = await asyncio.gather(
+                run_load(
+                    "127.0.0.1", PORT, "/generate", payload=payload,
+                    concurrency=2, duration_s=6.0,
+                ),
+                run_load(
+                    "127.0.0.1", PORT, "/generate", payload=short,
+                    concurrency=2, duration_s=6.0,
+                ),
+            )
+            after = await scrape_metrics()
+            admitted = (
+                after["counters"].get("generate.admitted", 0)
+                - before["counters"].get("generate.admitted", 0)
+            )
+            return (single, batched, mixed_r, shorts_alone, shorts_holb,
+                    admitted)
 
-        single, batched, mixed_r = asyncio.run(measure())
+        (single, batched, mixed_r, shorts_alone, shorts_holb,
+         admitted) = asyncio.run(measure())
         single_tps = single.throughput * n_new
         batched_tps = batched.throughput * n_new
         # Weight by ACTUAL completions per template: closed-loop
@@ -384,8 +426,20 @@ def bench_generate() -> None:
                         "mixed_p50_ms": round(
                             mixed_r.quantile(0.5) or -1, 1
                         ),
+                        # Continuous batching: short-request latency
+                        # behind continuous long generations, vs
+                        # shorts alone; `holb_admitted` counts actual
+                        # mid-batch admissions during the probe.
+                        "short_alone_p50_ms": round(
+                            shorts_alone.quantile(0.5) or -1, 1
+                        ),
+                        "holb_short_p50_ms": round(
+                            shorts_holb.quantile(0.5) or -1, 1
+                        ),
+                        "holb_admitted": admitted,
                         "errors": (
                             single.errors + batched.errors + mixed_r.errors
+                            + shorts_alone.errors + shorts_holb.errors
                         ),
                         "backend": health.get("backend"),
                         "note": note_extra

@@ -386,8 +386,12 @@ def _pick_token(temps, logits, key_data, step, top_k=None, top_p=None):
     from ``logits / temps[b]`` — optionally top-k/top-p (nucleus)
     filtered — with the row's own PRNG stream
     (``fold_in(row_key, step)``): a row's tokens do not depend on
-    which batch slot it landed in."""
+    which batch slot it landed in. ``step`` may be a scalar or a
+    per-row ``[B]`` vector — rows admitted into a running batch
+    (continuous batching) sample at their OWN token index, so the
+    stream matches a solo run exactly."""
     b = logits.shape[0]
+    step = jnp.broadcast_to(jnp.asarray(step, jnp.int32), (b,))
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe_t = jnp.where(temps > 0.0, temps, 1.0)
     scaled = logits / safe_t[:, None]
@@ -408,8 +412,8 @@ def _pick_token(temps, logits, key_data, step, top_k=None, top_p=None):
         scaled,
     )
     keys = jax.vmap(
-        lambda kd: jax.random.fold_in(jax.random.wrap_key_data(kd), step)
-    )(key_data)
+        lambda kd, s: jax.random.fold_in(jax.random.wrap_key_data(kd), s)
+    )(key_data, step)
     sampled = jax.vmap(
         lambda k, lg: jax.random.categorical(k, lg)
     )(keys, scaled).astype(jnp.int32)
@@ -520,21 +524,25 @@ def _decode_scan(
 
     ``tok`` ``[B]`` is the last emitted token (fed back in), ``pos``
     the traced cache position it occupies + 1 is written next;
-    ``step0`` the traced sampling-stream offset (so chunked decoding
-    reproduces the single-scan token stream exactly). Returns
+    ``step0`` the traced sampling-stream offset — scalar or per-row
+    ``[B]`` (so chunked decoding reproduces the single-scan token
+    stream exactly, including rows admitted mid-batch at a different
+    token index than their neighbours). Returns
     ``(tokens [B, n_steps], cache, last_tok)``.
     """
+    b = tok.shape[0]
+    step0 = jnp.broadcast_to(jnp.asarray(step0, jnp.int32), (b,))
 
     def step(carry, i):
         cache, tok, pos = carry
         logits, cache = model.decode_step(
             params, cache, tok[:, None], pos, n_pad
         )
-        nxt = _pick_token(temps, logits, key_data, i, top_k, top_p)
+        nxt = _pick_token(temps, logits, key_data, i + step0, top_k, top_p)
         return (cache, nxt, pos + 1), nxt
 
     (cache, tok, _), toks = jax.lax.scan(
-        step, (cache, tok, pos), jnp.arange(n_steps) + step0
+        step, (cache, tok, pos), jnp.arange(n_steps)
     )
     return toks.T, cache, tok
 
@@ -578,6 +586,42 @@ def prefill_fn(model, total_len: int):
         return _pick_token(temps, logits, key_data, 0, top_k, top_p), cache
 
     return jax.jit(_run)
+
+
+@functools.lru_cache(maxsize=128)
+def admit_prefill_fn(model, bucket: int, total: int):
+    """Jitted continuous-batching admission program: prefill ONE
+    joiner's left-padded ``[1, bucket]`` prompt and scatter its K/V
+    into row ``r`` of a RUNNING batch's ``[B, total]`` cache, ending
+    at the batch's current decode position ``pos`` (both traced
+    scalars — one compile covers every admission point).
+
+    Cache-slot layout for the admitted row: real prompt tokens land in
+    slots ``[pos - used, pos)`` and everything earlier is masked via
+    ``n_pad_row = pos - used``, so the next decode step (which writes
+    at ``pos``) sees exactly the joiner's prompt at effective
+    positions ``0..used-1`` — byte-identical semantics to a row that
+    was in the batch from its own prefill. Returns
+    ``(cache, first_tok [1])``; the first token samples at the
+    joiner's OWN stream index 0.
+    """
+
+    def _run(params, cache, prompt_ids, n_pad1, key_data, temps,
+             top_k, top_p, r, pos):
+        mini, logits = _prefill_core(model, params, prompt_ids, n_pad1,
+                                     bucket)
+        first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
+        off = pos - bucket
+
+        def scatter(big, small):
+            start = (r,) + (off,) + (0,) * (big.ndim - 2)
+            return jax.lax.dynamic_update_slice(
+                big, small.astype(big.dtype), start
+            )
+
+        return jax.tree.map(scatter, cache, mini), first
+
+    return jax.jit(_run, donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=64)

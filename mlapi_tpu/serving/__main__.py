@@ -120,6 +120,95 @@ def _watch_and_reexec(argv) -> int:
             return 0
 
 
+def _supervise_workers(n: int, ckpt: str, args) -> int:
+    """SO_REUSEPORT worker pool: spawn ``n`` fresh server processes
+    all bound to the same (host, port), restart any that die, fan out
+    SIGTERM on shutdown. This is the CPU-attach scale-out (one asyncio
+    loop saturates one core at ~6-8k req/s); the TPU is
+    single-process-exclusive, so TPU scale-out is more chips on a DP
+    mesh, not more processes — workers are pinned to CPU unless the
+    operator overrides ``MLAPI_TPU_PLATFORM`` themselves."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ, MLAPI_TPU_WORKER="1")
+    if not env.get("MLAPI_TPU_PLATFORM"):
+        env["MLAPI_TPU_PLATFORM"] = "cpu"
+        _log.info(
+            "--workers: pinning workers to CPU (MLAPI_TPU_PLATFORM=cpu); "
+            "the TPU is single-process-exclusive — scale TPU serving "
+            "with more chips, not more processes"
+        )
+    cmd = [
+        sys.executable, "-m", "mlapi_tpu.serving",
+        "--checkpoint", ckpt, "--host", args.host, "--port", str(args.port),
+        "--max-wait-ms", str(args.max_wait_ms),
+    ]
+    if args.max_batch is not None:
+        cmd += ["--max-batch", str(args.max_batch)]
+    children = [subprocess.Popen(cmd, env=env) for _ in range(n)]
+    spawned_at = [time.time()] * n
+    restart_at = [0.0] * n   # earliest next respawn (backoff)
+    backoff = [0.5] * n      # doubles on fast deaths, resets on survival
+    fast_deaths = 0          # consecutive across ALL workers
+    _log.info("spawned %d workers on %s:%d", n, args.host, args.port)
+    try:
+        while True:
+            time.sleep(0.5)
+            for i, c in enumerate(children):
+                if c is None:
+                    continue
+                rc = c.poll()
+                if rc is None:
+                    continue
+                lived = time.time() - spawned_at[i]
+                if lived < 5.0:
+                    # Died during/just after startup: back off — a
+                    # persistent boot failure (bad checkpoint, bind
+                    # error) must not crash-loop at full import cost.
+                    fast_deaths += 1
+                    backoff[i] = min(30.0, backoff[i] * 2)
+                    if fast_deaths >= 3 * n:
+                        _log.error(
+                            "workers keep dying at startup (rc=%d); "
+                            "giving up", rc,
+                        )
+                        return 1
+                else:
+                    fast_deaths = 0
+                    backoff[i] = 0.5
+                _log.warning(
+                    "worker %d (pid %d) exited rc=%d after %.1fs; "
+                    "restarting in %.1fs", i, c.pid, rc, lived, backoff[i],
+                )
+                restart_at[i] = time.time() + backoff[i]
+                spawned_at[i] = time.time() + backoff[i]
+                children[i] = None  # placeholder until respawn
+
+            for i, c in enumerate(children):
+                if c is None and time.time() >= restart_at[i]:
+                    children[i] = subprocess.Popen(cmd, env=env)
+                    spawned_at[i] = time.time()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for c in children:
+            if c is not None and c.poll() is None:
+                c.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for c in children:
+            if c is None:
+                continue
+            try:
+                c.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                c.kill()
+    return 0
+
+
 def main(argv=None) -> None:
     from mlapi_tpu.utils.platform import apply_platform_override
 
@@ -134,6 +223,11 @@ def main(argv=None) -> None:
     parser.add_argument("--max-batch", type=int, default=None)
     parser.add_argument(
         "--max-wait-ms", type=float, default=0.2, help="micro-batch window"
+    )
+    parser.add_argument(
+        "--workers", type=int, default=1,
+        help="number of SO_REUSEPORT server processes (CPU-attach "
+             "scale-out; needs an explicit --port)",
     )
     parser.add_argument(
         "--profiler-port", type=int, default=0,
@@ -165,9 +259,20 @@ def main(argv=None) -> None:
         parser.error("need --checkpoint or --demo-iris")
     ckpt = args.checkpoint or _demo_iris_checkpoint()
 
+    import os
+    import sys
+
+    is_worker = os.environ.get("MLAPI_TPU_WORKER") == "1"
+    if args.workers > 1 and not is_worker:
+        if args.port == 0:
+            parser.error("--workers needs an explicit --port "
+                         "(every worker binds the same one)")
+        sys.exit(_supervise_workers(args.workers, ckpt, args))
+
     engine = InferenceEngine.from_checkpoint(ckpt)
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
-    server = Server(app, host=args.host, port=args.port)
+    server = Server(app, host=args.host, port=args.port,
+                    reuse_port=is_worker)
     try:
         asyncio.run(server.serve_forever())
     except KeyboardInterrupt:

@@ -519,6 +519,8 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
 
     @app.get("/healthz")
     async def healthz():
+        import os
+
         import jax
 
         return {
@@ -528,6 +530,10 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             "checkpoint": engine.meta,
             "backend": jax.default_backend(),
             "device_count": jax.device_count(),
+            # Which worker process answered — observability for
+            # SO_REUSEPORT multi-worker serving (and the multiworker
+            # test's distribution check).
+            "pid": os.getpid(),
         }
 
     @app.get("/metrics")
@@ -552,6 +558,9 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.cancelled_batches"] = (
                 engine.cancelled_batches
             )
+            snap["counters"]["generate.compactions"] = engine.compactions
+            snap["counters"]["generate.admitted"] = engine.admitted
+            snap["counters"]["generate.growths"] = engine.growths
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

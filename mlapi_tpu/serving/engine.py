@@ -365,18 +365,19 @@ def _dispatch_rtt_ms(samples: int = 3) -> float:
 
 @functools.cache
 def _compact_fn():
-    """Jitted leading-dim gather over the whole decode state: select
-    the still-active rows (plus dummy repeats up to a power of two)
-    out of the cache/token/param vectors. The gather shrinks the
-    leading dim, so it cannot alias the old buffers — peak HBM during
-    a compaction is briefly old + new cache (then the old one frees).
-    Compiled once per (from, to, cache-tier) shape; compaction halves
-    the batch at most once per chunk, so the shape set is the halving
-    chain the warmup grid covers."""
+    """Jitted leading-dim gather over the KV cache: select a new set
+    of device rows (still-active rows for compaction, old rows plus
+    dummy repeats for batch growth). The gather changes the leading
+    dim, so it cannot alias the old buffers — peak HBM during a
+    resize is briefly old + new cache (then the old one frees).
+    Compiled once per (from, to, cache-tier) shape; the batch resizes
+    along the power-of-two chain only, which the warmup grid covers.
+    Per-row request vectors (temps/keys/pads/steps) live on the host
+    and are re-uploaded with each chunk dispatch — only the cache is
+    device-resident state."""
 
-    def _run(cache, vecs, sel):
-        gather = lambda a: a[sel]  # noqa: E731
-        return jax.tree.map(gather, cache), jax.tree.map(gather, vecs)
+    def _run(cache, sel):
+        return jax.tree.map(lambda a: a[sel], cache)
 
     return jax.jit(_run)
 
@@ -462,6 +463,21 @@ class TextGenerationEngine:
         # Batcher state (started by the app's startup hook).
         self._queue: asyncio.Queue | None = None
         self._task: asyncio.Task | None = None
+        # Continuous-batching handoff: requests the collector has
+        # popped while a batch is RUNNING, waiting to be admitted at a
+        # chunk boundary (decode thread) or swept into the next batch
+        # (collector, after the running one ends).
+        import threading
+
+        self._admit: list = []
+        self._alock = threading.Lock()
+        # Admission is gated to warmed shapes once a full warmup ran,
+        # so a joiner can never stall the running batch on an XLA
+        # compile; before/without full warmup (tests, CPU), admission
+        # is unrestricted.
+        self._strict_admit = False
+        self._warmed_admit: set = set()
+        self._warmed_growth: set = set()
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -469,10 +485,14 @@ class TextGenerationEngine:
         self.rejected = 0
         self.cancelled_batches = 0
         self.compactions = 0
+        self.admitted = 0
+        self.growths = 0
 
     @property
     def queue_depth(self) -> int:
-        return self._queue.qsize() if self._queue is not None else 0
+        base = self._queue.qsize() if self._queue is not None else 0
+        with self._alock:
+            return base + len(self._admit)
 
     # Shared surface with the classification engines (healthz, app).
     @property
@@ -525,11 +545,36 @@ class TextGenerationEngine:
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
-    def _run_batch(self, reqs: list) -> None:
+    @staticmethod
+    def _key_data(seed: int) -> np.ndarray:
+        return np.asarray(jax.random.key_data(jax.random.key(seed)))
+
+    def _run_batch(self, reqs: list, admit: bool = False) -> None:
         """Decode one coalesced batch, streaming chunks to each
         request's queue; a ``None`` sentinel marks completion, an
-        exception object marks failure."""
-        from mlapi_tpu.models.gpt import decode_chunk_fn, prefill_fn
+        exception object marks failure.
+
+        With ``admit=True`` (the collector's batches) this is a
+        CONTINUOUS batch: at every chunk boundary, waiting requests
+        whose prompt bucket and token budget fit the running cache are
+        prefilled into a free device row (``admit_prefill_fn``) and
+        decode alongside the original members — a long generation no
+        longer head-of-line-blocks short arrivals. Admission is
+        tier-aligned so it never compiles on the request path: joiners
+        are only taken when their (bucket, cache, batch) admission
+        program was warmed (strict mode), the batch grows along the
+        warmed power-of-two chain only, and per-row sampling-stream
+        indices keep every row's output byte-identical to a solo run.
+
+        Device-resident state is the KV cache and nothing else: all
+        per-row vectors (pads, temps, keys, stream steps, last token)
+        are host mirrors re-uploaded with each chunk dispatch, which
+        is what makes admission/compaction/growth bookkeeping plain
+        numpy instead of extra device programs.
+        """
+        from mlapi_tpu.models.gpt import (
+            admit_prefill_fn, decode_chunk_fn, prefill_fn,
+        )
 
         try:
             self.batch_calls += 1
@@ -545,6 +590,9 @@ class TextGenerationEngine:
             b_pad = 1
             while b_pad < b:
                 b_pad *= 2
+            b_max = 1
+            while b_max < self.max_batch:
+                b_max *= 2
 
             prompt = np.full((b_pad, bucket), self.tokenizer.pad_id, np.int32)
             n_pad = np.full((b_pad,), max(bucket - 1, 0), np.int32)
@@ -557,127 +605,231 @@ class TextGenerationEngine:
                 temps[i] = r.temperature
                 topk[i] = r.top_k
                 topp[i] = r.top_p
-            zero_key = np.asarray(jax.random.key_data(jax.random.key(0)))
-            key_data = np.stack(
-                [
-                    np.asarray(jax.random.key_data(jax.random.key(r.seed)))
-                    for r in reqs
-                ]
-                + [zero_key] * (b_pad - b)
+            keys = np.stack(
+                [self._key_data(r.seed) for r in reqs]
+                + [self._key_data(0)] * (b_pad - b)
             )
 
-            topk_j, topp_j = jnp.asarray(topk), jnp.asarray(topp)
             first, cache = prefill_fn(self.model, total)(
-                self.params, jnp.asarray(prompt), jnp.asarray(key_data),
-                jnp.asarray(temps), jnp.asarray(n_pad), topk_j, topp_j,
+                self.params, jnp.asarray(prompt), jnp.asarray(keys),
+                jnp.asarray(temps), jnp.asarray(n_pad), jnp.asarray(topk),
+                jnp.asarray(topp),
             )
-            tok = first
-            first_host = np.asarray(first)
-            produced = 1
+            tok = np.asarray(first)
+            # step[row]: the row's NEXT sampling-stream index — its own
+            # produced-token count, NOT a batch-global counter, so a
+            # row admitted later still reproduces its solo stream.
+            step = np.ones((b_pad,), np.int32)
+            produced = [1] * b
             done = [False] * b
             for i, r in enumerate(reqs):
-                r.push({"token_ids": [int(first_host[i])]})
+                r.push({"token_ids": [int(tok[i])]})
                 if r.n_new <= 1:
                     r.push(None)
                     done[i] = True
 
             dc = decode_chunk_fn(self.model, self.chunk)
-            n_pad_j, temps_j, keys_j = (
-                jnp.asarray(n_pad), jnp.asarray(temps), jnp.asarray(key_data)
-            )
-            pos, step = bucket, 1
+            pos = bucket
             # rows[i]: request i's current row in the (possibly
-            # compacted) device batch. Rows are independent (per-row
+            # resized) device batch. Rows are independent (per-row
             # mask/positions/PRNG streams), so gathering live rows
-            # into a smaller warmed program changes nothing but cost.
-            rows = list(range(b))
+            # into a different-size warmed program changes nothing
+            # but cost.
+            rows: list = list(range(b))
             b_cur = b_pad
+
+            def mirrors_take(sel: np.ndarray) -> None:
+                nonlocal n_pad, temps, topk, topp, keys, tok, step
+                n_pad, temps, topk, topp, tok, step = (
+                    n_pad[sel], temps[sel], topk[sel], topp[sel],
+                    tok[sel], step[sel],
+                )
+                keys = keys[sel]
+
+            def admissible(r) -> bool:
+                """Can ``r`` join the RUNNING batch right now? Its
+                prompt bucket must fit below the current decode
+                position and its remaining tokens (in whole chunks)
+                inside the remaining cache."""
+                bkt = len(r.row)
+                if bkt > pos:
+                    return False
+                steps = -(-(r.n_new - 1) // self.chunk) * self.chunk
+                return pos + steps <= total
+
             while True:
+                pending_n = 0
+                if admit and self._admit:
+                    with self._alock:
+                        candidates = list(self._admit)
+                    taken: list = []
+                    n_live = sum(
+                        1 for i, r in enumerate(reqs)
+                        if not done[i] and not r.cancelled
+                    )
+                    for cand in candidates:
+                        if cand.cancelled:
+                            taken.append(cand)  # drop silently
+                            continue
+                        if n_live + 1 > self.max_batch:
+                            break
+                        if not admissible(cand):
+                            continue
+                        used_rows = {
+                            rows[i] for i, r in enumerate(reqs)
+                            if not done[i] and not r.cancelled
+                        }
+                        free = [
+                            j for j in range(b_cur) if j not in used_rows
+                        ]
+                        grow = not free and b_cur < b_max
+                        bkt = len(cand.row)
+                        if self._strict_admit:
+                            b_t = b_cur * 2 if grow else b_cur
+                            if (bkt, total, b_t) not in self._warmed_admit:
+                                continue
+                            if grow and (
+                                (b_cur, b_cur * 2, total)
+                                not in self._warmed_growth
+                            ):
+                                continue
+                        if not free and not grow:
+                            break
+                        if grow:
+                            # Batch growth: double along the warmed
+                            # power-of-two chain; new rows are dummies
+                            # until admitted into.
+                            sel = np.concatenate(
+                                [np.arange(b_cur), np.zeros(b_cur)]
+                            ).astype(np.int32)
+                            cache = _compact_fn()(cache, jnp.asarray(sel))
+                            mirrors_take(sel)
+                            n_pad[b_cur:] = pos  # mask dummy rows fully
+                            temps[b_cur:] = 0.0
+                            b_cur *= 2
+                            free = list(range(b_cur // 2, b_cur))
+                            self.growths += 1
+                        row = free[0]
+                        af = admit_prefill_fn(self.model, bkt, total)
+                        cache, first1 = af(
+                            self.params, cache, jnp.asarray(cand.row[None]),
+                            jnp.asarray(
+                                np.asarray([bkt - cand.used], np.int32)
+                            ),
+                            jnp.asarray(self._key_data(cand.seed)[None]),
+                            jnp.asarray(
+                                np.asarray([cand.temperature], np.float32)
+                            ),
+                            jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                            jnp.asarray(
+                                np.asarray([cand.top_p], np.float32)
+                            ),
+                            jnp.int32(row), jnp.int32(pos),
+                        )
+                        ftok = int(np.asarray(first1)[0])
+                        n_pad[row] = pos - cand.used
+                        temps[row] = cand.temperature
+                        topk[row] = cand.top_k
+                        topp[row] = cand.top_p
+                        keys[row] = self._key_data(cand.seed)
+                        tok[row] = ftok
+                        step[row] = 1
+                        reqs.append(cand)
+                        rows.append(row)
+                        produced.append(1)
+                        cand.push({"token_ids": [ftok]})
+                        fin = cand.n_new <= 1
+                        if fin:
+                            cand.push(None)
+                        done.append(fin)
+                        if not fin:
+                            n_live += 1
+                        taken.append(cand)
+                        self.admitted += 1
+                    if taken:
+                        with self._alock:
+                            for t in taken:
+                                try:
+                                    self._admit.remove(t)
+                                except ValueError:
+                                    pass
+                    with self._alock:
+                        pending_n = len(self._admit)
                 live = [
                     i for i, r in enumerate(reqs)
                     if not done[i] and not r.cancelled
                 ]
                 if not live:
-                    # Every remaining consumer disconnected: stop
-                    # burning device time on abandoned work.
+                    # Every remaining consumer disconnected or
+                    # finished: stop burning device time.
                     if not all(done):
                         self.cancelled_batches += 1
                     break
-                # The batch only needs to run as long as a live
-                # request still wants tokens (a finished or cancelled
-                # straggler must not keep the loop decoding to the
-                # global max); n_new_max keeps the cache-window clamp.
-                if produced >= min(
-                    n_new_max, max(reqs[i].n_new for i in live)
-                ):
-                    break
+                if pos + self.chunk > total:
+                    break  # cache exhausted — safety net below
                 want_b = 1
                 while want_b < len(live):
                     want_b *= 2
                 # At most one halving per chunk: keeps the compaction
                 # shape set to the halving chain (8→4→2→1), which the
                 # warmup grid compiles — an arbitrary (from, to) jump
-                # would compile on the request path.
+                # would compile on the request path. Skip shrinking
+                # while joiners wait: they would force a regrow.
                 want_b = max(want_b, b_cur // 2)
-                if want_b < b_cur:
-                    # Batch compaction: half (or more) of the rows
-                    # finished — continue in the next-smaller
-                    # power-of-two program on the live rows only.
+                if want_b < b_cur and not pending_n:
                     sel = [rows[i] for i in live]
                     sel += [sel[0]] * (want_b - len(sel))
-                    cache, (tok, n_pad_j, temps_j, keys_j, topk_j,
-                            topp_j) = _compact_fn()(
-                        cache,
-                        (tok, n_pad_j, temps_j, keys_j, topk_j, topp_j),
-                        jnp.asarray(np.asarray(sel, np.int32)),
-                    )
-                    rows = [None] * b
+                    sel = np.asarray(sel, np.int32)
+                    cache = _compact_fn()(cache, jnp.asarray(sel))
+                    mirrors_take(sel)
+                    rows = [None] * len(reqs)
                     for row, i in enumerate(live):
                         rows[i] = row
                     b_cur = want_b
                     self.compactions += 1
                 self.chunk_calls += 1
-                toks, cache, tok = dc(
-                    self.params, cache, tok, jnp.int32(pos),
-                    n_pad_j, temps_j, keys_j, jnp.int32(step),
-                    topk_j, topp_j,
+                toks, cache, _ = dc(
+                    self.params, cache, jnp.asarray(tok), jnp.int32(pos),
+                    jnp.asarray(n_pad), jnp.asarray(temps),
+                    jnp.asarray(keys), jnp.asarray(step),
+                    jnp.asarray(topk), jnp.asarray(topp),
                 )
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
+                tok = toks_host[:, -1].copy()
+                step = step + np.int32(got)
                 for i in live:
                     r = reqs[i]
                     if r.cancelled:
                         continue
-                    want = r.n_new - produced
+                    want = r.n_new - produced[i]
                     if want > 0:
                         r.push(
                             {"token_ids":
                                  toks_host[rows[i], : min(want, got)]
                                  .tolist()}
                         )
+                        produced[i] += got
                         if want <= got:
                             r.push(None)
                             done[i] = True
                 pos += got
-                step += got
-                produced += got
             # Safety net: every waiter MUST get a terminator. The
-            # collector only batches window-compatible requests, so
-            # this fires only if that invariant is ever broken — a
-            # loud error beats a silently-truncated hang.
+            # collector/admission only group window-compatible
+            # requests, so this fires only if that invariant is ever
+            # broken — a loud error beats a silently-truncated hang.
             for i, r in enumerate(reqs):
                 if done[i] or r.cancelled:
                     continue
-                if r.n_new > n_new_max:
-                    _log.error(
-                        "request truncated at %d/%d tokens (batch window "
-                        "exhausted) — collector grouping bug?",
-                        n_new_max, r.n_new,
-                    )
-                    r.push(RuntimeError(
-                        f"generation truncated at {n_new_max}/{r.n_new} "
-                        "tokens (incompatible batch)"
-                    ))
+                _log.error(
+                    "request truncated at %d/%d tokens (batch window "
+                    "exhausted) — collector grouping bug?",
+                    produced[i], r.n_new,
+                )
+                r.push(RuntimeError(
+                    f"generation truncated at {produced[i]}/{r.n_new} "
+                    "tokens (incompatible batch)"
+                ))
         except Exception as e:  # noqa: BLE001 — delivered to every waiter
             _log.error("generation batch of %d failed: %s", len(reqs), e)
             for r in reqs:
@@ -720,10 +872,34 @@ class TextGenerationEngine:
         loop = asyncio.get_running_loop()
         carry: list = []  # window-incompatible leftovers, served next
         reqs: list = []
+        get = None  # in-flight queue pop (outer so the finally sees it)
         try:
             while True:
-                reqs = carry or [await self._queue.get()]
-                carry = []
+                # Requests a running batch could not admit come first.
+                # They were staged independently, so re-apply the
+                # window-compatibility check and the max_batch cap
+                # when forming the batch from them (the sweep can
+                # hold many mutually-incompatible requests; batching
+                # them blindly would truncate the long ones and pad
+                # the device batch past the warmed grid).
+                with self._alock:
+                    carry = self._admit + carry
+                    self._admit.clear()
+                if carry:
+                    reqs = [carry[0]]
+                    rest: list = []
+                    for r in carry[1:]:
+                        if (
+                            len(reqs) < self.max_batch
+                            and self._compatible(reqs, r)
+                        ):
+                            reqs.append(r)
+                        else:
+                            rest.append(r)
+                    carry = rest
+                else:
+                    reqs = [await self._queue.get()]
+                    carry = []
                 if self.max_wait_s > 0:
                     deadline = loop.time() + self.max_wait_s
                     while len(reqs) < self.max_batch:
@@ -752,21 +928,75 @@ class TextGenerationEngine:
                         else:
                             carry.append(nxt)
                             break
-                # One batch decodes at a time (single device stream);
-                # later arrivals batch together while this one runs.
-                await loop.run_in_executor(None, self._run_batch, reqs)
+                # One batch decodes at a time (single device stream).
+                # While it runs, keep draining arrivals into the
+                # admission list: the decode loop takes compatible
+                # ones at chunk boundaries (continuous batching); the
+                # rest are swept into the next batch above.
+                fut = loop.run_in_executor(None, self._run_batch, reqs, True)
+                while not fut.done():
+                    # Backpressure: once a full batch's worth of
+                    # requests is staged for admission, STOP draining
+                    # the bounded queue — otherwise `_admit` would
+                    # grow without bound during a long batch and
+                    # `max_queue` would stop meaning anything. Stalled
+                    # arrivals then fill the queue and shed as 503s.
+                    with self._alock:
+                        backlog = len(self._admit)
+                    if backlog >= self.max_batch:
+                        await asyncio.wait({fut}, timeout=0.05)
+                        continue
+                    get = asyncio.ensure_future(self._queue.get())
+                    # noqa: the outer `get` keeps the last pop visible
+                    # to the finally below — a cancel mid-wait must
+                    # not strand a request the pop already claimed.
+                    await asyncio.wait(
+                        {fut, get}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if get.done() and not get.cancelled():
+                        with self._alock:
+                            self._admit.append(get.result())
+                        get = None
+                    else:
+                        get.cancel()
+                        try:
+                            await get
+                        except asyncio.CancelledError:
+                            # Distinguish OUR cancel of the child pop
+                            # from the ENGINE being stopped: swallowing
+                            # an external cancel here would un-cancel
+                            # the collector and leave stop() awaiting
+                            # it forever (observed deadlock).
+                            if asyncio.current_task().cancelling():
+                                raise
+                        else:
+                            # get won the race with our cancel: the
+                            # queue item is in hand — keep it.
+                            with self._alock:
+                                self._admit.append(get.result())
+                        get = None
+                await fut
                 reqs = []
         finally:
             # Cancellation (stop()) or a collector crash must not
             # strand waiters — neither those already popped off the
-            # queue NOR those still queued (a handler awaiting
-            # ``gen.queue.get()`` on a queued request would otherwise
-            # hang forever after an unexpected collector death).
+            # queue NOR those still queued or awaiting admission (a
+            # handler awaiting ``gen.queue.get()`` on a queued request
+            # would otherwise hang forever after an unexpected
+            # collector death).
             err = RuntimeError("generation engine stopped")
             queued = []
+            if get is not None:
+                if get.done() and not get.cancelled():
+                    queued.append(get.result())
+                else:
+                    get.cancel()
             if self._queue is not None:
                 while not self._queue.empty():
                     queued.append(self._queue.get_nowait())
+            with self._alock:
+                queued += self._admit
+                self._admit.clear()
             for r in (*reqs, *carry, *queued):
                 try:
                     r.push(err)
@@ -903,10 +1133,68 @@ class TextGenerationEngine:
                 if sinks[0].error is not None:
                     raise sinks[0].error
                 shapes += 1
+        if full:
+            shapes += self._warm_admission(batches)
+            # From here on, a joiner is only admitted into a RUNNING
+            # batch when its admission program is already compiled —
+            # an unwarmed shape waits for the next batch instead of
+            # stalling the running one on an XLA compile.
+            self._strict_admit = True
         _log.info(
-            "warmed generate: %d (bucket x batch) shapes, chunk=%d",
+            "warmed generate: %d (bucket x batch x admission) shapes, "
+            "chunk=%d",
             shapes, self.chunk,
         )
+
+    def _warm_admission(self, batches: list) -> int:
+        """Compile the continuous-batching admission grid off the
+        request path: for every default-tier cache shape, every
+        power-of-two batch, and every joiner prompt bucket, the
+        ``admit_prefill_fn`` program plus the batch-growth gather.
+        Populates the warmed-shape sets that gate strict admission."""
+        from mlapi_tpu.models.gpt import admit_prefill_fn
+
+        tier = self.chunk
+        while tier < self.default_max_new_tokens:
+            tier *= 2
+        shapes = 0
+        for run_bucket in self.prompt_buckets:
+            total = min(self.model.max_positions, run_bucket + tier)
+            if total - run_bucket < 1:
+                continue
+            for bsz in batches:
+                if bsz * 2 <= batches[-1]:
+                    sel = np.concatenate(
+                        [np.arange(bsz), np.zeros(bsz)]
+                    ).astype(np.int32)
+                    _compact_fn()(
+                        self.model.init_cache(bsz, total), jnp.asarray(sel)
+                    )
+                    self._warmed_growth.add((bsz, bsz * 2, total))
+                for bj in self.prompt_buckets:
+                    # A joiner's bucket must fit below some reachable
+                    # decode position: pos ranges over
+                    # [run_bucket, total).
+                    if bj >= total:
+                        continue
+                    af = admit_prefill_fn(self.model, bj, total)
+                    prompt = np.full(
+                        (1, bj), self.tokenizer.pad_id, np.int32
+                    )
+                    af(
+                        self.params,
+                        self.model.init_cache(bsz, total),
+                        jnp.asarray(prompt),
+                        jnp.asarray(np.asarray([max(bj - 1, 0)], np.int32)),
+                        jnp.asarray(self._key_data(0)[None]),
+                        jnp.asarray(np.zeros((1,), np.float32)),
+                        jnp.asarray(np.zeros((1,), np.int32)),
+                        jnp.asarray(np.ones((1,), np.float32)),
+                        jnp.int32(0), jnp.int32(bj),
+                    )
+                    self._warmed_admit.add((bj, total, bsz))
+                    shapes += 1
+        return shapes
 
 
 def _load_meta_only(path):

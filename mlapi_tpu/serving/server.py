@@ -42,18 +42,29 @@ class HttpProtocolError(Exception):
 
 
 class Server:
-    """Serves one ASGI app on (host, port)."""
+    """Serves one ASGI app on (host, port).
 
-    def __init__(self, app, host: str = "127.0.0.1", port: int = 8000):
+    ``reuse_port=True`` binds with ``SO_REUSEPORT`` so N worker
+    *processes* can share one listening port, kernel-balanced per
+    connection — the CPU-attach scale-out path (the single asyncio
+    loop is the throughput ceiling on one core; see
+    ``__main__.py --workers``). TPU serving scales with more chips on
+    a mesh instead: the chip is single-process-exclusive.
+    """
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 8000,
+                 *, reuse_port: bool = False):
         self.app = app
         self.host = host
         self.port = port
+        self.reuse_port = reuse_port
         self._server: asyncio.Server | None = None
 
     async def start(self) -> None:
         await self.app.startup()
         self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port
+            self._handle_connection, self.host, self.port,
+            reuse_port=self.reuse_port or None,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         _log.info("listening on http://%s:%d", self.host, self.port)
