@@ -588,40 +588,38 @@ def prefill_fn(model, total_len: int):
     return jax.jit(_run)
 
 
-@functools.lru_cache(maxsize=128)
-def admit_prefill_fn(model, bucket: int, total: int):
-    """Jitted continuous-batching admission program: prefill ONE
-    joiner's left-padded ``[1, bucket]`` prompt and scatter its K/V
-    into row ``r`` of a RUNNING batch's ``[B, total]`` cache, ending
-    at the batch's current decode position ``pos`` (both traced
-    scalars — one compile covers every admission point).
+@functools.cache
+def admit_scatter_fn():
+    """Jitted continuous-batching admission scatter: place a joiner's
+    prompt K/V (a ``[1, bucket]``-shaped cache pytree from
+    ``prefill_fn(model, bucket)``) into row ``r`` of a RUNNING batch's
+    ``[B, total]`` cache, ending at the batch's current decode
+    position ``pos`` (``r`` and ``pos - bucket`` are traced scalars —
+    one compile covers every admission point). Splitting admission
+    into (bucket-keyed prefill) + (this scatter) keeps the EXPENSIVE
+    compile keyed on the prompt bucket alone; the scatter is pure
+    data movement and compiles per (bucket, cache, batch) shape in
+    negligible time, which is what makes admission viable at every
+    cache tier, not just the warmed default.
 
     Cache-slot layout for the admitted row: real prompt tokens land in
     slots ``[pos - used, pos)`` and everything earlier is masked via
     ``n_pad_row = pos - used``, so the next decode step (which writes
     at ``pos``) sees exactly the joiner's prompt at effective
     positions ``0..used-1`` — byte-identical semantics to a row that
-    was in the batch from its own prefill. Returns
-    ``(cache, first_tok [1])``; the first token samples at the
-    joiner's OWN stream index 0.
+    was in the batch from its own prefill.
     """
 
-    def _run(params, cache, prompt_ids, n_pad1, key_data, temps,
-             top_k, top_p, r, pos):
-        mini, logits = _prefill_core(model, params, prompt_ids, n_pad1,
-                                     bucket)
-        first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
-        off = pos - bucket
-
+    def _run(cache, mini, r, off):
         def scatter(big, small):
             start = (r,) + (off,) + (0,) * (big.ndim - 2)
             return jax.lax.dynamic_update_slice(
                 big, small.astype(big.dtype), start
             )
 
-        return jax.tree.map(scatter, cache, mini), first
+        return jax.tree.map(scatter, cache, mini)
 
-    return jax.jit(_run, donate_argnums=(1,))
+    return jax.jit(_run, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=64)

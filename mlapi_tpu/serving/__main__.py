@@ -149,6 +149,12 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
     ]
     if args.max_batch is not None:
         cmd += ["--max-batch", str(args.max_batch)]
+    # systemd/docker stop the supervisor with SIGTERM; without a
+    # handler the finally below never runs and the workers are
+    # orphaned still bound to the port (SO_REUSEPORT would then let a
+    # restarted service share it with the stale set, silently).
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
     children = [subprocess.Popen(cmd, env=env) for _ in range(n)]
     spawned_at = [time.time()] * n
     restart_at = [0.0] * n   # earliest next respawn (backoff)

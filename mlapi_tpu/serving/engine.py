@@ -470,14 +470,23 @@ class TextGenerationEngine:
         import threading
 
         self._admit: list = []
+        # Staged requests the RUNNING batch can never take (token
+        # budget exceeds its remaining cache): handed back here for
+        # the collector's next batch, so they don't camp in _admit
+        # blocking compaction and queue draining.
+        self._deferred: list = []
         self._alock = threading.Lock()
         # Admission is gated to warmed shapes once a full warmup ran,
         # so a joiner can never stall the running batch on an XLA
         # compile; before/without full warmup (tests, CPU), admission
-        # is unrestricted.
+        # is unrestricted. The expensive compile (joiner prefill) is
+        # keyed on the prompt bucket alone; scatter/growth gathers are
+        # trivial and may compile on demand when dispatch RTT is low.
         self._strict_admit = False
-        self._warmed_admit: set = set()
+        self._warmed_joiner: set = set()
+        self._warmed_scatter: set = set()
         self._warmed_growth: set = set()
+        self._admit_eager_override: bool | None = None
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -492,7 +501,20 @@ class TextGenerationEngine:
     def queue_depth(self) -> int:
         base = self._queue.qsize() if self._queue is not None else 0
         with self._alock:
-            return base + len(self._admit)
+            return base + len(self._admit) + len(self._deferred)
+
+    @property
+    def _admit_eager(self) -> bool:
+        """May the admission path compile a TRIVIAL program (KV
+        scatter, growth gather) on demand? Yes on a low-RTT attach
+        (local chip / CPU: sub-second compile, nobody notices); no
+        through a network tunnel, where even a trivial remote compile
+        stalls the running batch for seconds — there, only pre-warmed
+        shapes are admitted."""
+        if self._admit_eager_override is not None:
+            return self._admit_eager_override
+        self._admit_eager_override = _dispatch_rtt_ms() < 15.0
+        return self._admit_eager_override
 
     # Shared surface with the classification engines (healthz, app).
     @property
@@ -573,7 +595,7 @@ class TextGenerationEngine:
         numpy instead of extra device programs.
         """
         from mlapi_tpu.models.gpt import (
-            admit_prefill_fn, decode_chunk_fn, prefill_fn,
+            admit_scatter_fn, decode_chunk_fn, prefill_fn,
         )
 
         try:
@@ -628,7 +650,6 @@ class TextGenerationEngine:
                     r.push(None)
                     done[i] = True
 
-            dc = decode_chunk_fn(self.model, self.chunk)
             pos = bucket
             # rows[i]: request i's current row in the (possibly
             # resized) device batch. Rows are independent (per-row
@@ -646,30 +667,51 @@ class TextGenerationEngine:
                 )
                 keys = keys[sel]
 
+            def never_admissible(r) -> bool:
+                """Token budget exceeds the running cache's remaining
+                room — and ``pos`` only grows, so this can never
+                change for THIS batch. Such requests must leave the
+                admission list (→ ``_deferred``) rather than camp in
+                it suppressing compaction and queue draining."""
+                return pos + (r.n_new - 1) > total
+
             def admissible(r) -> bool:
                 """Can ``r`` join the RUNNING batch right now? Its
                 prompt bucket must fit below the current decode
-                position and its remaining tokens (in whole chunks)
-                inside the remaining cache."""
-                bkt = len(r.row)
-                if bkt > pos:
-                    return False
-                steps = -(-(r.n_new - 1) // self.chunk) * self.chunk
-                return pos + steps <= total
+                position (``pos`` grows, so a False here can flip
+                True later) and its remaining tokens inside the
+                remaining cache (the final chunk may be
+                remainder-sized)."""
+                return len(r.row) <= pos and not never_admissible(r)
+
+            def unstage(cand) -> None:
+                with self._alock:
+                    try:
+                        self._admit.remove(cand)
+                    except ValueError:
+                        pass
 
             while True:
                 pending_n = 0
                 if admit and self._admit:
                     with self._alock:
                         candidates = list(self._admit)
-                    taken: list = []
                     n_live = sum(
                         1 for i, r in enumerate(reqs)
                         if not done[i] and not r.cancelled
                     )
                     for cand in candidates:
                         if cand.cancelled:
-                            taken.append(cand)  # drop silently
+                            unstage(cand)  # drop silently
+                            continue
+                        if never_admissible(cand):
+                            # Hand back to the collector for the NEXT
+                            # batch; leaving it staged would block
+                            # compaction and backpressure for the
+                            # whole run.
+                            unstage(cand)
+                            with self._alock:
+                                self._deferred.append(cand)
                             continue
                         if n_live + 1 > self.max_batch:
                             break
@@ -685,16 +727,37 @@ class TextGenerationEngine:
                         grow = not free and b_cur < b_max
                         bkt = len(cand.row)
                         if self._strict_admit:
-                            b_t = b_cur * 2 if grow else b_cur
-                            if (bkt, total, b_t) not in self._warmed_admit:
+                            # The EXPENSIVE compile (the joiner's
+                            # prefill) is keyed on the prompt bucket
+                            # alone and must be pre-warmed; the
+                            # scatter/growth gathers are trivial
+                            # compiles, allowed on demand when the
+                            # dispatch RTT is low (local attach) and
+                            # required-warm through a tunnel where
+                            # even a trivial remote compile stalls
+                            # the running batch.
+                            if bkt not in self._warmed_joiner:
                                 continue
-                            if grow and (
-                                (b_cur, b_cur * 2, total)
-                                not in self._warmed_growth
-                            ):
-                                continue
+                            if not self._admit_eager:
+                                b_t = b_cur * 2 if grow else b_cur
+                                if (
+                                    (bkt, total, b_t)
+                                    not in self._warmed_scatter
+                                ):
+                                    continue
+                                if grow and (
+                                    (b_cur, b_cur * 2, total)
+                                    not in self._warmed_growth
+                                ):
+                                    continue
                         if not free and not grow:
                             break
+                        # Committed: leave the staging list BEFORE the
+                        # device work, so a mid-admission failure
+                        # (outer except delivers the error to every
+                        # member of ``reqs``) cannot also re-serve an
+                        # already-admitted joiner from ``_admit``.
+                        unstage(cand)
                         if grow:
                             # Batch growth: double along the warmed
                             # power-of-two chain; new rows are dummies
@@ -710,22 +773,25 @@ class TextGenerationEngine:
                             free = list(range(b_cur // 2, b_cur))
                             self.growths += 1
                         row = free[0]
-                        af = admit_prefill_fn(self.model, bkt, total)
-                        cache, first1 = af(
-                            self.params, cache, jnp.asarray(cand.row[None]),
-                            jnp.asarray(
-                                np.asarray([bkt - cand.used], np.int32)
-                            ),
+                        first1, mini = prefill_fn(self.model, bkt)(
+                            self.params, jnp.asarray(cand.row[None]),
                             jnp.asarray(self._key_data(cand.seed)[None]),
                             jnp.asarray(
                                 np.asarray([cand.temperature], np.float32)
+                            ),
+                            jnp.asarray(
+                                np.asarray([bkt - cand.used], np.int32)
                             ),
                             jnp.asarray(np.asarray([cand.top_k], np.int32)),
                             jnp.asarray(
                                 np.asarray([cand.top_p], np.float32)
                             ),
-                            jnp.int32(row), jnp.int32(pos),
                         )
+                        cache = admit_scatter_fn()(
+                            cache, mini, jnp.int32(row),
+                            jnp.int32(pos - bkt),
+                        )
+                        self._warmed_scatter.add((bkt, total, b_cur))
                         ftok = int(np.asarray(first1)[0])
                         n_pad[row] = pos - cand.used
                         temps[row] = cand.temperature
@@ -744,15 +810,7 @@ class TextGenerationEngine:
                         done.append(fin)
                         if not fin:
                             n_live += 1
-                        taken.append(cand)
                         self.admitted += 1
-                    if taken:
-                        with self._alock:
-                            for t in taken:
-                                try:
-                                    self._admit.remove(t)
-                                except ValueError:
-                                    pass
                     with self._alock:
                         pending_n = len(self._admit)
                 live = [
@@ -765,7 +823,14 @@ class TextGenerationEngine:
                     if not all(done):
                         self.cancelled_batches += 1
                     break
-                if pos + self.chunk > total:
+                # The final chunk may be remainder-sized: when
+                # max_positions clamps the cache tier, (total -
+                # bucket) need not be a chunk multiple, and a
+                # window-edge request is owed the partial chunk (the
+                # old whole-chunk stop silently ran past the cache
+                # end and corrupted the tail positions).
+                size = min(self.chunk, total - pos)
+                if size <= 0:
                     break  # cache exhausted — safety net below
                 want_b = 1
                 while want_b < len(live):
@@ -788,7 +853,7 @@ class TextGenerationEngine:
                     b_cur = want_b
                     self.compactions += 1
                 self.chunk_calls += 1
-                toks, cache, _ = dc(
+                toks, cache, _ = decode_chunk_fn(self.model, size)(
                     self.params, cache, jnp.asarray(tok), jnp.int32(pos),
                     jnp.asarray(n_pad), jnp.asarray(temps),
                     jnp.asarray(keys), jnp.asarray(step),
@@ -883,7 +948,8 @@ class TextGenerationEngine:
                 # them blindly would truncate the long ones and pad
                 # the device batch past the warmed grid).
                 with self._alock:
-                    carry = self._admit + carry
+                    carry = self._deferred + self._admit + carry
+                    self._deferred.clear()
                     self._admit.clear()
                 if carry:
                     reqs = [carry[0]]
@@ -942,7 +1008,7 @@ class TextGenerationEngine:
                     # `max_queue` would stop meaning anything. Stalled
                     # arrivals then fill the queue and shed as 503s.
                     with self._alock:
-                        backlog = len(self._admit)
+                        backlog = len(self._admit) + len(self._deferred)
                     if backlog >= self.max_batch:
                         await asyncio.wait({fut}, timeout=0.05)
                         continue
@@ -995,8 +1061,9 @@ class TextGenerationEngine:
                 while not self._queue.empty():
                     queued.append(self._queue.get_nowait())
             with self._alock:
-                queued += self._admit
+                queued += self._admit + self._deferred
                 self._admit.clear()
+                self._deferred.clear()
             for r in (*reqs, *carry, *queued):
                 try:
                     r.push(err)
@@ -1147,17 +1214,34 @@ class TextGenerationEngine:
         )
 
     def _warm_admission(self, batches: list) -> int:
-        """Compile the continuous-batching admission grid off the
-        request path: for every default-tier cache shape, every
-        power-of-two batch, and every joiner prompt bucket, the
-        ``admit_prefill_fn`` program plus the batch-growth gather.
-        Populates the warmed-shape sets that gate strict admission."""
-        from mlapi_tpu.models.gpt import admit_prefill_fn
+        """Compile the continuous-batching admission programs off the
+        request path. The expensive program — the joiner's [1, bucket]
+        prefill — is keyed on the prompt bucket ALONE (one compile per
+        bucket, reusing ``prefill_fn(model, bucket)``); the trivial
+        KV-scatter and growth-gather programs are warmed across the
+        default-tier (cache × batch) grid. Populates the warmed-shape
+        sets that gate strict admission; other cache tiers' scatters
+        compile on demand when ``_admit_eager`` allows (low-RTT
+        attach) and defer otherwise."""
+        from mlapi_tpu.models.gpt import admit_scatter_fn, prefill_fn
 
         tier = self.chunk
         while tier < self.default_max_new_tokens:
             tier *= 2
         shapes = 0
+        minis = {}
+        for bj in self.prompt_buckets:
+            prompt = np.full((1, bj), self.tokenizer.pad_id, np.int32)
+            _, minis[bj] = prefill_fn(self.model, bj)(
+                self.params, jnp.asarray(prompt),
+                jnp.asarray(self._key_data(0)[None]),
+                jnp.asarray(np.zeros((1,), np.float32)),
+                jnp.asarray(np.asarray([max(bj - 1, 0)], np.int32)),
+                jnp.asarray(np.zeros((1,), np.int32)),
+                jnp.asarray(np.ones((1,), np.float32)),
+            )
+            self._warmed_joiner.add(bj)
+            shapes += 1
         for run_bucket in self.prompt_buckets:
             total = min(self.model.max_positions, run_bucket + tier)
             if total - run_bucket < 1:
@@ -1177,22 +1261,11 @@ class TextGenerationEngine:
                     # [run_bucket, total).
                     if bj >= total:
                         continue
-                    af = admit_prefill_fn(self.model, bj, total)
-                    prompt = np.full(
-                        (1, bj), self.tokenizer.pad_id, np.int32
+                    admit_scatter_fn()(
+                        self.model.init_cache(bsz, total), minis[bj],
+                        jnp.int32(0), jnp.int32(0),
                     )
-                    af(
-                        self.params,
-                        self.model.init_cache(bsz, total),
-                        jnp.asarray(prompt),
-                        jnp.asarray(np.asarray([max(bj - 1, 0)], np.int32)),
-                        jnp.asarray(self._key_data(0)[None]),
-                        jnp.asarray(np.zeros((1,), np.float32)),
-                        jnp.asarray(np.zeros((1,), np.int32)),
-                        jnp.asarray(np.ones((1,), np.float32)),
-                        jnp.int32(0), jnp.int32(bj),
-                    )
-                    self._warmed_admit.add((bj, total, bsz))
+                    self._warmed_scatter.add((bj, total, bsz))
                     shapes += 1
         return shapes
 

@@ -227,10 +227,10 @@ async def test_warmup_populates_admission_grid(monkeypatch):
     eng = _engine(max_batch=2, prompt_buckets=(16,))
     eng.warmup()
     assert eng._strict_admit
-    assert eng._warmed_admit, "no admission shapes warmed"
+    assert eng._warmed_joiner == {16}, "joiner prefill bucket not warmed"
     assert eng._warmed_growth, "no growth shapes warmed"
     total = 16 + 32  # bucket + default tier (default_max_new_tokens=32)
-    assert (16, total, 1) in eng._warmed_admit
+    assert (16, total, 1) in eng._warmed_scatter
     assert (1, 2, total) in eng._warmed_growth
     await eng.start()
     try:
@@ -246,6 +246,52 @@ async def test_warmup_populates_admission_grid(monkeypatch):
         )
     finally:
         await eng.stop()
+
+
+async def test_admission_at_nondefault_tier_with_eager_compiles(monkeypatch):
+    """With strict gating on, a batch running at a HIGHER cache tier
+    than the warmed default still admits joiners when the attach is
+    low-RTT: the expensive prefill is warmed per bucket, and the
+    trivial scatter is allowed to compile on demand."""
+    monkeypatch.setenv("MLAPI_TPU_WARMUP", "full")
+    eng = _engine(max_batch=2, prompt_buckets=(16,))
+    eng.warmup()
+    assert eng._strict_admit
+    assert eng._admit_eager  # CPU attach: sub-ms dispatch RTT
+    await eng.start()
+    try:
+        # n_new=48 > default 32 → cache tier 64, total 80: a shape no
+        # scatter was warmed for.
+        a = await eng.submit("abcd", max_new_tokens=48, seed=2)
+        await a.queue.get()
+        assert (16, 80, 1) not in eng._warmed_scatter
+        b = await eng.submit("xy", max_new_tokens=4, seed=5)
+        got_b = await _collect(b)
+        await _collect(a)
+        solo = eng.generate_text("xy", max_new_tokens=4, seed=5)
+        assert got_b == solo["token_ids"]
+        assert eng.admitted >= 1, "long-tier batch refused a joiner"
+    finally:
+        await eng.stop()
+
+
+def test_window_edge_request_gets_partial_final_chunk():
+    """When max_positions clamps the cache, (total - bucket) need not
+    be a chunk multiple; the final decode chunk must run at the
+    remainder size so a window-edge request still receives every
+    token it was promised (code-review regression: the whole-chunk
+    stop errored it as truncated — and the pre-r03 loop silently ran
+    past the cache end)."""
+    model = get_model("gpt_lm", **CFG)
+    eng = TextGenerationEngine(
+        model, model.init(jax.random.key(0)),
+        tokenizer=ByteTokenizer(), chunk=16,
+    )
+    # 70-char prompt → oversize exact bucket 70; n_new=24 fits the
+    # model window (94 <= 96) but total clamps to 96: room is 26 =
+    # one 16-chunk + a 10-remainder.
+    out = eng.generate_text("x" * 70, max_new_tokens=24)
+    assert len(out["token_ids"]) == 24
 
 
 async def test_staggered_soak_every_stream_exact():

@@ -136,6 +136,16 @@ def test_two_workers_share_one_port(iris_checkpoint):
         except subprocess.TimeoutExpired:
             sup.kill()
             sup.wait(10)
+    # SIGTERM to the supervisor must also stop the WORKERS (its
+    # handler runs the shutdown fan-out) — no orphans still bound to
+    # the port.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        alive = [p for p in pids if os.path.isdir(f"/proc/{p}")]
+        if not alive:
+            break
+        time.sleep(0.5)
+    assert not alive, f"workers {alive} orphaned after supervisor SIGTERM"
 
 
 def test_worker_flag_requires_explicit_port(iris_checkpoint):
