@@ -1,0 +1,75 @@
+"""Transparent weight-only-quantized model wrapper.
+
+``QuantizedModel(inner)`` exposes the decoder-protocol / classifier
+surface of ``inner`` but expects its ``params`` tree to hold int8
+``{"q", "scale"}`` leaves (see ``ops/quant.py``). Dequantization
+happens INSIDE each traced method, so every jitted program — serving
+forward, prefill, decode chunk, admission prefill — reads int8 weights
+from HBM and expands them in-register on the way into the matmul. No
+model family needs to know: the wrapper satisfies the same protocol
+the engines and ``models/gpt.py``'s model-generic machinery consume,
+and it is hashable/frozen so the ``lru_cache``'d program factories key
+on it like any other model config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from mlapi_tpu.ops.quant import dequantize_tree
+
+
+@dataclass(frozen=True)
+class QuantizedModel:
+    """Weight-only int8 view over any model family."""
+
+    inner: object
+
+    # Anything not overridden (vocab_size, max_positions, num_heads,
+    # input_kind, init_cache, ...) is the inner model's.
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _deq(self, params):
+        # Dequantize to f32: every model site applies its own compute
+        # cast (`.astype(cdt)` at use), exactly as with float params.
+        return dequantize_tree(params, jnp.float32)
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def apply(self, params, *args, **kwargs):
+        return self.inner.apply(self._deq(params), *args, **kwargs)
+
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+        return self.inner.prefill_core(
+            self._deq(params), prompt_ids, n_pad, total_len
+        )
+
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
+        return self.inner.decode_step(
+            self._deq(params), cache, token_ids, pos, n_pad
+        )
+
+    def generate(self, params, prompt_ids, **kwargs):
+        # Route through the model-generic path with SELF as the model
+        # so prefill/decode dequantize inside the traced program —
+        # delegating to inner.generate would re-enter with the inner
+        # model and skip dequantization.
+        if not hasattr(self.inner, "generate"):
+            raise AttributeError(
+                f"{type(self.inner).__name__} is not a generative model"
+            )
+        from mlapi_tpu.models.gpt import run_generate
+
+        return run_generate(self, params, prompt_ids, **kwargs)
+
+    def param_shardings(self, layout=None):
+        raise NotImplementedError(
+            "quantized serving on a mesh is not supported yet: the "
+            "quantized tree's {'q','scale'} leaves do not match the "
+            "float param specs; serve quantized models single-chip or "
+            "load float params for mesh serving"
+        )

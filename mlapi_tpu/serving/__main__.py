@@ -149,6 +149,8 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
     ]
     if args.max_batch is not None:
         cmd += ["--max-batch", str(args.max_batch)]
+    if getattr(args, "quantize", None):
+        cmd += ["--quantize", args.quantize]
     # systemd/docker stop the supervisor with SIGTERM; without a
     # handler the finally below never runs and the workers are
     # orphaned still bound to the port (SO_REUSEPORT would then let a
@@ -236,6 +238,12 @@ def main(argv=None) -> None:
              "scale-out; needs an explicit --port)",
     )
     parser.add_argument(
+        "--quantize", choices=["int8"], default=None,
+        help="weight-only quantization at load: half the parameter "
+             "HBM, dequantization fused into each matmul "
+             "(single-chip serving only)",
+    )
+    parser.add_argument(
         "--profiler-port", type=int, default=0,
         help="start a jax.profiler server on this port (XProf/TensorBoard "
              "can attach live)",
@@ -275,7 +283,7 @@ def main(argv=None) -> None:
                          "(every worker binds the same one)")
         sys.exit(_supervise_workers(args.workers, ckpt, args))
 
-    engine = InferenceEngine.from_checkpoint(ckpt)
+    engine = InferenceEngine.from_checkpoint(ckpt, quantize=args.quantize)
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
     server = Server(app, host=args.host, port=args.port,
                     reuse_port=is_worker)

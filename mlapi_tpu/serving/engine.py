@@ -108,6 +108,7 @@ class InferenceEngine:
         *,
         mesh: jax.sharding.Mesh | None = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
+        quantize: str | None = None,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -115,6 +116,12 @@ class InferenceEngine:
         (``model`` registry name + kwargs) unless one is passed in,
         and the engine class follows the model's ``input_kind``
         (tabular feature rows vs text token ids).
+
+        ``quantize="int8"`` converts the loaded float weights to
+        weight-only per-channel int8 at load time and serves through
+        the transparent :class:`~mlapi_tpu.models.quantized.QuantizedModel`
+        wrapper — half the parameter HBM, dequantization fused into
+        each matmul inside the jitted programs. Single-chip only.
         """
         from mlapi_tpu.checkpoint import load_checkpoint
         from mlapi_tpu.models import get_model
@@ -136,7 +143,31 @@ class InferenceEngine:
         )
         params, meta = load_checkpoint(path, abstract)
 
-        if hasattr(model, "generate"):
+        # Engine dispatch keys off the INNER model: the quantized
+        # wrapper defines the full decoder protocol, so probing the
+        # wrapper would route every quantized checkpoint — tabular
+        # classifiers included — to the generative engine.
+        inner = model
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(f"unsupported quantize={quantize!r}")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "quantized serving on a mesh is not supported; "
+                    "drop --quantize or serve single-chip"
+                )
+            from mlapi_tpu.models.quantized import QuantizedModel
+            from mlapi_tpu.ops.quant import quantize_tree, quantized_bytes
+
+            params = quantize_tree(params)
+            stored, full = quantized_bytes(params)
+            _log.info(
+                "weight-only int8: params %.1f MB (f32 would be %.1f MB)",
+                stored / 1e6, full / 1e6,
+            )
+            model = QuantizedModel(model)
+
+        if hasattr(inner, "generate"):
             # Generative LM: no label vocab — the output space is the
             # tokenizer's.
             from mlapi_tpu.text import load_tokenizer
@@ -152,14 +183,15 @@ class InferenceEngine:
                 params,
                 tokenizer=tokenizer,
                 mesh=mesh,
-                meta={"step": meta.step, "config_hash": meta.config_hash},
+                meta={"step": meta.step, "config_hash": meta.config_hash,
+                      **({"quantized": quantize} if quantize else {})},
             )
 
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
         feature_names = meta.config.get("feature_names", feature_names)
 
-        if getattr(model, "input_kind", "tabular") == "text":
+        if getattr(inner, "input_kind", "tabular") == "text":
             from mlapi_tpu.text import load_tokenizer
             from mlapi_tpu.text.tokenizer import tokenizer_from_fingerprint
 
@@ -179,7 +211,8 @@ class InferenceEngine:
                 max_len=meta.config.get("max_len", default_len),
                 mesh=mesh,
                 buckets=buckets,
-                meta={"step": meta.step, "config_hash": meta.config_hash},
+                meta={"step": meta.step, "config_hash": meta.config_hash,
+                      **({"quantized": quantize} if quantize else {})},
             )
         return InferenceEngine(
             model,
@@ -188,7 +221,8 @@ class InferenceEngine:
             feature_names,
             mesh=mesh,
             buckets=buckets,
-            meta={"step": meta.step, "config_hash": meta.config_hash},
+            meta={"step": meta.step, "config_hash": meta.config_hash,
+                      **({"quantized": quantize} if quantize else {})},
         )
 
     # -- shape management -------------------------------------------------
@@ -579,14 +613,16 @@ class TextGenerationEngine:
         With ``admit=True`` (the collector's batches) this is a
         CONTINUOUS batch: at every chunk boundary, waiting requests
         whose prompt bucket and token budget fit the running cache are
-        prefilled into a free device row (``admit_prefill_fn``) and
-        decode alongside the original members — a long generation no
-        longer head-of-line-blocks short arrivals. Admission is
-        tier-aligned so it never compiles on the request path: joiners
-        are only taken when their (bucket, cache, batch) admission
-        program was warmed (strict mode), the batch grows along the
-        warmed power-of-two chain only, and per-row sampling-stream
-        indices keep every row's output byte-identical to a solo run.
+        prefilled into a free device row (bucket-keyed ``prefill_fn``
+        + ``admit_scatter_fn``) and decode alongside the original
+        members — a long generation no longer head-of-line-blocks
+        short arrivals. Admission never stalls the batch on an
+        EXPENSIVE compile: in strict mode the joiner's prefill bucket
+        must be pre-warmed, and the trivial scatter/growth programs
+        either compile on demand (low-RTT attach) or must be warmed
+        too (tunnel). The batch grows along the warmed power-of-two
+        chain only, and per-row sampling-stream indices keep every
+        row's output byte-identical to a solo run.
 
         Device-resident state is the KV cache and nothing else: all
         per-row vectors (pads, temps, keys, stream steps, last token)
@@ -735,21 +771,31 @@ class TextGenerationEngine:
                             # dispatch RTT is low (local attach) and
                             # required-warm through a tunnel where
                             # even a trivial remote compile stalls
-                            # the running batch.
-                            if bkt not in self._warmed_joiner:
-                                continue
-                            if not self._admit_eager:
-                                b_t = b_cur * 2 if grow else b_cur
-                                if (
+                            # the running batch. A shape miss cannot
+                            # resolve during this batch (warmed sets
+                            # only grow via admissions this mode
+                            # forbids), so the joiner is handed back
+                            # for the next batch rather than left
+                            # camping in the staging list where it
+                            # would block compaction and draining.
+                            b_t = b_cur * 2 if grow else b_cur
+                            blocked = bkt not in self._warmed_joiner or (
+                                not self._admit_eager
+                                and (
                                     (bkt, total, b_t)
                                     not in self._warmed_scatter
-                                ):
-                                    continue
-                                if grow and (
-                                    (b_cur, b_cur * 2, total)
-                                    not in self._warmed_growth
-                                ):
-                                    continue
+                                    or (
+                                        grow
+                                        and (b_cur, b_cur * 2, total)
+                                        not in self._warmed_growth
+                                    )
+                                )
+                            )
+                            if blocked:
+                                unstage(cand)
+                                with self._alock:
+                                    self._deferred.append(cand)
+                                continue
                         if not free and not grow:
                             break
                         # Committed: leave the staging list BEFORE the

@@ -9,7 +9,7 @@ temperature/seed, produces byte-identical tokens to the same request
 run solo through ``generate_text``. That is what per-row pad masks,
 per-row position shifts, per-row PRNG streams, and per-row
 sampling-step indices buy (``models/gpt.py::_pick_token``,
-``admit_prefill_fn``).
+``admit_scatter_fn``).
 """
 
 import asyncio
