@@ -231,7 +231,8 @@ class GptLM:
         )
         return cache, last_logits
 
-    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None,
+                    prefix_len=None, prefix_lo=None):
         """One decode step: ``[B, 1]`` ids at position ``pos`` (traced
         scalar) → (``[B, V]`` logits, updated cache). The KV for the
         new token is written into the fixed-shape cache; attention
@@ -243,6 +244,9 @@ class GptLM:
         position embedding is shifted so row ``b``'s real tokens sit
         at effective positions ``0..pos-n_pad[b]`` — a prompt's output
         is identical whichever pad bucket it landed in.
+        ``prefix_len``/``prefix_lo`` describe a shared prefix-cache
+        region ahead of the per-row pads (see
+        :func:`decode_valid_and_shift`).
         """
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
@@ -251,12 +255,12 @@ class GptLM:
         if n_pad is None:
             n_pad = jnp.zeros((b,), jnp.int32)
 
-        idx = jnp.arange(max_len)
-        x = params["wte"][token_ids] + params["wpe"][pos - n_pad][:, None, :]
+        valid, shift = decode_valid_and_shift(
+            max_len, pos, n_pad, prefix_len, prefix_lo
+        )
+        posq = jnp.maximum(pos - shift, 0)
+        x = params["wte"][token_ids] + params["wpe"][posq][:, None, :]
         new_cache = {}
-        valid = ((idx[None, :] <= pos) & (idx[None, :] >= n_pad[:, None]))[
-            :, None, None, :
-        ]  # [B,1,1,L]
 
         for n in range(self.num_layers):
             layer = params[f"layer_{n}"]
@@ -463,6 +467,40 @@ def run_generate(
     )
 
 
+def decode_valid_and_shift(max_len, pos, n_pad, prefix_len=None,
+                           prefix_lo=None):
+    """Shared decode-time key mask + per-row position shift, for both
+    the plain left-padded layout and the prefix-cache layout.
+
+    Cache-slot layout (per row ``b``):
+    ``[prefix_lo .. prefix_len)`` real PREFIX tokens (shared across
+    the batch, scattered from the prefix KV cache; empty when
+    ``prefix_len == 0``), ``[prefix_len .. prefix_len + n_pad[b])``
+    this row's suffix pad slots (masked), then real suffix/generated
+    tokens. Valid keys: ``idx <= pos`` (written so far), ``idx >=
+    prefix_lo`` (prefix's own left-pad), and NOT inside the per-row
+    pad hole. With ``prefix_len == prefix_lo == 0`` this reduces
+    exactly to the original ``(idx <= pos) & (idx >= n_pad[b])``.
+
+    The position shift maps slot ``s`` to effective position
+    ``s - prefix_lo - n_pad[b]`` (prefix real count + suffix index),
+    which likewise reduces to ``s - n_pad[b]``.
+    Returns ``(valid [B,1,1,L], shift [B])``.
+    """
+    if prefix_len is None:
+        prefix_len = jnp.int32(0)
+    if prefix_lo is None:
+        prefix_lo = jnp.int32(0)
+    idx = jnp.arange(max_len)[None, :]
+    valid = (
+        (idx <= pos)
+        & (idx >= prefix_lo)
+        & ((idx < prefix_len) | (idx >= prefix_len + n_pad[:, None]))
+    )[:, None, None, :]
+    shift = prefix_lo + n_pad
+    return valid, shift
+
+
 def cached_attend(
     cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None
 ):
@@ -519,6 +557,7 @@ def _prefill_core(model, params, prompt_ids, n_pad, total_len: int):
 def _decode_scan(
     model, params, cache, tok, pos, n_pad, temps, key_data,
     n_steps: int, step0, top_k=None, top_p=None,
+    prefix_len=None, prefix_lo=None,
 ):
     """``n_steps`` cached decode steps under one ``lax.scan``.
 
@@ -536,7 +575,8 @@ def _decode_scan(
     def step(carry, i):
         cache, tok, pos = carry
         logits, cache = model.decode_step(
-            params, cache, tok[:, None], pos, n_pad
+            params, cache, tok[:, None], pos, n_pad,
+            prefix_len, prefix_lo,
         )
         nxt = _pick_token(temps, logits, key_data, i + step0, top_k, top_p)
         return (cache, nxt, pos + 1), nxt
@@ -631,10 +671,66 @@ def decode_chunk_fn(model, chunk: int):
     must use the returned cache handle."""
 
     def _run(params, cache, tok, pos, n_pad, temps, key_data, step0,
-             top_k, top_p):
+             top_k, top_p, prefix_len, prefix_lo):
         return _decode_scan(
             model, params, cache, tok, pos, n_pad, temps, key_data,
-            chunk, step0, top_k, top_p,
+            chunk, step0, top_k, top_p, prefix_len, prefix_lo,
         )
 
     return jax.jit(_run, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=64)
+def prefix_prefill_fn(model, suffix_len: int, total: int):
+    """Jitted prefix-cache prefill + first-token program: scatter a
+    shared prompt prefix's precomputed KV (``prefix_kv``, a
+    ``[1, P]``-shaped cache pytree from ``prefill_fn(model, P)``)
+    into slots ``[0, P)`` of EVERY row of a fresh ``[B, total]``
+    cache, then run a teacher-forced scan over the left-padded
+    ``[B, suffix_len]`` suffix block at slots ``[P, P+suffix_len)``.
+    The prefix forward is never recomputed — that is the entire
+    point: time-to-first-token for a request with an S-token shared
+    prefix drops from O(P + U) to O(U) forward work.
+
+    Per-row suffix pads (``hole [B]``) are masked via the pad hole in
+    :func:`decode_valid_and_shift`; ``lo`` is the prefix's OWN
+    left-pad inside its bucket. Sampling draws at each row's stream
+    index 0, so the emitted stream is byte-identical to the same
+    prompt served without prefix caching. Returns
+    ``(first_tok [B], cache)``.
+    """
+
+    def _run(params, prefix_kv, suffix_ids, hole, lo, key_data, temps,
+             top_k, top_p):
+        b = suffix_ids.shape[0]
+        p_len = jax.tree.leaves(prefix_kv)[0].shape[1]
+        cache = model.init_cache(b, total)
+        cache = jax.tree.map(
+            lambda big, small: jax.lax.dynamic_update_slice(
+                big,
+                jnp.broadcast_to(
+                    small, (b,) + small.shape[1:]
+                ).astype(big.dtype),
+                (0, 0, 0, 0),
+            ),
+            cache, prefix_kv,
+        )
+
+        def step(carry, u):
+            cache, _ = carry
+            logits, cache = model.decode_step(
+                params, cache, jax.lax.dynamic_slice_in_dim(
+                    suffix_ids, u, 1, axis=1
+                ),
+                p_len + u, hole, jnp.int32(p_len), lo,
+            )
+            return (cache, logits), None
+
+        zero = jnp.zeros((b, model.vocab_size), jnp.float32)
+        (cache, logits), _ = jax.lax.scan(
+            step, (cache, zero), jnp.arange(suffix_len)
+        )
+        first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
+        return first, cache
+
+    return jax.jit(_run)

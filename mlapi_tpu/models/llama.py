@@ -272,14 +272,17 @@ class LlamaLM:
         )
         return cache, last_logits
 
-    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None,
+                    prefix_len=None, prefix_lo=None):
         """One cached decode step — same contract as
         ``GptLM.decode_step`` (``[B, 1]`` ids at traced cache position
         ``pos``; per-row ``n_pad`` shifts rotary positions and masks
-        pad keys). The cache write + masked attention is the shared
-        ``gpt.cached_attend``, with GQA's kv-head broadcast plugged in.
+        pad keys; ``prefix_len``/``prefix_lo`` describe a shared
+        prefix-cache region). The cache write + masked attention is
+        the shared ``gpt.cached_attend``, with GQA's kv-head broadcast
+        plugged in.
         """
-        from mlapi_tpu.models.gpt import cached_attend
+        from mlapi_tpu.models.gpt import cached_attend, decode_valid_and_shift
 
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
@@ -287,12 +290,11 @@ class LlamaLM:
         if n_pad is None:
             n_pad = jnp.zeros((b,), jnp.int32)
 
-        idx = jnp.arange(max_len)
-        positions = jnp.maximum(pos - n_pad, 0)[:, None]  # [B, 1]
+        valid, shift = decode_valid_and_shift(
+            max_len, pos, n_pad, prefix_len, prefix_lo
+        )
+        positions = jnp.maximum(pos - shift, 0)[:, None]  # [B, 1]
         x = params["wte"][token_ids]
-        valid = ((idx[None, :] <= pos) & (idx[None, :] >= n_pad[:, None]))[
-            :, None, None, :
-        ]  # [B, 1, 1, L]
         new_cache = {}
 
         for n in range(self.num_layers):

@@ -48,9 +48,11 @@ class QuantizedModel:
             self._deq(params), prompt_ids, n_pad, total_len
         )
 
-    def decode_step(self, params, cache, token_ids, pos, n_pad=None):
+    def decode_step(self, params, cache, token_ids, pos, n_pad=None,
+                    prefix_len=None, prefix_lo=None):
         return self.inner.decode_step(
-            self._deq(params), cache, token_ids, pos, n_pad
+            self._deq(params), cache, token_ids, pos, n_pad,
+            prefix_len, prefix_lo,
         )
 
     def generate(self, params, prompt_ids, **kwargs):

@@ -191,6 +191,10 @@ def _install_generate(app: App, engine) -> None:
         seed=(int, 0),
         stream=(bool, False),
         stop=(str | list[str] | None, None),
+        # Shared-prefix KV caching: the effective prompt is
+        # prefix + text, but the prefix's forward pass is computed
+        # once and its KV reused by every request that names it.
+        prefix=(str | None, None),
     )
     hard_cap = engine.model.max_positions - 1
 
@@ -285,9 +289,24 @@ def _install_generate(app: App, engine) -> None:
                 seed=req.seed,
                 top_k=req.top_k,
                 top_p=req.top_p,
+                prefix=req.prefix,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
+        except ValueError as e:
+            # An invalid prefix (too long for the model window, empty
+            # after tokenization) is the requester's error, not a 500.
+            raise HTTPError(
+                422,
+                [
+                    {
+                        "type": "value_error",
+                        "loc": ["prefix"],
+                        "msg": str(e),
+                        "input": req.prefix,
+                    }
+                ],
+            ) from None
 
         if req.stream:
             async def ndjson():
@@ -309,7 +328,7 @@ def _install_generate(app: App, engine) -> None:
                                     "done": True,
                                     "text": engine.tokenizer.decode(ids),
                                     "token_ids": ids,
-                                    "prompt_tokens": gen.used,
+                                    "prompt_tokens": gen.prompt_tokens,
                                 }
                             ).encode() + b"\n"
                             return
@@ -337,7 +356,7 @@ def _install_generate(app: App, engine) -> None:
                                         "done": True,
                                         "text": text[:cut],
                                         "token_ids": ids,
-                                        "prompt_tokens": gen.used,
+                                        "prompt_tokens": gen.prompt_tokens,
                                         "stopped": s,
                                     }
                                 ).encode() + b"\n"
@@ -382,7 +401,7 @@ def _install_generate(app: App, engine) -> None:
         out = {
             "text": text if stopped is None else text[: stopped[0]],
             "token_ids": ids,
-            "prompt_tokens": gen.used,
+            "prompt_tokens": gen.prompt_tokens,
         }
         if stopped is not None:
             out["stopped"] = stopped[1]
@@ -561,6 +580,13 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.compactions"] = engine.compactions
             snap["counters"]["generate.admitted"] = engine.admitted
             snap["counters"]["generate.growths"] = engine.growths
+            snap["counters"]["generate.prefix_hits"] = engine.prefix_hits
+            snap["counters"]["generate.prefix_misses"] = (
+                engine.prefix_misses
+            )
+            snap["counters"]["generate.prefix_fallbacks"] = (
+                engine.prefix_fallbacks
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

@@ -333,10 +333,12 @@ class GenRequest:
     __slots__ = (
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
         "cancelled", "top_k", "top_p",
+        "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
+        "prompt_tokens",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
-                 top_k=0, top_p=1.0):
+                 top_k=0, top_p=1.0, prefix=None):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -345,6 +347,23 @@ class GenRequest:
         self.loop = loop
         self.top_k = top_k        # 0 disables
         self.top_p = top_p        # 1.0 disables
+        # Shared-prefix KV entry (engine._prefix_entry); only
+        # same-prefix requests batch together.
+        if prefix is not None:
+            self.prefix_fp = prefix.fp
+            self.prefix_kv = prefix.kv
+            self.prefix_len = prefix.bucket
+            self.prefix_lo = prefix.lo
+            # Tokens that actually conditioned the output = prefix
+            # real tokens + suffix real tokens (`used` stays the
+            # suffix-row count — it drives the pad mask).
+            self.prompt_tokens = prefix.used + used
+        else:
+            self.prefix_fp = None
+            self.prefix_kv = None
+            self.prefix_len = 0
+            self.prefix_lo = 0
+            self.prompt_tokens = used
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
 
@@ -359,6 +378,21 @@ class GenRequest:
         self.cancelled = True
 
 
+class _PrefixEntry:
+    """One cached shared-prompt prefix: its device-resident KV (a
+    ``[1, bucket]``-shaped cache pytree), the bucket it was padded to,
+    its own left-pad ``lo``, and the real token count."""
+
+    __slots__ = ("fp", "kv", "bucket", "lo", "used")
+
+    def __init__(self, fp, kv, bucket, lo, used):
+        self.fp = fp
+        self.kv = kv
+        self.bucket = bucket
+        self.lo = lo
+        self.used = used
+
+
 class _SyncSink:
     """Adapter so the synchronous ``generate_text`` path reuses
     ``_run_batch`` verbatim: collects token chunks into a list instead
@@ -368,6 +402,8 @@ class _SyncSink:
         self.row, self.used, self.n_new = req.row, req.used, req.n_new
         self.temperature, self.seed = req.temperature, req.seed
         self.top_k, self.top_p = req.top_k, req.top_p
+        self.prefix_fp, self.prefix_kv = req.prefix_fp, req.prefix_kv
+        self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
@@ -521,6 +557,12 @@ class TextGenerationEngine:
         self._warmed_scatter: set = set()
         self._warmed_growth: set = set()
         self._admit_eager_override: bool | None = None
+        # Shared-prefix KV cache: text → _PrefixEntry, LRU-bounded
+        # (each entry holds a [1, prefix_bucket] KV pytree on device).
+        import collections
+
+        self._prefixes: collections.OrderedDict = collections.OrderedDict()
+        self.max_prefixes = 8
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -530,6 +572,13 @@ class TextGenerationEngine:
         self.compactions = 0
         self.admitted = 0
         self.growths = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_fallbacks = 0
+        # Batch-resize (compaction) shapes proven compiled — in
+        # strict non-eager mode a resize outside this set is skipped
+        # (decode stays at full width) rather than compiled mid-batch.
+        self._warmed_shrink: set = set()
 
     @property
     def queue_depth(self) -> int:
@@ -577,15 +626,92 @@ class TextGenerationEngine:
             tier *= 2
         return min(self.model.max_positions, bucket + tier)
 
+    def _prefix_entry(self, text: str) -> "_PrefixEntry":
+        """Return (computing on first use, LRU-cached after) the KV
+        cache of a shared prompt prefix. The forward pass over the
+        prefix runs ONCE; every request naming the same prefix reuses
+        its keys/values straight from device memory — the
+        time-to-first-token win prefix caching exists for. The first
+        request with a new prefix pays the prefill (and possibly an
+        XLA compile for a new prefix bucket) on its own latency, which
+        is the honest place for it."""
+        from mlapi_tpu.models.gpt import prefill_fn
+
+        entry = self._prefixes.get(text)
+        if entry is not None:
+            self._prefixes.move_to_end(text)
+            self.prefix_hits += 1
+            return entry
+        ids = self.tokenizer.token_ids(text)
+        if not ids:
+            raise ValueError("prefix tokenizes to nothing")
+        # The prefix must leave room for at least the smallest suffix
+        # bucket plus one generated token.
+        cap = self.model.max_positions - self.prompt_buckets[0] - 1
+        if len(ids) > cap:
+            raise ValueError(
+                f"prefix is {len(ids)} tokens; at most {cap} fit the "
+                f"model window (max_positions="
+                f"{self.model.max_positions})"
+            )
+        bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
+        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        row[0, -len(ids):] = ids
+        lo = bucket - len(ids)
+        zero1 = np.zeros((1,), np.float32)
+        _, kv = prefill_fn(self.model, bucket)(
+            self.params, jnp.asarray(row),
+            jnp.asarray(self._key_data(0)[None]),
+            jnp.asarray(zero1),
+            jnp.asarray(np.asarray([lo], np.int32)),
+            jnp.asarray(np.zeros((1,), np.int32)),
+            jnp.asarray(np.ones((1,), np.float32)),
+        )
+        entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
+        self._prefixes[text] = entry
+        self.prefix_misses += 1
+        while len(self._prefixes) > self.max_prefixes:
+            self._prefixes.popitem(last=False)  # evict LRU
+        return entry
+
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
-                loop, top_k: int = 0, top_p: float = 1.0) -> GenRequest:
-        limit = self.model.max_positions - n_new
+                loop, top_k: int = 0, top_p: float = 1.0,
+                prefix: str | None = None) -> GenRequest:
+        entry = None
+        if prefix:
+            raw_s = self.tokenizer.token_ids(text)
+            p_ids = self.tokenizer.token_ids(prefix)
+            s_bucket = max(self._bucket(len(raw_s)), len(raw_s))
+            if s_bucket > len(p_ids):
+                # The KV path computes the suffix token-by-token; when
+                # the suffix rivals the prefix, one fused prefill over
+                # the concatenation is cheaper. Output is identical
+                # either way (the equivalence the tests pin), so route
+                # silently and count it.
+                self.prefix_fallbacks += 1
+                text = prefix + text
+            else:
+                entry = self._prefix_entry(prefix)
+        p_len = entry.bucket if entry else 0
+        limit = self.model.max_positions - n_new - p_len
         if limit <= 0:
             raise ValueError(
-                f"max_new_tokens={n_new} leaves no room for a prompt "
-                f"(max_positions={self.model.max_positions})"
+                f"max_new_tokens={n_new}"
+                + (f" plus a {p_len}-slot prefix" if p_len else "")
+                + f" leaves no room for a prompt "
+                  f"(max_positions={self.model.max_positions})"
             )
         raw = self.tokenizer.token_ids(text)
+        if entry is not None and len(raw) > limit:
+            # The plain path documents left-truncation of oversized
+            # prompts; on the KV path that would truncate the SUFFIX
+            # while keeping the whole prefix — silently different
+            # conditioning than the concatenated prompt. Refuse loud.
+            raise ValueError(
+                f"prefix + text + max_new_tokens exceed the model "
+                f"window (suffix is {len(raw)} tokens, {limit} fit "
+                f"behind the {p_len}-slot prefix)"
+            )
         raw = raw[-limit:] if raw else [self.tokenizer.pad_id]
         # Left-pad to a bucket so common prompt lengths never
         # recompile; pads are masked out by the model (n_pad), so the
@@ -597,7 +723,8 @@ class TextGenerationEngine:
         used = min(len(raw), bucket)
         row[-used:] = raw[-used:]
         return GenRequest(
-            row, used, n_new, temperature, seed, loop, top_k, top_p
+            row, used, n_new, temperature, seed, loop, top_k, top_p,
+            prefix=entry,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -632,14 +759,20 @@ class TextGenerationEngine:
         """
         from mlapi_tpu.models.gpt import (
             admit_scatter_fn, decode_chunk_fn, prefill_fn,
+            prefix_prefill_fn,
         )
 
         try:
             self.batch_calls += 1
             bucket = max(len(r.row) for r in reqs)
             n_new_max = max(r.n_new for r in reqs)
-            total = self._cache_len(bucket, n_new_max)
-            n_new_max = min(n_new_max, total - bucket)
+            # All members share one prefix (collector grouping
+            # invariant); p_len slots of every row's cache hold its
+            # scattered KV.
+            p_len = reqs[0].prefix_len
+            p_lo = reqs[0].prefix_lo
+            total = self._cache_len(p_len + bucket, n_new_max)
+            n_new_max = min(n_new_max, total - p_len - bucket)
             b = len(reqs)
             # Pad the BATCH dimension to a power of two: programs are
             # keyed on batch size, so without padding every distinct
@@ -668,11 +801,25 @@ class TextGenerationEngine:
                 + [self._key_data(0)] * (b_pad - b)
             )
 
-            first, cache = prefill_fn(self.model, total)(
-                self.params, jnp.asarray(prompt), jnp.asarray(keys),
-                jnp.asarray(temps), jnp.asarray(n_pad), jnp.asarray(topk),
-                jnp.asarray(topp),
-            )
+            if p_len:
+                # Shared-prefix batch: the prefix KV is scattered into
+                # every row and only the suffix block is computed —
+                # the prefix's forward work is paid once per prefix,
+                # not once per request.
+                first, cache = prefix_prefill_fn(
+                    self.model, bucket, total
+                )(
+                    self.params, reqs[0].prefix_kv, jnp.asarray(prompt),
+                    jnp.asarray(n_pad), jnp.int32(p_lo),
+                    jnp.asarray(keys), jnp.asarray(temps),
+                    jnp.asarray(topk), jnp.asarray(topp),
+                )
+            else:
+                first, cache = prefill_fn(self.model, total)(
+                    self.params, jnp.asarray(prompt), jnp.asarray(keys),
+                    jnp.asarray(temps), jnp.asarray(n_pad),
+                    jnp.asarray(topk), jnp.asarray(topp),
+                )
             tok = np.asarray(first)
             # step[row]: the row's NEXT sampling-stream index — its own
             # produced-token count, NOT a batch-global counter, so a
@@ -686,7 +833,7 @@ class TextGenerationEngine:
                     r.push(None)
                     done[i] = True
 
-            pos = bucket
+            pos = p_len + bucket
             # rows[i]: request i's current row in the (possibly
             # resized) device batch. Rows are independent (per-row
             # mask/positions/PRNG streams), so gathering live rows
@@ -739,6 +886,16 @@ class TextGenerationEngine:
                     for cand in candidates:
                         if cand.cancelled:
                             unstage(cand)  # drop silently
+                            continue
+                        if p_len or cand.prefix_fp is not None:
+                            # Prefix layouts are one shared scalar
+                            # region per batch: a prefix request can
+                            # only batch at formation time, and a
+                            # prefix batch admits nobody — defer to
+                            # the collector's next batch.
+                            unstage(cand)
+                            with self._alock:
+                                self._deferred.append(cand)
                             continue
                         if never_admissible(cand):
                             # Hand back to the collector for the NEXT
@@ -817,6 +974,9 @@ class TextGenerationEngine:
                             temps[b_cur:] = 0.0
                             b_cur *= 2
                             free = list(range(b_cur // 2, b_cur))
+                            self._warmed_growth.add(
+                                (b_cur // 2, b_cur, total)
+                            )
                             self.growths += 1
                         row = free[0]
                         first1, mini = prefill_fn(self.model, bkt)(
@@ -887,11 +1047,23 @@ class TextGenerationEngine:
                 # would compile on the request path. Skip shrinking
                 # while joiners wait: they would force a regrow.
                 want_b = max(want_b, b_cur // 2)
-                if want_b < b_cur and not pending_n:
+                # In strict non-eager mode (tunnel attach) a resize
+                # whose gather shape was never compiled would stall
+                # the batch on a remote compile — skip it and keep
+                # decoding at full width instead (correct, just less
+                # compact). Shapes prove themselves as warmup and
+                # low-RTT runs execute them.
+                resize_ok = (
+                    not self._strict_admit
+                    or self._admit_eager
+                    or (b_cur, want_b, total) in self._warmed_shrink
+                )
+                if want_b < b_cur and not pending_n and resize_ok:
                     sel = [rows[i] for i in live]
                     sel += [sel[0]] * (want_b - len(sel))
                     sel = np.asarray(sel, np.int32)
                     cache = _compact_fn()(cache, jnp.asarray(sel))
+                    self._warmed_shrink.add((b_cur, want_b, total))
                     mirrors_take(sel)
                     rows = [None] * len(reqs)
                     for row, i in enumerate(live):
@@ -904,6 +1076,7 @@ class TextGenerationEngine:
                     jnp.asarray(n_pad), jnp.asarray(temps),
                     jnp.asarray(keys), jnp.asarray(step),
                     jnp.asarray(topk), jnp.asarray(topp),
+                    jnp.int32(p_len), jnp.int32(p_lo),
                 )
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
@@ -973,11 +1146,16 @@ class TextGenerationEngine:
     def _compatible(self, group: list, r) -> bool:
         """Can ``r`` join ``group`` without clamping anyone? The batch
         decodes to ``max(n_new)`` from a ``max(bucket)``-wide prompt;
-        both maxima together must still fit the model's window (each
-        request alone always does — ``_encode`` guarantees it)."""
+        both maxima together (plus the shared prefix, if any) must
+        still fit the model's window (each request alone always does —
+        ``_encode`` guarantees it). Prefix-cached requests batch only
+        with requests naming the SAME prefix: the prefix region is one
+        shared scalar layout for the whole batch."""
+        if r.prefix_fp != group[0].prefix_fp:
+            return False
         bucket = max(len(r.row), *(len(g.row) for g in group))
         n_new = max(r.n_new, *(g.n_new for g in group))
-        return bucket + n_new <= self.model.max_positions
+        return r.prefix_len + bucket + n_new <= self.model.max_positions
 
     async def _collect_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -1125,6 +1303,7 @@ class TextGenerationEngine:
         seed: int = 0,
         top_k: int = 0,
         top_p: float = 1.0,
+        prefix: str | None = None,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
@@ -1141,9 +1320,17 @@ class TextGenerationEngine:
                 f"generation collector died: {exc!r}"
             ) from exc
         n_new = int(max_new_tokens or self.default_max_new_tokens)
-        req = self._encode(
-            text, n_new, float(temperature), int(seed),
-            asyncio.get_running_loop(), int(top_k), float(top_p),
+        # Encode OFF the event loop: a first-use prefix runs a device
+        # prefill (and possibly an XLA compile) inside _encode — on
+        # the loop thread that would freeze every stream and timer in
+        # the server for its duration.
+        loop = asyncio.get_running_loop()
+        req = await loop.run_in_executor(
+            None,
+            lambda: self._encode(
+                text, n_new, float(temperature), int(seed), loop,
+                int(top_k), float(top_p), prefix=prefix,
+            ),
         )
         try:
             self._queue.put_nowait(req)
@@ -1165,6 +1352,7 @@ class TextGenerationEngine:
         seed: int = 0,
         top_k: int = 0,
         top_p: float = 1.0,
+        prefix: str | None = None,
     ) -> dict:
         """One prompt → generated continuation (text + ids), decoded
         through the same chunked programs the batcher uses (so there
@@ -1172,7 +1360,7 @@ class TextGenerationEngine:
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         req = self._encode(
             text, n_new, float(temperature), int(seed), None,
-            int(top_k), float(top_p),
+            int(top_k), float(top_p), prefix=prefix,
         )
         out_ids: list[int] = []
         sink = _SyncSink(req, out_ids)
@@ -1182,7 +1370,7 @@ class TextGenerationEngine:
         return {
             "text": self.tokenizer.decode(out_ids),
             "token_ids": out_ids,
-            "prompt_tokens": req.used,  # tokens that actually conditioned
+            "prompt_tokens": req.prompt_tokens,  # incl. prefix tokens
         }
 
     def warmup(self, *, full: bool | None = None) -> None:
