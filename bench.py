@@ -311,8 +311,12 @@ def bench_generate() -> None:
 
     n_new = 32
     payload = {"text": "the quick brown fox", "max_new_tokens": n_new}
+    srv_args = ["--checkpoint", ck]
+    quantized = os.environ.get("BENCH_GEN_QUANTIZE") == "1"
+    if quantized:
+        srv_args += ["--quantize", "int8"]
     server, health, fb_note = _start_with_cpu_fallback(
-        workdir, server_env, startup_timeout, args=["--checkpoint", ck]
+        workdir, server_env, startup_timeout, args=srv_args
     )
     note_extra = fb_note or note_extra
     try:
@@ -388,6 +392,47 @@ def bench_generate() -> None:
 
         (single, batched, mixed_r, shorts_alone, shorts_holb,
          admitted) = asyncio.run(measure())
+        prefix_extras = {}
+        if os.environ.get("BENCH_GEN_PREFIX") == "1":
+            # Prefix-caching TTFT: the same effective prompt served
+            # via the cached-prefix path vs inline concatenation.
+            sys_p = "the quick brown fox jumps over the lazy dog. " * 4
+            concat_payload = {
+                "text": sys_p + "hello", "max_new_tokens": 4,
+            }
+            prefix_payload = {
+                "text": "hello", "prefix": sys_p, "max_new_tokens": 4,
+            }
+
+            async def prefix_measure():
+                # One warm request each (compiles + builds the entry).
+                await run_load(
+                    "127.0.0.1", PORT, "/generate",
+                    payload=prefix_payload, concurrency=1, duration_s=3.0,
+                )
+                await run_load(
+                    "127.0.0.1", PORT, "/generate",
+                    payload=concat_payload, concurrency=1, duration_s=3.0,
+                )
+                via = await run_load(
+                    "127.0.0.1", PORT, "/generate",
+                    payload=prefix_payload, concurrency=1, duration_s=6.0,
+                )
+                concat = await run_load(
+                    "127.0.0.1", PORT, "/generate",
+                    payload=concat_payload, concurrency=1, duration_s=6.0,
+                )
+                return via, concat
+
+            via, concat = asyncio.run(prefix_measure())
+            prefix_extras = {
+                "prefix_cached_p50_ms": round(via.quantile(0.5) or -1, 1),
+                "prefix_concat_p50_ms": round(
+                    concat.quantile(0.5) or -1, 1
+                ),
+                "prefix_errors": via.errors + concat.errors,
+            }
+
         single_tps = single.throughput * n_new
         batched_tps = batched.throughput * n_new
         # Weight by ACTUAL completions per template: closed-loop
@@ -437,6 +482,8 @@ def bench_generate() -> None:
                             shorts_holb.quantile(0.5) or -1, 1
                         ),
                         "holb_admitted": admitted,
+                        "quantized": quantized,
+                        **prefix_extras,
                         "errors": (
                             single.errors + batched.errors + mixed_r.errors
                             + shorts_alone.errors + shorts_holb.errors

@@ -563,6 +563,10 @@ class TextGenerationEngine:
 
         self._prefixes: collections.OrderedDict = collections.OrderedDict()
         self.max_prefixes = 8
+        # Guards the LRU against concurrent _encode calls (submit runs
+        # encoding in executor threads): without it, N first requests
+        # naming the same prefix would each pay the cold prefill.
+        self._pxlock = threading.Lock()
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -626,7 +630,8 @@ class TextGenerationEngine:
             tier *= 2
         return min(self.model.max_positions, bucket + tier)
 
-    def _prefix_entry(self, text: str) -> "_PrefixEntry":
+    def _prefix_entry(self, text: str,
+                      ids: list | None = None) -> "_PrefixEntry":
         """Return (computing on first use, LRU-cached after) the KV
         cache of a shared prompt prefix. The forward pass over the
         prefix runs ONCE; every request naming the same prefix reuses
@@ -634,45 +639,82 @@ class TextGenerationEngine:
         time-to-first-token win prefix caching exists for. The first
         request with a new prefix pays the prefill (and possibly an
         XLA compile for a new prefix bucket) on its own latency, which
-        is the honest place for it."""
+        is the honest place for it. Cold builds serialize under
+        ``_pxlock`` so concurrent first requests share one prefill
+        instead of each paying it."""
         from mlapi_tpu.models.gpt import prefill_fn
 
-        entry = self._prefixes.get(text)
-        if entry is not None:
-            self._prefixes.move_to_end(text)
-            self.prefix_hits += 1
-            return entry
-        ids = self.tokenizer.token_ids(text)
-        if not ids:
-            raise ValueError("prefix tokenizes to nothing")
-        # The prefix must leave room for at least the smallest suffix
-        # bucket plus one generated token.
-        cap = self.model.max_positions - self.prompt_buckets[0] - 1
-        if len(ids) > cap:
-            raise ValueError(
-                f"prefix is {len(ids)} tokens; at most {cap} fit the "
-                f"model window (max_positions="
-                f"{self.model.max_positions})"
+        with self._pxlock:
+            entry = self._prefixes.get(text)
+            if entry is not None:
+                self._prefixes.move_to_end(text)
+                self.prefix_hits += 1
+                return entry
+            if ids is None:
+                ids = self.tokenizer.token_ids(text)
+            if not ids:
+                raise ValueError("prefix tokenizes to nothing")
+            # The prefix must leave room for at least the smallest
+            # suffix bucket plus one generated token.
+            cap = self.model.max_positions - self.prompt_buckets[0] - 1
+            if len(ids) > cap:
+                raise ValueError(
+                    f"prefix is {len(ids)} tokens; at most {cap} fit "
+                    f"the model window (max_positions="
+                    f"{self.model.max_positions})"
+                )
+            bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
+            row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            row[0, -len(ids):] = ids
+            lo = bucket - len(ids)
+            zero1 = np.zeros((1,), np.float32)
+            _, kv = prefill_fn(self.model, bucket)(
+                self.params, jnp.asarray(row),
+                jnp.asarray(self._key_data(0)[None]),
+                jnp.asarray(zero1),
+                jnp.asarray(np.asarray([lo], np.int32)),
+                jnp.asarray(np.zeros((1,), np.int32)),
+                jnp.asarray(np.ones((1,), np.float32)),
             )
-        bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
-        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        row[0, -len(ids):] = ids
-        lo = bucket - len(ids)
-        zero1 = np.zeros((1,), np.float32)
-        _, kv = prefill_fn(self.model, bucket)(
-            self.params, jnp.asarray(row),
-            jnp.asarray(self._key_data(0)[None]),
-            jnp.asarray(zero1),
-            jnp.asarray(np.asarray([lo], np.int32)),
-            jnp.asarray(np.zeros((1,), np.int32)),
-            jnp.asarray(np.ones((1,), np.float32)),
-        )
-        entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
-        self._prefixes[text] = entry
-        self.prefix_misses += 1
-        while len(self._prefixes) > self.max_prefixes:
-            self._prefixes.popitem(last=False)  # evict LRU
-        return entry
+            entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
+            if self._strict_admit:
+                self._warm_prefix_shapes(entry)
+            self._prefixes[text] = entry
+            self.prefix_misses += 1
+            while len(self._prefixes) > self.max_prefixes:
+                self._prefixes.popitem(last=False)  # evict LRU
+            return entry
+
+    def _warm_prefix_shapes(self, entry: "_PrefixEntry") -> None:
+        """Registration-time warm of the prefix-batch programs: on a
+        tunnel attach (strict mode) the first BATCH using a new prefix
+        must not stall the device stream on an XLA compile, so the
+        (suffix bucket × small batch) grid at the default cache tier
+        compiles as part of building the entry — the registration
+        request already owns that latency."""
+        from mlapi_tpu.models.gpt import prefix_prefill_fn
+
+        for sb in self.prompt_buckets:
+            if sb > entry.used:
+                continue  # such suffixes take the fallback path
+            total = self._cache_len(
+                entry.bucket + sb, self.default_max_new_tokens
+            )
+            for bsz in (1, 2):
+                suffix = np.full(
+                    (bsz, sb), self.tokenizer.pad_id, np.int32
+                )
+                prefix_prefill_fn(self.model, sb, total)(
+                    self.params, entry.kv, jnp.asarray(suffix),
+                    jnp.asarray(np.full((bsz,), sb - 1, np.int32)),
+                    jnp.int32(entry.lo),
+                    jnp.asarray(
+                        np.stack([self._key_data(0)] * bsz)
+                    ),
+                    jnp.asarray(np.zeros((bsz,), np.float32)),
+                    jnp.asarray(np.zeros((bsz,), np.int32)),
+                    jnp.asarray(np.ones((bsz,), np.float32)),
+                )
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
@@ -680,18 +722,28 @@ class TextGenerationEngine:
         entry = None
         if prefix:
             raw_s = self.tokenizer.token_ids(text)
-            p_ids = self.tokenizer.token_ids(prefix)
+            with self._pxlock:
+                cached = self._prefixes.get(prefix)
+            # Hit path never re-tokenizes the (possibly multi-KB)
+            # prefix string: the cached entry knows its token count.
+            p_ids = None
+            p_tok = cached.used if cached is not None else None
+            if p_tok is None:
+                p_ids = self.tokenizer.token_ids(prefix)
+                p_tok = len(p_ids)
             s_bucket = max(self._bucket(len(raw_s)), len(raw_s))
-            if s_bucket > len(p_ids):
-                # The KV path computes the suffix token-by-token; when
-                # the suffix rivals the prefix, one fused prefill over
-                # the concatenation is cheaper. Output is identical
-                # either way (the equivalence the tests pin), so route
-                # silently and count it.
+            if not raw_s or s_bucket > p_tok:
+                # Empty suffixes would condition on a fabricated pad
+                # placeholder behind the prefix; and the KV path
+                # computes the suffix token-by-token, so when the
+                # suffix rivals the prefix one fused prefill over the
+                # concatenation is cheaper. Output is identical either
+                # way (the equivalence the tests pin) — route silently
+                # and count it.
                 self.prefix_fallbacks += 1
                 text = prefix + text
             else:
-                entry = self._prefix_entry(prefix)
+                entry = self._prefix_entry(prefix, p_ids)
         p_len = entry.bucket if entry else 0
         limit = self.model.max_positions - n_new - p_len
         if limit <= 0:

@@ -32,6 +32,8 @@ def run(
     resume: bool = True,
     profile_dir: str | None = None,
     debug_checks: bool = False,
+    lora_rank: int = 0,
+    init_from: str | None = None,
 ) -> dict:
     import jax
 
@@ -51,6 +53,31 @@ def run(
             cfg.dataset,
         )
     model = get_model(cfg.model, **cfg.model_kwargs)
+    init_params = None
+    if init_from:
+        # Fine-tune from an existing checkpoint (the model config must
+        # match — the tree-signature check inside load_checkpoint
+        # refuses a mismatched architecture).
+        from mlapi_tpu.checkpoint import load_checkpoint
+
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            model.init(jax.random.key(cfg.seed)),
+        )
+        init_params, _ = load_checkpoint(init_from, abstract)
+        _log.info("initialised from checkpoint %s", init_from)
+    if lora_rank:
+        # Parameter-efficient fine-tune: adapters train, base freezes
+        # (no optimizer moments for it). The final checkpoint is the
+        # MERGED plain tree, so serving needs no LoRA awareness.
+        # --init-from supplies the pretrained base; without it the
+        # base is a fresh init (useful only for tests).
+        from mlapi_tpu.models.lora import LoraModel
+
+        model = LoraModel(model, rank=lora_rank)
+        init_params = model.init(
+            jax.random.key(cfg.seed), base_params=init_params
+        )
     if getattr(model, "input_kind", "tabular") == "text":
         # JAX gather clamps out-of-range ids silently; catch a
         # tokenizer/model vocab mismatch before it trains to garbage.
@@ -100,6 +127,7 @@ def run(
         resume=resume,
         profile_dir=profile_dir,
         debug_checks=debug_checks,
+        init_params=init_params,
     )
     _log.info(
         "%s: %d steps in %.2fs, final_loss=%.4f, test_accuracy=%s",
@@ -107,6 +135,9 @@ def run(
         result.test_accuracy,
     )
 
+    params_out = result.params
+    if lora_rank:
+        params_out = model.merge_params(result.params)
     if out:
         ckpt_config = {
             "model": cfg.model,
@@ -122,7 +153,7 @@ def run(
                 ckpt_config["tokenizer"] = splits.extras["tokenizer"]
         save_checkpoint(
             out,
-            result.params,
+            params_out,
             step=result.steps,
             config=ckpt_config,
             vocab=splits.vocab,
@@ -187,6 +218,18 @@ def main(argv=None) -> None:
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (view with TensorBoard)",
     )
+    parser.add_argument(
+        "--lora-rank", type=int, default=0,
+        help="LoRA fine-tune at this rank: only low-rank adapters "
+             "train (frozen base keeps no optimizer state); the saved "
+             "checkpoint is the merged plain tree, served unchanged. "
+             "Combine with --init-from to adapt a pretrained model",
+    )
+    parser.add_argument(
+        "--init-from", default=None,
+        help="seed training from this committed checkpoint's weights "
+             "(full fine-tune, or the frozen base for --lora-rank)",
+    )
     args = parser.parse_args(argv)
 
     if args.bench:
@@ -219,6 +262,8 @@ def main(argv=None) -> None:
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
         debug_checks=args.debug_checks,
+        lora_rank=args.lora_rank,
+        init_from=args.init_from,
     )
     print(json.dumps(summary))
 

@@ -307,6 +307,7 @@ def fit(
     profile_dir: str | None = None,
     debug_checks: bool = False,
     task: str = "auto",
+    init_params=None,
 ) -> TrainResult:
     """Train ``model`` on ``splits``.
 
@@ -349,8 +350,18 @@ def fit(
             "lm" if np.asarray(splits.y_train).ndim == 2 else "classify",
         )
 
-    params = model.init(jax.random.key(seed))
+    # ``init_params`` seeds training from existing weights (pretrained
+    # fine-tune, LoRA base) instead of a fresh random init.
+    params = (
+        init_params if init_params is not None
+        else model.init(jax.random.key(seed))
+    )
     tx = _make_optimizer(optimizer, learning_rate, model=model, params=params)
+    if hasattr(model, "trainable_mask"):
+        # Parameter-efficient fine-tuning (LoRA): frozen leaves get no
+        # update and — the part that matters for memory — no optimizer
+        # state at all (adamw moments exist only for the adapters).
+        tx = optax.masked(tx, model.trainable_mask(params))
 
     if mesh is not None:
         # Model-declared layout (e.g. Wide&Deep's sharded embedding

@@ -1,0 +1,203 @@
+"""LoRA fine-tuning (`models/lora.py`): adapter init, the
+zero-at-start guarantee, frozen-base training through the standard
+`fit` loop, and merged export serving through the unchanged engines."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.models.lora import LoraModel
+from mlapi_tpu.text import ByteTokenizer
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=96,
+    compute_dtype="float32",
+)
+
+
+def test_init_adapts_every_projection_and_starts_at_identity():
+    base_model = get_model("gpt_lm", **CFG)
+    lm = LoraModel(base_model, rank=4)
+    params = lm.init(jax.random.key(0))
+    # 4 projections per layer x 2 layers for the GPT family.
+    assert len(params["lora"]) == 8
+    for ab in params["lora"].values():
+        assert ab["a"].shape[1] == 4 and ab["b"].shape[0] == 4
+        np.testing.assert_array_equal(np.asarray(ab["b"]), 0.0)
+    # b == 0 → the adapted model IS the base model at step 0.
+    ids = jnp.asarray(np.arange(16, dtype=np.int32)[None] % 200)
+    ref = base_model.apply(params["base"], ids)
+    got = lm.apply(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_init_is_deterministic_across_calls():
+    lm = LoraModel(get_model("gpt_lm", **CFG), rank=2)
+    p1 = lm.init(jax.random.key(3))
+    p2 = lm.init(jax.random.key(3))
+    for k in p1["lora"]:
+        np.testing.assert_array_equal(
+            np.asarray(p1["lora"][k]["a"]), np.asarray(p2["lora"][k]["a"])
+        )
+
+
+def test_llama_targets_found():
+    cfg = dict(CFG)
+    cfg.pop("num_heads")
+    lm = LoraModel(
+        get_model("llama_lm", **cfg, num_heads=4, num_kv_heads=2), rank=2
+    )
+    params = lm.init(jax.random.key(0))
+    # 7 projections per layer (q,k,v,wo,gate,up,down) x 2 layers.
+    assert len(params["lora"]) == 14
+
+
+def test_masked_training_updates_only_adapters():
+    """Through the REAL train step (make_train_step + optax.masked):
+    the base tree is byte-identical after training; only a/b move —
+    and the optimizer keeps no state for frozen leaves."""
+    from mlapi_tpu.train.loop import make_train_step
+
+    model = LoraModel(get_model("gpt_lm", **CFG), rank=4)
+    params = model.init(jax.random.key(0))
+    base_before = jax.tree.map(lambda a: np.asarray(a).copy(),
+                               params["base"])
+    tx = optax.masked(optax.adam(1e-2), model.trainable_mask(params))
+    opt = tx.init(params)
+    # Frozen leaves carry no adam moments (MaskedNode), adapters do.
+    masked_leaves = jax.tree.leaves(opt)
+    lora_param_leaves = jax.tree.leaves(
+        {"lora": params["lora"]}
+    )
+    # mu + nu per trainable leaf only:
+    assert (
+        sum(1 for x in masked_leaves if hasattr(x, "shape"))
+        <= 2 * len(lora_param_leaves) + 2  # (+count leaves)
+    )
+
+    tok = ByteTokenizer()
+    seq = np.asarray(tok.token_ids("ab" * 20), np.int32)[None]
+    seqs = np.tile(seq, (8, 1))
+    step = make_train_step(model.apply, tx, task="lm")
+    loss0 = None
+    for _ in range(30):
+        params, opt, loss = step(
+            params, opt, jnp.asarray(seqs), jnp.asarray(seqs)
+        )
+        loss0 = loss0 if loss0 is not None else float(loss)
+    assert float(loss) < loss0, "LoRA-only training did not learn"
+    for p_new, p_old in zip(
+        jax.tree.leaves(params["base"]), jax.tree.leaves(base_before)
+    ):
+        np.testing.assert_array_equal(np.asarray(p_new), p_old)
+    moved = any(
+        not np.array_equal(np.asarray(ab["b"]), 0.0)
+        for ab in params["lora"].values()
+    )
+    assert moved, "no adapter moved"
+
+
+def test_merge_export_serves_through_plain_engine(tmp_path):
+    """merge_params folds the adaptation into a plain tree that
+    checkpoints and serves with zero engine changes."""
+    from mlapi_tpu.checkpoint import save_checkpoint
+    from mlapi_tpu.serving import InferenceEngine
+
+    inner = get_model("gpt_lm", **CFG)
+    lm = LoraModel(inner, rank=4)
+    params = lm.init(jax.random.key(0))
+    # Give the adapters some nonzero content.
+    params["lora"] = jax.tree.map(
+        lambda a: a + 0.01, params["lora"]
+    )
+    merged = lm.merge_params(params)
+    ids = jnp.asarray(np.arange(12, dtype=np.int32)[None] % 200)
+    np.testing.assert_allclose(
+        np.asarray(inner.apply(merged, ids)),
+        np.asarray(lm.apply(params, ids)),
+        atol=1e-5,
+    )
+    ck = tmp_path / "merged"
+    save_checkpoint(
+        ck, merged, step=1,
+        config={
+            "model": "gpt_lm", "model_kwargs": CFG,
+            "tokenizer": ByteTokenizer().fingerprint(),
+        },
+    )
+    eng = InferenceEngine.from_checkpoint(ck)
+    out = eng.generate_text("ab", max_new_tokens=4)
+    assert len(out["token_ids"]) == 4
+
+
+def test_fit_integration_freezes_base():
+    """End to end through `fit`: LoRA training on the LM task runs
+    and leaves the base frozen."""
+    from mlapi_tpu.datasets import SupervisedSplits
+    from mlapi_tpu.train import fit
+    from mlapi_tpu.utils.vocab import LabelVocab
+
+    model = LoraModel(get_model("gpt_lm", **CFG), rank=4)
+    tok = ByteTokenizer()
+    seqs = np.tile(
+        np.asarray(tok.token_ids("abcd " * 12), np.int32)[None][:, :48],
+        (16, 1),
+    )
+    splits = SupervisedSplits(
+        x_train=seqs[:12], y_train=seqs[:12],
+        x_test=seqs[12:], y_test=seqs[12:],
+        vocab=LabelVocab(("<lm>",)), source="synthetic",
+        extras={"tokenizer": tok.fingerprint(), "task": "lm"},
+    )
+    base_before = jax.tree.map(
+        lambda a: np.asarray(a).copy(),
+        model.init(jax.random.key(0))["base"],
+    )
+    r = fit(model, splits, steps=10, learning_rate=1e-2,
+            optimizer="adam", batch_size=8, seed=0)
+    for p_new, p_old in zip(
+        jax.tree.leaves(r.params["base"]), jax.tree.leaves(base_before)
+    ):
+        np.testing.assert_array_equal(np.asarray(p_new), p_old)
+
+
+def test_cli_lora_finetunes_from_pretrained_base(tmp_path):
+    """--init-from + --lora-rank: the frozen base really is the
+    pretrained checkpoint (not a fresh init), and the exported merged
+    checkpoint serves."""
+    from mlapi_tpu.serving import InferenceEngine
+    from mlapi_tpu.train.__main__ import main as train_main
+
+    base_ck = tmp_path / "base"
+    lora_ck = tmp_path / "lora"
+    train_main([
+        "--preset", "docs-gpt", "--steps", "8", "--out", str(base_ck),
+    ])
+    train_main([
+        "--preset", "docs-gpt", "--steps", "4", "--out", str(lora_ck),
+        "--lora-rank", "4", "--init-from", str(base_ck),
+    ])
+    base_eng = InferenceEngine.from_checkpoint(base_ck)
+    lora_eng = InferenceEngine.from_checkpoint(lora_ck)
+    # The adapted model inherits the pretrained embeddings: wte must
+    # be byte-identical (frozen), not a different random init.
+    np.testing.assert_array_equal(
+        np.asarray(base_eng.params["wte"]),
+        np.asarray(lora_eng.params["wte"]),
+    )
+    out = lora_eng.generate_text("the", max_new_tokens=4)
+    assert len(out["token_ids"]) == 4
+
+
+def test_no_targets_is_loud():
+    with pytest.raises(ValueError, match="no LoRA targets"):
+        LoraModel(
+            get_model("linear", num_features=4, num_classes=3), rank=2
+        ).init(jax.random.key(0))

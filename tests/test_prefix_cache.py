@@ -201,6 +201,39 @@ def test_prefix_plus_request_window_accounting():
         eng.generate_text("ij", max_new_tokens=150, prefix="a" * 16)
 
 
+def test_empty_text_with_prefix_serves_prefix_alone():
+    """text="" must not condition on a fabricated pad placeholder
+    behind the prefix: it falls back to the plain path and equals
+    serving the prefix as the whole prompt (code-review regression)."""
+    eng = _engine()
+    plain = eng.generate_text(LONG_P, max_new_tokens=6)
+    via = eng.generate_text("", max_new_tokens=6, prefix=LONG_P)
+    assert via["token_ids"] == plain["token_ids"]
+    assert via["prompt_tokens"] == plain["prompt_tokens"]
+    assert eng.prefix_fallbacks == 1
+
+
+def test_hit_path_does_not_retokenize_prefix():
+    """After the entry exists, encoding consults the LRU before
+    touching the prefix string (multi-KB system prompts must not be
+    re-tokenized per request)."""
+    eng = _engine()
+    eng.generate_text("ij", max_new_tokens=2, prefix=LONG_P)
+    calls = []
+    orig = eng.tokenizer.token_ids
+
+    def spy(s):
+        calls.append(s)
+        return orig(s)
+
+    eng.tokenizer.token_ids = spy
+    try:
+        eng.generate_text("kl", max_new_tokens=2, prefix=LONG_P)
+    finally:
+        eng.tokenizer.token_ids = orig
+    assert LONG_P not in calls, "hit path re-tokenized the prefix"
+
+
 def test_oversized_suffix_on_kv_path_refused():
     """On the KV path the plain path's silent left-truncation would
     drop SUFFIX tokens while keeping the whole prefix — different
