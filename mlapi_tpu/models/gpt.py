@@ -280,6 +280,48 @@ class GptLM:
         )
         return logits, new_cache
 
+    def extend_core(self, params, cache, token_ids, pos0, n_pad,
+                    prefix_len, prefix_lo):
+        """Fused BLOCK forward of ``[B, U]`` tokens at cache slots
+        ``[pos0, pos0+U)`` against an existing cache — the multi-token
+        generalization of :meth:`decode_step` (one weight pass over
+        the whole block instead of U serial steps; this is what makes
+        prefix-cache suffix prefill MXU-bound, not bandwidth-bound).
+        Queries attend to every earlier valid cache slot plus the
+        causal part of their own block, under the same
+        prefix-region/pad-hole layout as
+        :func:`decode_valid_and_shift`. Returns
+        ``(cache, last_logits [B, V])``.
+        """
+        cdt = jnp.dtype(self.compute_dtype)
+        b, u = token_ids.shape
+        hd = self.head_dim
+        max_len = cache["layer_0"]["k"].shape[1]
+
+        posq, mask = extend_positions_and_mask(
+            max_len, u, pos0, n_pad, prefix_len, prefix_lo
+        )
+        x = params["wte"][token_ids] + params["wpe"][posq]
+        new_cache = {}
+
+        for n in range(self.num_layers):
+            layer = params[f"layer_{n}"]
+
+            def attend(q, k_new, v_new, *, _n=n):
+                out, new_cache[f"layer_{_n}"] = cached_attend(
+                    cache[f"layer_{_n}"], q, k_new, v_new, pos0, mask,
+                    cdt, hd,
+                )
+                return out
+
+            x = self._block(layer, x, attend)
+
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+        last = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
+            jnp.float32
+        )
+        return new_cache, last
+
     def generate(
         self,
         params,
@@ -501,6 +543,31 @@ def decode_valid_and_shift(max_len, pos, n_pad, prefix_len=None,
     return valid, shift
 
 
+def extend_positions_and_mask(max_len, u, pos0, n_pad, prefix_len=None,
+                              prefix_lo=None):
+    """Block-extend variant of :func:`decode_valid_and_shift`: for U
+    queries at cache slots ``[pos0, pos0+U)``, per-row effective
+    positions ``[B, U]`` (clipped at 0 for pad slots) and the
+    ``[B, 1, U, L]`` key mask — earlier valid slots plus the causal
+    part of the block itself, minus the prefix pad and the per-row
+    suffix pad hole."""
+    if prefix_len is None:
+        prefix_len = jnp.int32(0)
+    if prefix_lo is None:
+        prefix_lo = jnp.int32(0)
+    idx = jnp.arange(max_len)
+    qpos = pos0 + jnp.arange(u)                       # [U] slot ids
+    shift = prefix_lo + n_pad                          # [B]
+    posq = jnp.maximum(qpos[None, :] - shift[:, None], 0)
+    valid_k = (idx[None, :] >= prefix_lo) & (
+        (idx[None, :] < prefix_len)
+        | (idx[None, :] >= prefix_len + n_pad[:, None])
+    )                                                  # [B, L]
+    causal = idx[None, None, :] <= qpos[None, :, None]  # [1, U, L]
+    mask = (valid_k[:, None, :] & causal)[:, None, :, :]
+    return posq, mask
+
+
 def cached_attend(
     cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None
 ):
@@ -693,11 +760,13 @@ def prefix_prefill_fn(model, suffix_len: int, total: int):
     prefix drops from O(P + U) to O(U) forward work.
 
     Per-row suffix pads (``hole [B]``) are masked via the pad hole in
-    :func:`decode_valid_and_shift`; ``lo`` is the prefix's OWN
-    left-pad inside its bucket. Sampling draws at each row's stream
-    index 0, so the emitted stream is byte-identical to the same
-    prompt served without prefix caching. Returns
-    ``(first_tok [B], cache)``.
+    :func:`extend_positions_and_mask`; ``lo`` is the prefix's OWN
+    left-pad inside its bucket. The suffix runs as ONE fused block
+    forward (``extend_core``) — a single weight pass, like the plain
+    prefill, so the KV path beats re-prefilling the concatenation for
+    every nonempty prefix. Sampling draws at each row's stream index
+    0, so the emitted stream is byte-identical to the same prompt
+    served without prefix caching. Returns ``(first_tok [B], cache)``.
     """
 
     def _run(params, prefix_kv, suffix_ids, hole, lo, key_data, temps,
@@ -715,20 +784,9 @@ def prefix_prefill_fn(model, suffix_len: int, total: int):
             ),
             cache, prefix_kv,
         )
-
-        def step(carry, u):
-            cache, _ = carry
-            logits, cache = model.decode_step(
-                params, cache, jax.lax.dynamic_slice_in_dim(
-                    suffix_ids, u, 1, axis=1
-                ),
-                p_len + u, hole, jnp.int32(p_len), lo,
-            )
-            return (cache, logits), None
-
-        zero = jnp.zeros((b, model.vocab_size), jnp.float32)
-        (cache, logits), _ = jax.lax.scan(
-            step, (cache, zero), jnp.arange(suffix_len)
+        cache, logits = model.extend_core(
+            params, cache, suffix_ids, jnp.int32(p_len), hole,
+            jnp.int32(p_len), lo,
         )
         first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
         return first, cache

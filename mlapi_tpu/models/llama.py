@@ -315,6 +315,42 @@ class LlamaLM:
         )
         return logits, new_cache
 
+    def extend_core(self, params, cache, token_ids, pos0, n_pad,
+                    prefix_len, prefix_lo):
+        """Fused block forward against an existing cache — same
+        contract as ``GptLM.extend_core`` (rotary positions per row,
+        GQA kv broadcast via the shared ``cached_attend``)."""
+        from mlapi_tpu.models.gpt import (
+            cached_attend, extend_positions_and_mask,
+        )
+
+        cdt = jnp.dtype(self.compute_dtype)
+        max_len = cache["layer_0"]["k"].shape[1]
+        posq, mask = extend_positions_and_mask(
+            max_len, token_ids.shape[1], pos0, n_pad, prefix_len,
+            prefix_lo,
+        )
+        x = params["wte"][token_ids]
+        new_cache = {}
+
+        for n in range(self.num_layers):
+            layer = params[f"layer_{n}"]
+
+            def attend(q, k_new, v_new, *, _n=n):
+                out, new_cache[f"layer_{_n}"] = cached_attend(
+                    cache[f"layer_{_n}"], q, k_new, v_new, pos0, mask,
+                    cdt, self.head_dim, expand=self._repeat_kv,
+                )
+                return out
+
+            x = self._block(layer, x, posq, attend)
+
+        x = _rms_norm(x, params["rms_f_scale"])
+        last = x[:, -1].astype(jnp.float32) @ params["lm_head"].astype(
+            jnp.float32
+        )
+        return new_cache, last
+
     def generate(self, params, prompt_ids, **kwargs):
         """Same surface as ``GptLM.generate`` (the whole prefill +
         chunked-scan + sampling pipeline is the shared machinery in

@@ -55,6 +55,13 @@ class QuantizedModel:
             prefix_len, prefix_lo,
         )
 
+    def extend_core(self, params, cache, token_ids, pos0, n_pad,
+                    prefix_len, prefix_lo):
+        return self.inner.extend_core(
+            self._deq(params), cache, token_ids, pos0, n_pad,
+            prefix_len, prefix_lo,
+        )
+
     def generate(self, params, prompt_ids, **kwargs):
         # Route through the model-generic path with SELF as the model
         # so prefill/decode dequantize inside the traced program —

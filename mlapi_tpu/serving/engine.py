@@ -566,7 +566,10 @@ class TextGenerationEngine:
         # Guards the LRU against concurrent _encode calls (submit runs
         # encoding in executor threads): without it, N first requests
         # naming the same prefix would each pay the cold prefill.
+        # ``_px_building`` holds per-key in-flight build events so
+        # cold builds never block hits on OTHER prefixes.
         self._pxlock = threading.Lock()
+        self._px_building: dict = {}
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -630,60 +633,81 @@ class TextGenerationEngine:
             tier *= 2
         return min(self.model.max_positions, bucket + tier)
 
-    def _prefix_entry(self, text: str,
-                      ids: list | None = None) -> "_PrefixEntry":
+    def _prefix_entry(self, text: str) -> "_PrefixEntry":
         """Return (computing on first use, LRU-cached after) the KV
         cache of a shared prompt prefix. The forward pass over the
         prefix runs ONCE; every request naming the same prefix reuses
         its keys/values straight from device memory — the
         time-to-first-token win prefix caching exists for. The first
-        request with a new prefix pays the prefill (and possibly an
-        XLA compile for a new prefix bucket) on its own latency, which
-        is the honest place for it. Cold builds serialize under
-        ``_pxlock`` so concurrent first requests share one prefill
-        instead of each paying it."""
+        request with a new prefix pays the prefill (and possibly XLA
+        compiles for its shapes) on its own latency. Concurrent first
+        requests for the SAME prefix share one build (per-key event);
+        hits on other prefixes never wait behind a build — the lock
+        guards only the dict, not the device work."""
+        while True:
+            with self._pxlock:
+                entry = self._prefixes.get(text)
+                if entry is not None:
+                    self._prefixes.move_to_end(text)
+                    self.prefix_hits += 1
+                    return entry
+                ev = self._px_building.get(text)
+                if ev is None:
+                    import threading
+
+                    ev = threading.Event()
+                    self._px_building[text] = ev
+                    break
+            # Someone else is building this prefix: wait, then re-check
+            # (their failure leaves the entry absent — we retry as the
+            # builder and surface the same error to this caller).
+            ev.wait(timeout=600.0)
+        try:
+            entry = self._build_prefix_entry(text)
+            with self._pxlock:
+                self._prefixes[text] = entry
+                self.prefix_misses += 1
+                while len(self._prefixes) > self.max_prefixes:
+                    self._prefixes.popitem(last=False)  # evict LRU
+            return entry
+        finally:
+            with self._pxlock:
+                self._px_building.pop(text, None)
+            ev.set()
+
+    def _build_prefix_entry(self, text: str) -> "_PrefixEntry":
+        """Tokenize, validate, prefill, and (strict mode) warm one
+        prefix — device work, run OUTSIDE the registry lock."""
         from mlapi_tpu.models.gpt import prefill_fn
 
-        with self._pxlock:
-            entry = self._prefixes.get(text)
-            if entry is not None:
-                self._prefixes.move_to_end(text)
-                self.prefix_hits += 1
-                return entry
-            if ids is None:
-                ids = self.tokenizer.token_ids(text)
-            if not ids:
-                raise ValueError("prefix tokenizes to nothing")
-            # The prefix must leave room for at least the smallest
-            # suffix bucket plus one generated token.
-            cap = self.model.max_positions - self.prompt_buckets[0] - 1
-            if len(ids) > cap:
-                raise ValueError(
-                    f"prefix is {len(ids)} tokens; at most {cap} fit "
-                    f"the model window (max_positions="
-                    f"{self.model.max_positions})"
-                )
-            bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
-            row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            row[0, -len(ids):] = ids
-            lo = bucket - len(ids)
-            zero1 = np.zeros((1,), np.float32)
-            _, kv = prefill_fn(self.model, bucket)(
-                self.params, jnp.asarray(row),
-                jnp.asarray(self._key_data(0)[None]),
-                jnp.asarray(zero1),
-                jnp.asarray(np.asarray([lo], np.int32)),
-                jnp.asarray(np.zeros((1,), np.int32)),
-                jnp.asarray(np.ones((1,), np.float32)),
+        ids = self.tokenizer.token_ids(text)
+        if not ids:
+            raise ValueError("prefix tokenizes to nothing")
+        # The prefix must leave room for at least the smallest suffix
+        # bucket plus one generated token.
+        cap = self.model.max_positions - self.prompt_buckets[0] - 1
+        if len(ids) > cap:
+            raise ValueError(
+                f"prefix is {len(ids)} tokens; at most {cap} fit "
+                f"the model window (max_positions="
+                f"{self.model.max_positions})"
             )
-            entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
-            if self._strict_admit:
-                self._warm_prefix_shapes(entry)
-            self._prefixes[text] = entry
-            self.prefix_misses += 1
-            while len(self._prefixes) > self.max_prefixes:
-                self._prefixes.popitem(last=False)  # evict LRU
-            return entry
+        bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
+        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        row[0, -len(ids):] = ids
+        lo = bucket - len(ids)
+        _, kv = prefill_fn(self.model, bucket)(
+            self.params, jnp.asarray(row),
+            jnp.asarray(self._key_data(0)[None]),
+            jnp.asarray(np.zeros((1,), np.float32)),
+            jnp.asarray(np.asarray([lo], np.int32)),
+            jnp.asarray(np.zeros((1,), np.int32)),
+            jnp.asarray(np.ones((1,), np.float32)),
+        )
+        entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
+        if self._strict_admit:
+            self._warm_prefix_shapes(entry)
+        return entry
 
     def _warm_prefix_shapes(self, entry: "_PrefixEntry") -> None:
         """Registration-time warm of the prefix-batch programs: on a
@@ -694,13 +718,19 @@ class TextGenerationEngine:
         request already owns that latency."""
         from mlapi_tpu.models.gpt import prefix_prefill_fn
 
+        b_max = 1
+        while b_max < self.max_batch:
+            b_max *= 2
+        batches = [1]
+        while batches[-1] < b_max:
+            batches.append(batches[-1] * 2)
         for sb in self.prompt_buckets:
-            if sb > entry.used:
-                continue  # such suffixes take the fallback path
+            if entry.bucket + sb + 1 > self.model.max_positions:
+                continue  # no room for such suffixes behind this prefix
             total = self._cache_len(
                 entry.bucket + sb, self.default_max_new_tokens
             )
-            for bsz in (1, 2):
+            for bsz in batches:
                 suffix = np.full(
                     (bsz, sb), self.tokenizer.pad_id, np.int32
                 )
@@ -722,28 +752,19 @@ class TextGenerationEngine:
         entry = None
         if prefix:
             raw_s = self.tokenizer.token_ids(text)
-            with self._pxlock:
-                cached = self._prefixes.get(prefix)
-            # Hit path never re-tokenizes the (possibly multi-KB)
-            # prefix string: the cached entry knows its token count.
-            p_ids = None
-            p_tok = cached.used if cached is not None else None
-            if p_tok is None:
-                p_ids = self.tokenizer.token_ids(prefix)
-                p_tok = len(p_ids)
-            s_bucket = max(self._bucket(len(raw_s)), len(raw_s))
-            if not raw_s or s_bucket > p_tok:
-                # Empty suffixes would condition on a fabricated pad
-                # placeholder behind the prefix; and the KV path
-                # computes the suffix token-by-token, so when the
-                # suffix rivals the prefix one fused prefill over the
-                # concatenation is cheaper. Output is identical either
-                # way (the equivalence the tests pin) — route silently
-                # and count it.
+            if not raw_s:
+                # An empty suffix would condition on a fabricated pad
+                # placeholder behind the prefix — serve the prefix
+                # alone through the plain path instead (identical
+                # output by the pinned equivalence).
                 self.prefix_fallbacks += 1
                 text = prefix + text
             else:
-                entry = self._prefix_entry(prefix, p_ids)
+                # The suffix runs as ONE fused block forward against
+                # the cached prefix KV (extend_core), so the KV path
+                # wins for every nonempty prefix — no length
+                # heuristic needed.
+                entry = self._prefix_entry(prefix)
         p_len = entry.bucket if entry else 0
         limit = self.model.max_positions - n_new - p_len
         if limit <= 0:
