@@ -748,6 +748,39 @@ def decode_chunk_fn(model, chunk: int):
 
 
 @functools.lru_cache(maxsize=64)
+def extend_chunk_fn(model, width: int, total: int):
+    """Jitted chunked-prefill program: one ``[B, width]`` block of a
+    long prompt forwarded against the cache at traced offset ``pos0``
+    (``extend_core``). Because the offset is traced, ONE compile
+    serves every chunk of every prompt padded to a ``width`` multiple
+    — a 4096-token prompt costs ceil(4096/width) dispatches of this
+    same program instead of a bespoke exact-length compile per prompt
+    length (the compile-count story that makes long-context serving
+    predictable). Returns ``(cache, last_logits)``; the caller samples
+    from the FINAL chunk's logits only."""
+
+    def _run(params, cache, chunk_ids, pos0, n_pad):
+        return model.extend_core(
+            params, cache, chunk_ids, pos0, n_pad,
+            jnp.int32(0), jnp.int32(0),
+        )
+
+    return jax.jit(_run, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=16)
+def sample_fn(model):
+    """Jitted standalone sampler for the chunked-prefill path: the
+    final chunk's logits → each row's first token at stream index 0
+    (identical draw to the fused prefill programs)."""
+
+    def _run(logits, key_data, temps, top_k, top_p):
+        return _pick_token(temps, logits, key_data, 0, top_k, top_p)
+
+    return jax.jit(_run)
+
+
+@functools.lru_cache(maxsize=64)
 def prefix_prefill_fn(model, suffix_len: int, total: int):
     """Jitted prefix-cache prefill + first-token program: scatter a
     shared prompt prefix's precomputed KV (``prefix_kv``, a

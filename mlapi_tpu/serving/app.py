@@ -587,6 +587,9 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.prefix_fallbacks"] = (
                 engine.prefix_fallbacks
             )
+            snap["counters"]["generate.prefill_chunks"] = (
+                engine.prefill_chunks
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

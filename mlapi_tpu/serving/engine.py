@@ -582,6 +582,7 @@ class TextGenerationEngine:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_fallbacks = 0
+        self.prefill_chunks = 0
         # Batch-resize (compaction) shapes proven compiled — in
         # strict non-eager mode a resize outside this set is skipped
         # (decode stays at full width) rather than compiled mid-batch.
@@ -718,11 +719,8 @@ class TextGenerationEngine:
         request already owns that latency."""
         from mlapi_tpu.models.gpt import prefix_prefill_fn
 
-        b_max = 1
-        while b_max < self.max_batch:
-            b_max *= 2
         batches = [1]
-        while batches[-1] < b_max:
+        while batches[-1] < self.max_batch:
             batches.append(batches[-1] * 2)
         for sb in self.prompt_buckets:
             if entry.bucket + sb + 1 > self.model.max_positions:
@@ -750,15 +748,17 @@ class TextGenerationEngine:
                 loop, top_k: int = 0, top_p: float = 1.0,
                 prefix: str | None = None) -> GenRequest:
         entry = None
+        raw = None
         if prefix:
-            raw_s = self.tokenizer.token_ids(text)
-            if not raw_s:
+            raw = self.tokenizer.token_ids(text)
+            if not raw:
                 # An empty suffix would condition on a fabricated pad
                 # placeholder behind the prefix — serve the prefix
                 # alone through the plain path instead (identical
                 # output by the pinned equivalence).
                 self.prefix_fallbacks += 1
                 text = prefix + text
+                raw = None  # re-tokenize the concatenation below
             else:
                 # The suffix runs as ONE fused block forward against
                 # the cached prefix KV (extend_core), so the KV path
@@ -774,7 +774,8 @@ class TextGenerationEngine:
                 + f" leaves no room for a prompt "
                   f"(max_positions={self.model.max_positions})"
             )
-        raw = self.tokenizer.token_ids(text)
+        if raw is None:
+            raw = self.tokenizer.token_ids(text)
         if entry is not None and len(raw) > limit:
             # The plain path documents left-truncation of oversized
             # prompts; on the KV path that would truncate the SUFFIX
@@ -789,9 +790,20 @@ class TextGenerationEngine:
         # Left-pad to a bucket so common prompt lengths never
         # recompile; pads are masked out by the model (n_pad), so the
         # answer is identical whichever bucket the prompt lands in. A
-        # prompt longer than the largest bucket gets its exact length
-        # (one-off compile) rather than silent truncation.
-        bucket = min(max(self._bucket(len(raw)), len(raw)), limit)
+        # prompt longer than the largest bucket rounds up to a
+        # multiple of it and prefills in fixed-width chunks (ONE
+        # compiled program per cache tier, any length — see
+        # ``extend_chunk_fn``); only when even that multiple exceeds
+        # the window does it take its exact length (one-off compile)
+        # rather than silent truncation.
+        if len(raw) > self.prompt_buckets[-1]:
+            cp = self.prompt_buckets[-1]
+            bucket = -(-len(raw) // cp) * cp
+            if bucket > limit:
+                bucket = len(raw)
+        else:
+            bucket = self._bucket(len(raw))
+        bucket = min(bucket, limit)
         row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
         used = min(len(raw), bucket)
         row[-used:] = raw[-used:]
@@ -885,6 +897,33 @@ class TextGenerationEngine:
                     self.params, reqs[0].prefix_kv, jnp.asarray(prompt),
                     jnp.asarray(n_pad), jnp.int32(p_lo),
                     jnp.asarray(keys), jnp.asarray(temps),
+                    jnp.asarray(topk), jnp.asarray(topp),
+                )
+            elif (
+                bucket > self.prompt_buckets[-1]
+                and bucket % self.prompt_buckets[-1] == 0
+            ):
+                # Chunked prefill: the long prompt runs as fixed-width
+                # extend_core blocks at a TRACED offset — one compiled
+                # program per cache tier serves every prompt length,
+                # instead of a bespoke compile per exact length.
+                from mlapi_tpu.models.gpt import extend_chunk_fn, sample_fn
+
+                cp = self.prompt_buckets[-1]
+                cache = self.model.init_cache(b_pad, total)
+                n_pad_j = jnp.asarray(n_pad)
+                logits = None
+                for c0 in range(0, bucket, cp):
+                    self.prefill_chunks += 1
+                    cache, logits = extend_chunk_fn(
+                        self.model, cp, total
+                    )(
+                        self.params, cache,
+                        jnp.asarray(prompt[:, c0:c0 + cp]),
+                        jnp.int32(c0), n_pad_j,
+                    )
+                first = sample_fn(self.model)(
+                    logits, jnp.asarray(keys), jnp.asarray(temps),
                     jnp.asarray(topk), jnp.asarray(topp),
                 )
             else:

@@ -62,11 +62,8 @@ def run(
 
         # eval_shape: abstract tree only — a full random init of a
         # large pretrained model could OOM before the load even runs.
-        abstract = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            jax.eval_shape(
-                lambda: model.init(jax.random.key(cfg.seed))
-            ),
+        abstract = jax.eval_shape(
+            lambda: model.init(jax.random.key(cfg.seed))
         )
         init_params, _ = load_checkpoint(init_from, abstract)
         _log.info("initialised from checkpoint %s", init_from)

@@ -294,6 +294,35 @@ def test_window_edge_request_gets_partial_final_chunk():
     assert len(out["token_ids"]) == 24
 
 
+def test_chunked_prefill_matches_single_prefill():
+    """A prompt longer than the largest bucket prefills in
+    fixed-width extend_core chunks (one compiled program per cache
+    tier, traced offset) and produces the same tokens as a single
+    full-width prefill — greedy and seeded-sampled."""
+    cfg = dict(CFG, max_positions=320)
+    model = get_model("gpt_lm", **cfg)
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+    chunked = TextGenerationEngine(
+        model, params, tokenizer=tok, chunk=4,
+        prompt_buckets=(16, 64, 128),
+    )
+    wide = TextGenerationEngine(
+        model, params, tokenizer=tok, chunk=4,
+        prompt_buckets=(16, 64, 256),
+    )
+    text = "abcdefgh" * 25  # 200 tokens: chunked 2x128 vs one 256
+    for kw in (
+        dict(max_new_tokens=8),
+        dict(max_new_tokens=8, temperature=0.9, seed=4, top_k=30),
+    ):
+        a = chunked.generate_text(text, **kw)
+        b = wide.generate_text(text, **kw)
+        assert a["token_ids"] == b["token_ids"], kw
+    assert chunked.prefill_chunks == 4  # 2 chunks x 2 runs
+    assert wide.prefill_chunks == 0
+
+
 async def test_staggered_soak_every_stream_exact():
     """Randomized staggered arrivals across buckets, lengths, and
     sampling configs: every stream must match its solo run exactly,
