@@ -281,7 +281,7 @@ class GptLM:
         return logits, new_cache
 
     def extend_core(self, params, cache, token_ids, pos0, n_pad,
-                    prefix_len, prefix_lo):
+                    prefix_len, prefix_lo, all_logits: bool = False):
         """Fused BLOCK forward of ``[B, U]`` tokens at cache slots
         ``[pos0, pos0+U)`` against an existing cache — the multi-token
         generalization of :meth:`decode_step` (one weight pass over
@@ -291,7 +291,9 @@ class GptLM:
         causal part of their own block, under the same
         prefix-region/pad-hole layout as
         :func:`decode_valid_and_shift`. Returns
-        ``(cache, last_logits [B, V])``.
+        ``(cache, last_logits [B, V])`` — or, with ``all_logits=True``
+        (speculative-decoding verification), logits at EVERY block
+        position ``[B, U, V]``.
         """
         cdt = jnp.dtype(self.compute_dtype)
         b, u = token_ids.shape
@@ -317,10 +319,12 @@ class GptLM:
             x = self._block(layer, x, attend)
 
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
-        last = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
+        if not all_logits:
+            x = x[:, -1]
+        logits = x.astype(jnp.float32) @ params["wte"].T.astype(
             jnp.float32
         )
-        return new_cache, last
+        return new_cache, logits
 
     def generate(
         self,

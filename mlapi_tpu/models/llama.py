@@ -316,7 +316,7 @@ class LlamaLM:
         return logits, new_cache
 
     def extend_core(self, params, cache, token_ids, pos0, n_pad,
-                    prefix_len, prefix_lo):
+                    prefix_len, prefix_lo, all_logits: bool = False):
         """Fused block forward against an existing cache — same
         contract as ``GptLM.extend_core`` (rotary positions per row,
         GQA kv broadcast via the shared ``cached_attend``)."""
@@ -346,10 +346,12 @@ class LlamaLM:
             x = self._block(layer, x, posq, attend)
 
         x = _rms_norm(x, params["rms_f_scale"])
-        last = x[:, -1].astype(jnp.float32) @ params["lm_head"].astype(
+        if not all_logits:
+            x = x[:, -1]
+        logits = x.astype(jnp.float32) @ params["lm_head"].astype(
             jnp.float32
         )
-        return new_cache, last
+        return new_cache, logits
 
     def generate(self, params, prompt_ids, **kwargs):
         """Same surface as ``GptLM.generate`` (the whole prefill +

@@ -56,10 +56,10 @@ class QuantizedModel:
         )
 
     def extend_core(self, params, cache, token_ids, pos0, n_pad,
-                    prefix_len, prefix_lo):
+                    prefix_len, prefix_lo, all_logits: bool = False):
         return self.inner.extend_core(
             self._deq(params), cache, token_ids, pos0, n_pad,
-            prefix_len, prefix_lo,
+            prefix_len, prefix_lo, all_logits,
         )
 
     def generate(self, params, prompt_ids, **kwargs):

@@ -1,0 +1,228 @@
+"""Speculative decoding: a small DRAFT model proposes k tokens, the
+TARGET model verifies them all in ONE block forward.
+
+Decode on TPU is one full target-weight read per token; verification
+reads the target weights once per ROUND of up to k+1 tokens, so with
+an in-domain draft the target's HBM bill drops by the mean accepted
+length. Greedy-exact: the emitted stream is byte-identical to plain
+target-only greedy decoding (accepted drafts ARE the target's argmax;
+the round's last token is the target's own argmax after them) — the
+guarantee the tests pin, including with draft == target where every
+round must accept the full k+1.
+
+TPU-first mechanics worth noting:
+
+- **Rollback is free.** Rejected draft positions leave stale K/V in
+  the target cache, but attention masks ``idx <= pos`` and the next
+  round overwrites them — no copies, no cache surgery, static shapes
+  throughout.
+- The verify block is ``extend_core(all_logits=True)`` — one fused
+  program per (k+1) width, position-offset traced, so a generation
+  compiles exactly three programs (target prefill, verify block,
+  draft step) regardless of length.
+- The draft runs single-token steps through the same
+  ``decode_chunk_fn`` program the serving engine uses.
+
+Batch-1 only: per-row acceptance lengths desynchronize cache
+positions across rows, which the scalar-``pos`` decode layout cannot
+express — batched serving gets its parallelism from continuous
+batching instead; speculation is the SINGLE-STREAM latency lever.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SpecStats:
+    rounds: int = 0
+    drafted: int = 0
+    accepted: int = 0
+    emitted: int = 0
+    fallback_steps: int = 0  # first-draft mismatch → plain decode step
+    per_round: list = field(default_factory=list)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.emitted / self.rounds if self.rounds else 0.0
+
+
+def _prefill(model, params, prompt_ids, total):
+    from mlapi_tpu.models.gpt import prefill_fn
+
+    b, _ = prompt_ids.shape
+    zero_key = jnp.asarray(
+        np.asarray(jax.random.key_data(jax.random.key(0)))[None]
+    )
+    first, cache = prefill_fn(model, total)(
+        params, prompt_ids, zero_key,
+        jnp.zeros((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
+    )
+    return int(np.asarray(first)[0]), cache
+
+
+def _step(model, params, cache, tok, pos):
+    """One greedy decode step; returns (next_tok, cache)."""
+    from mlapi_tpu.models.gpt import decode_chunk_fn
+
+    zero_key = jnp.asarray(
+        np.asarray(jax.random.key_data(jax.random.key(0)))[None]
+    )
+    toks, cache, _ = decode_chunk_fn(model, 1)(
+        params, cache, jnp.asarray(np.asarray([tok], np.int32)),
+        jnp.int32(pos), jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.float32), zero_key, jnp.int32(0),
+        jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.float32),
+        jnp.int32(0), jnp.int32(0),
+    )
+    return int(np.asarray(toks)[0, 0]), cache
+
+
+@functools.lru_cache(maxsize=32)
+def _verify_fn(model, width: int):
+    """Jitted verify block: greedy argmax at every position of a
+    ``[1, width]`` token block extended onto the target cache at a
+    traced offset."""
+
+    def _run(params, cache, block, pos0):
+        cache, logits = model.extend_core(
+            params, cache, block, pos0, jnp.zeros((1,), jnp.int32),
+            jnp.int32(0), jnp.int32(0), all_logits=True,
+        )
+        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return jax.jit(_run, donate_argnums=(1,))
+
+
+def speculative_generate(
+    target,
+    t_params,
+    draft,
+    d_params,
+    prompt_ids,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+) -> tuple[list[int], SpecStats]:
+    """Greedy speculative generation for ONE prompt row.
+
+    ``prompt_ids``: ``[1, P]`` int32 (no padding — callers bucket
+    upstream if they care about compile reuse). Returns
+    ``(token_ids, stats)``; ``token_ids`` equals plain target greedy
+    decoding exactly.
+    """
+    b, p = prompt_ids.shape
+    if b != 1:
+        raise ValueError("speculative decoding is single-row (batch=1)")
+    if target.vocab_size != draft.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    n = int(max_new_tokens)
+    if p + n > target.max_positions or p + n > draft.max_positions:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({n}) exceeds a model window"
+        )
+    k = max(1, min(int(k), n))
+    # Room for a full round's block (t0 + k drafts) past the last
+    # needed position keeps every verify the same width.
+    total_t = min(target.max_positions, p + n + k + 1)
+    total_d = min(draft.max_positions, p + n + k + 1)
+
+    stats = SpecStats()
+    prompt_ids = jnp.asarray(prompt_ids)
+    t0, t_cache = _prefill(target, t_params, prompt_ids, total_t)
+    _, d_cache = _prefill(draft, d_params, prompt_ids, total_d)
+
+    out: list[int] = [t0]
+    # Per-model bookkeeping: `upto` = cache slots holding VALID
+    # accepted content; `pend` = accepted tokens not yet written to
+    # that model's cache (their slots start at `upto`). The target's
+    # pend is always one token (the round's bonus); the draft's can be
+    # two after a fully-accepted round (its k-th proposal was never
+    # fed back to it).
+    t_upto, t_pend = p, [t0]
+    d_upto, d_pend = p, [t0]
+
+    while len(out) < n:
+        budget = n - len(out)
+        room = (
+            t_upto + 1 + k + 1 <= total_t
+            and d_upto + len(d_pend) + k <= total_d
+        )
+        if budget == 1 or not room:
+            # One plain target step. The draft is NOT consulted again
+            # once fallback starts (budget exhaustion and the room
+            # inequalities are both monotone under growing caches and
+            # pending lists), so syncing its cache here would be pure
+            # waste — accumulate its pending tokens instead, which
+            # keeps the consume loop correct in the impossible-return
+            # case and costs nothing.
+            nxt, t_cache = _step(target, t_params, t_cache,
+                                 t_pend[0], t_upto)
+            t_upto += 1
+            d_pend.append(nxt)
+            t_pend = [nxt]
+            out.append(nxt)
+            stats.fallback_steps += 1
+            continue
+
+        # Draft phase: consume the pending accepted tokens (the last
+        # consume's greedy output is the first proposal), then chain
+        # k-1 more proposals.
+        for tok in d_pend:
+            d_tok, d_cache = _step(draft, d_params, d_cache, tok, d_upto)
+            d_upto += 1
+        proposals = [d_tok]
+        while len(proposals) < k:
+            d_tok, d_cache = _step(draft, d_params, d_cache, d_tok, d_upto)
+            d_upto += 1
+            proposals.append(d_tok)
+        # d_upto now covers t0 + proposals[:-1]; proposals[-1] was
+        # proposed but never fed back (its slot is unwritten).
+
+        # Verify [t0, d1..dk] in ONE target block: argmax at position
+        # i is the target's next token AFTER t0, d1..di.
+        block = np.asarray([[t_pend[0], *proposals]], np.int32)
+        t_cache, expect = _verify_fn(target, k + 1)(
+            t_params, t_cache, jnp.asarray(block), jnp.int32(t_upto),
+        )
+        expect = np.asarray(expect)[0]  # [k+1]
+        # Only `usable` proposals can be emitted this round (the
+        # bonus token takes the last budget slot); drafts beyond it
+        # are neither accepted nor rejected — they don't count.
+        usable = min(k, budget - 1)
+        m = 0
+        while m < usable and proposals[m] == int(expect[m]):
+            m += 1
+        bonus = int(expect[m])
+        out.extend(proposals[:m])
+        out.append(bonus)
+        stats.rounds += 1
+        stats.drafted += usable
+        stats.accepted += m
+        stats.emitted += m + 1
+        stats.per_round.append(m + 1)
+
+        t_upto += m + 1  # t0 + m accepted drafts are valid content
+        t_pend = [bonus]
+        if m == k:
+            # Draft never cached its own k-th proposal: it is pending
+            # alongside the bonus (consecutive slots from d_upto).
+            d_pend = [proposals[-1], bonus]
+        else:
+            # Rewind over the draft's stale rejected tail; future
+            # writes overwrite it and `pos <= upto` masks it until
+            # then.
+            d_upto = t_upto
+            d_pend = [bonus]
+    return out[:n], stats

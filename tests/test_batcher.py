@@ -32,14 +32,27 @@ class FakeEngine:
 
 async def test_coalesces_to_ceil_n_over_b():
     engine = FakeEngine()
-    batcher = MicroBatcher(engine, max_batch=16, max_wait_ms=5.0)
+    # max_inflight=1: the plug batch holds the ONLY dispatch slot, and
+    # slot-first collection means the collector cannot form another
+    # batch until the gate opens — the 48 submits all accumulate in
+    # the queue first, making the coalescing count deterministic even
+    # on a heavily loaded host (this test used to flake under CPU
+    # contention when collection raced the submits).
+    batcher = MicroBatcher(
+        engine, max_batch=16, max_wait_ms=5.0, max_inflight=1
+    )
     await batcher.start()
     try:
         # Plug the dispatch thread so every subsequent submit queues up
         # behind one in-flight batch — deterministic coalescing.
         engine.gate.clear()
         plug = asyncio.create_task(batcher.submit(np.zeros(4)))
-        await asyncio.sleep(0.05)  # plug batch is now in the executor
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while batcher.device_calls < 1:  # plug batch is in the executor
+            assert asyncio.get_running_loop().time() < deadline, (
+                "plug batch never reached the executor"
+            )
+            await asyncio.sleep(0.01)
 
         n = 48
         tasks = [

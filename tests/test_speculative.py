@@ -1,0 +1,155 @@
+"""Speculative decoding (`ops/speculative.py`): greedy-exact stream,
+full acceptance with draft == target, cache bookkeeping across
+fully-accepted rounds (the draft's unfed k-th proposal), and the
+budget/window fallbacks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.speculative import speculative_generate
+from mlapi_tpu.text import ByteTokenizer
+
+T_CFG = dict(
+    vocab_size=260, hidden_size=48, num_layers=3, num_heads=4,
+    max_positions=160, compute_dtype="float32",
+)
+D_CFG = dict(
+    vocab_size=260, hidden_size=24, num_layers=1, num_heads=2,
+    max_positions=160, compute_dtype="float32",
+)
+
+
+def _greedy_ref(model, params, prompt, n):
+    return np.asarray(
+        model.generate(params, jnp.asarray(prompt), max_new_tokens=n)
+    )[0].tolist()
+
+
+def _train_repeater(model, seed=0):
+    tok = ByteTokenizer()
+    pattern = np.asarray(tok.token_ids("abcab" * 12), np.int32)
+    seqs = np.tile(pattern, (128, 1))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+    params = model.init(jax.random.key(seed))
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for _ in range(120):
+        params, opt, _ = step(params, opt)
+    return params
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_stream_equals_plain_greedy_random_models(k):
+    """Exactness holds regardless of draft quality: random draft +
+    random target — every emitted token is the target's greedy
+    choice."""
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompt = np.arange(9, dtype=np.int32)[None] % 200 + 3
+    ref = _greedy_ref(target, tp, prompt, 24)
+    got, stats = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=24, k=k,
+    )
+    assert got == ref, (k, stats)
+    assert stats.emitted + stats.fallback_steps + 1 == 24
+
+
+def test_draft_equals_target_accepts_everything():
+    """With draft == target every proposal matches: acceptance is
+    100% and every full round emits k+1 tokens — also exercises the
+    fully-accepted round's draft bookkeeping (the unfed k-th
+    proposal)."""
+    target = get_model("gpt_lm", **T_CFG)
+    tp = target.init(jax.random.key(0))
+    prompt = np.arange(7, dtype=np.int32)[None] % 150 + 5
+    ref = _greedy_ref(target, tp, prompt, 25)
+    got, stats = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=25, k=3,
+    )
+    assert got == ref
+    assert stats.acceptance_rate == 1.0, stats
+    assert stats.tokens_per_round == 4.0  # k+1 every round
+
+
+def test_trained_draft_accepts_on_domain():
+    """A small draft trained on the same pattern as the target
+    accepts a meaningful fraction — the speedup story, measured."""
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = _train_repeater(target)
+    dp = _train_repeater(draft, seed=3)
+    tok = ByteTokenizer()
+    prompt = np.asarray(tok.token_ids("abcababcab"), np.int32)[None]
+    ref = _greedy_ref(target, tp, prompt, 30)
+    got, stats = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=30, k=4,
+    )
+    assert got == ref
+    assert stats.acceptance_rate > 0.5, (
+        f"in-domain draft only accepted {stats.acceptance_rate:.2f}"
+    )
+
+
+def test_llama_family_supported():
+    cfg = dict(T_CFG, hidden_size=32, num_layers=2)
+    cfg.pop("num_heads")
+    target = get_model("llama_lm", **cfg, num_heads=4, num_kv_heads=2)
+    tp = target.init(jax.random.key(0))
+    prompt = np.arange(6, dtype=np.int32)[None] % 120 + 3
+    ref = _greedy_ref(target, tp, prompt, 12)
+    got, stats = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=12, k=2,
+    )
+    assert got == ref
+    assert stats.acceptance_rate == 1.0
+
+
+def test_window_edge_falls_back_to_plain_steps():
+    """Near the model window there is no room for a k+1 block: the
+    loop degrades to plain steps and still emits the exact stream."""
+    cfg = dict(T_CFG, max_positions=48)
+    target = get_model("gpt_lm", **cfg)
+    tp = target.init(jax.random.key(0))
+    prompt = np.arange(8, dtype=np.int32)[None] % 100 + 3
+    n = 40  # prompt + n == max_positions: the tail has no block room
+    ref = _greedy_ref(target, tp, prompt, n)
+    got, stats = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=n, k=4,
+    )
+    assert got == ref
+    assert stats.fallback_steps > 0
+
+
+def test_batch_and_vocab_validation():
+    target = get_model("gpt_lm", **T_CFG)
+    tp = target.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="single-row"):
+        speculative_generate(
+            target, tp, target, tp,
+            np.zeros((2, 4), np.int32), max_new_tokens=4,
+        )
+    other = get_model("gpt_lm", **dict(D_CFG, vocab_size=128))
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(
+            target, tp, other, other.init(jax.random.key(1)),
+            np.zeros((1, 4), np.int32), max_new_tokens=4,
+        )
