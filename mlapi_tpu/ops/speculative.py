@@ -57,15 +57,21 @@ class SpecStats:
         return self.emitted / self.rounds if self.rounds else 0.0
 
 
+@functools.cache
+def _zero_key():
+    """Greedy decoding never consumes randomness; one shared dummy
+    key avoids rebuilding it in the per-token hot loop."""
+    return jnp.asarray(
+        np.asarray(jax.random.key_data(jax.random.key(0)))[None]
+    )
+
+
 def _prefill(model, params, prompt_ids, total):
     from mlapi_tpu.models.gpt import prefill_fn
 
     b, _ = prompt_ids.shape
-    zero_key = jnp.asarray(
-        np.asarray(jax.random.key_data(jax.random.key(0)))[None]
-    )
     first, cache = prefill_fn(model, total)(
-        params, prompt_ids, zero_key,
+        params, prompt_ids, _zero_key(),
         jnp.zeros((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
         jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
     )
@@ -76,13 +82,10 @@ def _step(model, params, cache, tok, pos):
     """One greedy decode step; returns (next_tok, cache)."""
     from mlapi_tpu.models.gpt import decode_chunk_fn
 
-    zero_key = jnp.asarray(
-        np.asarray(jax.random.key_data(jax.random.key(0)))[None]
-    )
     toks, cache, _ = decode_chunk_fn(model, 1)(
         params, cache, jnp.asarray(np.asarray([tok], np.int32)),
         jnp.int32(pos), jnp.zeros((1,), jnp.int32),
-        jnp.zeros((1,), jnp.float32), zero_key, jnp.int32(0),
+        jnp.zeros((1,), jnp.float32), _zero_key(), jnp.int32(0),
         jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.float32),
         jnp.int32(0), jnp.int32(0),
     )

@@ -233,6 +233,54 @@ def test_hit_path_does_not_retokenize_prefix():
     assert LONG_P not in calls, "hit path re-tokenized the prefix"
 
 
+async def test_mixed_traffic_soak_every_stream_exact():
+    """Plain, prefix-cached, and chunked-long-prompt requests
+    staggered together: the collector must group compatibly (prefix
+    batches never mix), admission must defer cross-layout joiners,
+    and EVERY stream must equal its solo run — the strongest
+    whole-engine interleaving check."""
+    import asyncio
+
+    cfg = dict(CFG, max_positions=320)
+    model = get_model("gpt_lm", **cfg)
+    eng = TextGenerationEngine(
+        model, model.init(jax.random.key(0)),
+        tokenizer=ByteTokenizer(), chunk=4, max_batch=4,
+        prompt_buckets=(16, 64, 128),
+    )
+    rng = np.random.default_rng(7)
+    cases = []
+    for i in range(9):
+        kind = i % 3
+        temp = float(rng.choice([0.0, 0.8]))
+        n = int(rng.integers(3, 16))
+        if kind == 0:
+            cases.append(dict(text="ab" * int(rng.integers(1, 9)),
+                              max_new_tokens=n, temperature=temp,
+                              seed=i))
+        elif kind == 1:
+            cases.append(dict(text="q" * int(rng.integers(2, 7)),
+                              prefix=LONG_P, max_new_tokens=n,
+                              temperature=temp, seed=i))
+        else:
+            cases.append(dict(text="xyz" * 55,  # 165 toks → chunked
+                              max_new_tokens=n, temperature=temp,
+                              seed=i))
+    solos = [eng.generate_text(**c)["token_ids"] for c in cases]
+    await eng.start()
+    try:
+        gens = []
+        for c in cases:
+            gens.append(await eng.submit(**c))
+            await asyncio.sleep(float(rng.uniform(0, 0.03)))
+        outs = [await _collect(g) for g in gens]
+        assert outs == solos
+        assert eng.prefill_chunks > 0  # the long prompts really chunked
+        assert eng.prefix_misses == 1  # one shared prefix entry
+    finally:
+        await eng.stop()
+
+
 def test_oversized_suffix_on_kv_path_refused():
     """On the KV path the plain path's silent left-truncation would
     drop SUFFIX tokens while keeping the whole prefix — different
