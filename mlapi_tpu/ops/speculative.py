@@ -93,14 +93,16 @@ def _step(model, params, cache, tok, pos):
 
 
 @functools.lru_cache(maxsize=32)
-def _verify_fn(model, width: int):
+def verify_fn(model, width: int):
     """Jitted verify block: greedy argmax at every position of a
-    ``[1, width]`` token block extended onto the target cache at a
-    traced offset."""
+    ``[B, width]`` token block extended onto the target cache at a
+    traced offset, honoring per-row left-pad masks (``n_pad``) so the
+    serving engine's bucketed rows verify identically to unpadded
+    library rows."""
 
-    def _run(params, cache, block, pos0):
+    def _run(params, cache, block, pos0, n_pad):
         cache, logits = model.extend_core(
-            params, cache, block, pos0, jnp.zeros((1,), jnp.int32),
+            params, cache, block, pos0, n_pad,
             jnp.int32(0), jnp.int32(0), all_logits=True,
         )
         return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -196,8 +198,9 @@ def speculative_generate(
         # Verify [t0, d1..dk] in ONE target block: argmax at position
         # i is the target's next token AFTER t0, d1..di.
         block = np.asarray([[t_pend[0], *proposals]], np.int32)
-        t_cache, expect = _verify_fn(target, k + 1)(
+        t_cache, expect = verify_fn(target, k + 1)(
             t_params, t_cache, jnp.asarray(block), jnp.int32(t_upto),
+            jnp.zeros((1,), jnp.int32),
         )
         expect = np.asarray(expect)[0]  # [k+1]
         # Only `usable` proposals can be emitted this round (the

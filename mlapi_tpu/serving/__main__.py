@@ -244,6 +244,12 @@ def main(argv=None) -> None:
              "(single-chip serving only)",
     )
     parser.add_argument(
+        "--draft-checkpoint", default=None,
+        help="speculative decoding: a smaller same-tokenizer "
+             "checkpoint whose proposals the target verifies in one "
+             "block — speeds up single-stream greedy generation",
+    )
+    parser.add_argument(
         "--profiler-port", type=int, default=0,
         help="start a jax.profiler server on this port (XProf/TensorBoard "
              "can attach live)",
@@ -283,7 +289,10 @@ def main(argv=None) -> None:
                          "(every worker binds the same one)")
         sys.exit(_supervise_workers(args.workers, ckpt, args))
 
-    engine = InferenceEngine.from_checkpoint(ckpt, quantize=args.quantize)
+    engine = InferenceEngine.from_checkpoint(
+        ckpt, quantize=args.quantize,
+        draft_checkpoint=args.draft_checkpoint,
+    )
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
     server = Server(app, host=args.host, port=args.port,
                     reuse_port=is_worker)

@@ -590,6 +590,13 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.prefill_chunks"] = (
                 engine.prefill_chunks
             )
+            snap["counters"]["generate.spec_rounds"] = engine.spec_rounds
+            snap["counters"]["generate.spec_drafted"] = (
+                engine.spec_drafted
+            )
+            snap["counters"]["generate.spec_accepted"] = (
+                engine.spec_accepted
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

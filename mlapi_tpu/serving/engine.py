@@ -109,6 +109,7 @@ class InferenceEngine:
         mesh: jax.sharding.Mesh | None = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         quantize: str | None = None,
+        draft_checkpoint=None,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -178,13 +179,38 @@ class InferenceEngine:
                 if "tokenizer" in meta.config
                 else load_tokenizer(model.vocab_size)
             )
+            draft = None
+            if draft_checkpoint is not None:
+                dmeta = _load_meta_only(draft_checkpoint)
+                if dmeta.config.get("tokenizer") != meta.config.get(
+                    "tokenizer"
+                ):
+                    raise ValueError(
+                        "draft checkpoint was trained with a different "
+                        "tokenizer than the target"
+                    )
+                dmodel = get_model(
+                    dmeta.config["model"],
+                    **dmeta.config.get("model_kwargs", {}),
+                )
+                dabstract = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    jax.eval_shape(
+                        lambda: dmodel.init(jax.random.key(0))
+                    ),
+                )
+                dparams, _ = load_checkpoint(draft_checkpoint, dabstract)
+                draft = (dmodel, dparams)
             return TextGenerationEngine(
                 model,
                 params,
                 tokenizer=tokenizer,
                 mesh=mesh,
+                draft=draft,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
-                      **({"quantized": quantize} if quantize else {})},
+                      **({"quantized": quantize} if quantize else {}),
+                      **({"draft": str(draft_checkpoint)}
+                         if draft_checkpoint else {})},
             )
 
         if meta.vocab is None:
@@ -492,12 +518,35 @@ class TextGenerationEngine:
         chunk: int | None = None,
         max_wait_ms: float = 2.0,
         max_queue: int = 256,
+        draft: tuple | None = None,
+        spec_k: int = 4,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
                 f"tokenizer emits ids up to {tokenizer.vocab_size - 1} but "
                 f"the model's embedding table has {model.vocab_size} rows"
             )
+        # Speculative decoding: (draft_model, draft_params). Used only
+        # while the live batch is a single greedy row — the
+        # single-stream latency lever; batched throughput stays
+        # continuous batching's job.
+        if draft is not None:
+            d_model, d_params = draft
+            if d_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocabulary"
+                )
+            if d_model.max_positions < model.max_positions:
+                raise ValueError(
+                    f"draft window ({d_model.max_positions}) must cover "
+                    f"the target's ({model.max_positions})"
+                )
+            self.draft_model = d_model
+            self.draft_params = jax.device_put(d_params)
+        else:
+            self.draft_model = None
+            self.draft_params = None
+        self.spec_k = max(1, int(spec_k))
         self.model = model
         self.tokenizer = tokenizer
         self.mesh = mesh
@@ -583,6 +632,10 @@ class TextGenerationEngine:
         self.prefix_misses = 0
         self.prefix_fallbacks = 0
         self.prefill_chunks = 0
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._warmed_spec: set = set()
         # Batch-resize (compaction) shapes proven compiled — in
         # strict non-eager mode a resize outside this set is skipped
         # (decode stays at full width) rather than compiled mid-batch.
@@ -932,7 +985,9 @@ class TextGenerationEngine:
                     jnp.asarray(temps), jnp.asarray(n_pad),
                     jnp.asarray(topk), jnp.asarray(topp),
                 )
-            tok = np.asarray(first)
+            # np.array (copy): the spec phase mutates tok[0] in place,
+            # and np.asarray of a device array is a read-only view.
+            tok = np.array(first)
             # step[row]: the row's NEXT sampling-stream index — its own
             # produced-token count, NOT a batch-global counter, so a
             # row admitted later still reproduces its solo stream.
@@ -985,6 +1040,38 @@ class TextGenerationEngine:
                         self._admit.remove(cand)
                     except ValueError:
                         pass
+
+            # Speculative decoding applies while this batch is one
+            # greedy row: the draft proposes spec_k tokens per round
+            # and the target verifies them in ONE block forward —
+            # fewer target weight passes per emitted token. The spec
+            # phase hands off to the normal chunk loop (which resumes
+            # from any (cache, pos, tok) state) the moment an
+            # admission candidate arrives, and RE-engages for the
+            # tail once transient joiners depart (spec_hist tracks
+            # the row's emitted tokens for the draft-cache replay).
+            spec_hist: list | None = None
+            if (
+                self.draft_model is not None
+                and b == 1 and p_len == 0
+                and not reqs[0].cancelled
+                and temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0
+            ):
+                spec_hist = [int(tok[0])]
+
+            def try_spec():
+                nonlocal cache, pos
+                if spec_hist is None or done[0] or reqs[0].cancelled:
+                    return
+                cache, pos = self._spec_phase(
+                    reqs[0], cache, pos, total, bucket, tok, step,
+                    produced, n_pad, keys, spec_hist,
+                )
+                if produced[0] >= reqs[0].n_new:
+                    reqs[0].push(None)
+                    done[0] = True
+
+            try_spec()
 
             while True:
                 pending_n = 0
@@ -1141,6 +1228,19 @@ class TextGenerationEngine:
                     if not all(done):
                         self.cancelled_batches += 1
                     break
+                # Re-engage speculation once the batch is a single
+                # greedy row again (transient joiners departed): the
+                # spec phase replays the row's history into a fresh
+                # draft cache and resumes rounds for the tail. Its
+                # cheap disqualifiers make this retry free when
+                # speculation cannot currently help.
+                if (
+                    spec_hist is not None and b_cur == 1
+                    and live == [0] and not pending_n
+                ):
+                    try_spec()
+                    if done[0]:
+                        continue
                 # The final chunk may be remainder-sized: when
                 # max_positions clamps the cache tier, (total -
                 # bucket) need not be a chunk multiple, and a
@@ -1200,11 +1300,10 @@ class TextGenerationEngine:
                         continue
                     want = r.n_new - produced[i]
                     if want > 0:
-                        r.push(
-                            {"token_ids":
-                                 toks_host[rows[i], : min(want, got)]
-                                 .tolist()}
-                        )
+                        chunk_ids = toks_host[rows[i], : min(want, got)]
+                        r.push({"token_ids": chunk_ids.tolist()})
+                        if spec_hist is not None and i == 0:
+                            spec_hist.extend(chunk_ids.tolist())
                         produced[i] += got
                         if want <= got:
                             r.push(None)
@@ -1233,6 +1332,130 @@ class TextGenerationEngine:
                     r.push(e)
                 except Exception:  # a dead loop must not mask others
                     pass
+
+    def _spec_phase(self, r, cache, pos, total, bucket, tok, step,
+                    produced, n_pad, keys, history):
+        """Run speculative rounds for a single greedy request against
+        the engine's live target cache; returns ``(cache, pos)`` for
+        the normal decode loop to resume from. Mutates the host
+        mirrors (``tok``, ``step``, ``produced``) in place — the
+        handoff contract with ``_run_batch``. Library twin:
+        ``ops/speculative.speculative_generate`` (same round algebra,
+        pinned byte-exact there); this variant adds the engine's
+        per-row pad mask, streaming pushes, admission handoff, and
+        RE-ENGAGEMENT: ``history`` (the row's emitted tokens so far)
+        replays into a fresh draft cache through already-compiled
+        chunk programs, so a stream whose transient joiners departed
+        speculates again for its tail."""
+        from mlapi_tpu.models.gpt import (
+            decode_chunk_fn, extend_chunk_fn, prefill_fn,
+        )
+        from mlapi_tpu.ops.speculative import verify_fn
+
+        k = self.spec_k
+        # The draft prefill/replay are EXPENSIVE compiles: strict mode
+        # requires them pre-warmed regardless of attach RTT (same rule
+        # as the admission joiner prefill).
+        if self._strict_admit and (bucket, total) not in self._warmed_spec:
+            return cache, pos
+        # Cheap disqualifiers BEFORE any device work: nothing to
+        # speculate, no block room, or joiners already waiting.
+        if r.n_new - produced[0] <= 1 or pos + 1 + k + 1 > total:
+            return cache, pos
+        with self._alock:
+            if self._admit:
+                return cache, pos
+
+        npj = jnp.asarray(n_pad)
+        zt = jnp.zeros((1,), jnp.float32)
+        z0 = jnp.zeros((1,), jnp.int32)
+        o1 = jnp.ones((1,), jnp.float32)
+        keys_j = jnp.asarray(keys)
+
+        # Draft prefill over the SAME padded prompt row (its KV layout
+        # mirrors the target's, pads masked identically) ...
+        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        row[0, bucket - len(r.row):] = r.row
+        _, d_cache = prefill_fn(self.draft_model, total)(
+            self.draft_params, jnp.asarray(row), keys_j, zt, npj, z0, o1,
+        )
+        # ... then replay the already-emitted tokens (all but the
+        # unconsumed last, which seeds the first round) in
+        # fixed-width chunks plus single-step remainder — every
+        # program already compiled for this (bucket, total).
+        replay = history[:-1]
+        d_replay_upto = bucket
+        ri = 0
+        while len(replay) - ri >= self.chunk:
+            blk = np.asarray([replay[ri:ri + self.chunk]], np.int32)
+            d_cache, _ = extend_chunk_fn(
+                self.draft_model, self.chunk, total
+            )(
+                self.draft_params, d_cache, jnp.asarray(blk),
+                jnp.int32(d_replay_upto), npj,
+            )
+            d_replay_upto += self.chunk
+            ri += self.chunk
+        self._warmed_spec.add((bucket, total))
+
+        def dstep(dcache, token, at):
+            toks, dcache, _ = decode_chunk_fn(self.draft_model, 1)(
+                self.draft_params, dcache,
+                jnp.asarray(np.asarray([token], np.int32)),
+                jnp.int32(at), npj, zt, keys_j, jnp.int32(0), z0, o1,
+                jnp.int32(0), jnp.int32(0),
+            )
+            return int(np.asarray(toks)[0, 0]), dcache
+
+        while ri < len(replay):  # sub-chunk replay remainder
+            _, d_cache = dstep(d_cache, replay[ri], d_replay_upto)
+            d_replay_upto += 1
+            ri += 1
+
+        d_upto = t_upto = pos
+        d_pend = [int(tok[0])]
+        while not r.cancelled and produced[0] < r.n_new:
+            with self._alock:
+                if self._admit:
+                    break  # joiners waiting: normal loop admits them
+            budget = r.n_new - produced[0]
+            if budget <= 1 or t_upto + 1 + k + 1 > total:
+                break
+            for t_tok in d_pend:
+                d_tok, d_cache = dstep(d_cache, t_tok, d_upto)
+                d_upto += 1
+            proposals = [d_tok]
+            while len(proposals) < k:
+                d_tok, d_cache = dstep(d_cache, d_tok, d_upto)
+                d_upto += 1
+                proposals.append(d_tok)
+            block = np.asarray([[int(tok[0]), *proposals]], np.int32)
+            cache, expect = verify_fn(self.model, k + 1)(
+                self.params, cache, jnp.asarray(block),
+                jnp.int32(t_upto), npj,
+            )
+            expect = np.asarray(expect)[0]
+            usable = min(k, budget - 1)
+            m = 0
+            while m < usable and proposals[m] == int(expect[m]):
+                m += 1
+            bonus = int(expect[m])
+            emitted = [*proposals[:m], bonus]
+            r.push({"token_ids": emitted})
+            history.extend(emitted)  # keeps replay state current
+            produced[0] += m + 1
+            step[0] = produced[0]
+            t_upto += m + 1
+            tok[0] = bonus
+            self.spec_rounds += 1
+            self.spec_drafted += usable
+            self.spec_accepted += m
+            if m == k:
+                d_pend = [proposals[-1], bonus]
+            else:
+                d_upto = t_upto
+                d_pend = [bonus]
+        return cache, t_upto
 
     # -- asyncio batcher ---------------------------------------------------
     async def start(self) -> None:
@@ -1548,6 +1771,8 @@ class TextGenerationEngine:
                 shapes += 1
         if full:
             shapes += self._warm_admission(batches)
+            if self.draft_model is not None:
+                shapes += self._warm_spec()
             # From here on, a joiner is only admitted into a RUNNING
             # batch when its admission program is already compiled —
             # an unwarmed shape waits for the next batch instead of
@@ -1558,6 +1783,55 @@ class TextGenerationEngine:
             "chunk=%d",
             shapes, self.chunk,
         )
+
+    def _warm_spec(self) -> int:
+        """Compile the speculative-phase programs (draft prefill,
+        draft step, verify block) for every prompt bucket at the
+        default cache tier, off the request path."""
+        from mlapi_tpu.models.gpt import (
+            decode_chunk_fn, extend_chunk_fn, prefill_fn,
+        )
+        from mlapi_tpu.ops.speculative import verify_fn
+
+        shapes = 0
+        zt = jnp.zeros((1,), jnp.float32)
+        z0 = jnp.zeros((1,), jnp.int32)
+        o1 = jnp.ones((1,), jnp.float32)
+        key1 = jnp.asarray(self._key_data(0)[None])
+        for bucket in self.prompt_buckets:
+            total = self._cache_len(bucket, self.default_max_new_tokens)
+            if bucket + 1 + self.spec_k + 1 > total:
+                continue
+            row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            npj = jnp.asarray(np.asarray([bucket - 1], np.int32))
+            _, d_cache = prefill_fn(self.draft_model, total)(
+                self.draft_params, jnp.asarray(row), key1, zt, npj,
+                z0, o1,
+            )
+            _, d_cache, _ = decode_chunk_fn(self.draft_model, 1)(
+                self.draft_params, d_cache, jnp.asarray(
+                    np.zeros((1,), np.int32)
+                ),
+                jnp.int32(bucket), npj, zt, key1, jnp.int32(0), z0, o1,
+                jnp.int32(0), jnp.int32(0),
+            )
+            block = np.zeros((1, self.spec_k + 1), np.int32)
+            verify_fn(self.model, self.spec_k + 1)(
+                self.params, self.model.init_cache(1, total),
+                jnp.asarray(block), jnp.int32(bucket), npj,
+            )
+            if bucket + self.chunk <= total:
+                # Re-engagement replays history in chunk-wide blocks.
+                extend_chunk_fn(self.draft_model, self.chunk, total)(
+                    self.draft_params, d_cache,
+                    jnp.asarray(
+                        np.zeros((1, self.chunk), np.int32)
+                    ),
+                    jnp.int32(bucket), npj,
+                )
+            self._warmed_spec.add((bucket, total))
+            shapes += 1
+        return shapes
 
     def _warm_admission(self, batches: list) -> int:
         """Compile the continuous-batching admission programs off the

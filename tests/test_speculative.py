@@ -139,6 +139,85 @@ def test_window_edge_falls_back_to_plain_steps():
     assert stats.fallback_steps > 0
 
 
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _engines():
+    from mlapi_tpu.serving.engine import TextGenerationEngine
+
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    tok = ByteTokenizer()
+    plain = TextGenerationEngine(target, tp, tokenizer=tok, chunk=4)
+    spec = TextGenerationEngine(
+        target, tp, tokenizer=tok, chunk=4, draft=(draft, dp), spec_k=3,
+    )
+    return plain, spec
+
+
+def test_engine_spec_stream_matches_plain_engine():
+    """--draft-checkpoint serving: a single greedy request decodes
+    through speculative rounds and emits exactly what the draft-less
+    engine emits; sampled requests bypass speculation entirely."""
+    plain, spec = _engines()
+    ref = plain.generate_text("abcabcab", max_new_tokens=24)
+    got = spec.generate_text("abcabcab", max_new_tokens=24)
+    assert got["token_ids"] == ref["token_ids"]
+    assert spec.spec_rounds > 0, "speculation never engaged"
+
+    base_rounds = spec.spec_rounds
+    s_ref = plain.generate_text("ab", max_new_tokens=8,
+                                temperature=0.8, seed=3)
+    s_got = spec.generate_text("ab", max_new_tokens=8,
+                               temperature=0.8, seed=3)
+    assert s_got["token_ids"] == s_ref["token_ids"]
+    assert spec.spec_rounds == base_rounds, "sampled request speculated"
+
+
+@pytest.mark.anyio
+async def test_engine_spec_hands_off_to_admission():
+    """A joiner arriving mid-speculation is admitted: the spec phase
+    yields at a round boundary and the normal loop takes over — both
+    streams stay exact."""
+    import asyncio
+
+    plain, spec = _engines()
+    ref_a = plain.generate_text("abcabcab", max_new_tokens=40)
+    ref_b = plain.generate_text("xyz", max_new_tokens=6)
+    await spec.start()
+    try:
+        a = await spec.submit("abcabcab", max_new_tokens=40)
+        first = await a.queue.get()
+        b = await spec.submit("xyz", max_new_tokens=6)
+        got_b = []
+        while True:
+            item = await b.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got_b.extend(item["token_ids"])
+        got_a = list(first["token_ids"])
+        while True:
+            item = await a.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got_a.extend(item["token_ids"])
+        assert got_a == ref_a["token_ids"]
+        assert got_b == ref_b["token_ids"]
+        assert spec.admitted >= 1, "joiner was not admitted"
+        # After the joiner finished, the long stream's tail must have
+        # RE-engaged speculation (draft-cache replay), not decoded
+        # token-at-a-time forever.
+        assert spec.spec_rounds >= 2, spec.spec_rounds
+    finally:
+        await spec.stop()
+
+
 def test_batch_and_vocab_validation():
     target = get_model("gpt_lm", **T_CFG)
     tp = target.init(jax.random.key(0))
