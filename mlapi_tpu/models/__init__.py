@@ -44,6 +44,8 @@ from mlapi_tpu.models import gpt as _gpt  # noqa: E402,F401
 from mlapi_tpu.models import llama as _llama  # noqa: E402,F401
 from mlapi_tpu.models.bert import BertClassifier  # noqa: E402,F401
 from mlapi_tpu.models.gpt import GptLM  # noqa: E402,F401
+from mlapi_tpu.models.lora import LoraModel  # noqa: E402,F401
+from mlapi_tpu.models.quantized import QuantizedModel  # noqa: E402,F401
 from mlapi_tpu.models.linear import LinearClassifier  # noqa: E402,F401
 from mlapi_tpu.models.llama import LlamaLM  # noqa: E402,F401
 from mlapi_tpu.models.mlp import MLPClassifier  # noqa: E402,F401
