@@ -246,6 +246,29 @@ register_preset(
     )
 )
 
+# Speculative-decoding draft for docs-gpt: same tokenizer/corpus,
+# ~1/10th the weights. Train both and serve with
+#   python -m mlapi_tpu.serving --checkpoint <docs-gpt ckpt> \
+#       --draft-checkpoint <docs-gpt-draft ckpt>
+register_preset(
+    TrainConfig(
+        name="docs-gpt-draft",
+        model="gpt_lm",
+        model_kwargs={
+            "vocab_size": 260, "hidden_size": 48, "num_layers": 1,
+            "num_heads": 4, "max_positions": 256,
+            "compute_dtype": "float32",
+        },
+        dataset="docs_text",
+        dataset_kwargs={"seq_len": 128},
+        steps=300,
+        batch_size=64,
+        optimizer="adamw",
+        learning_rate=1e-3,
+        eval_every=100,
+    )
+)
+
 register_preset(
     TrainConfig(
         name="docs-llama",
