@@ -219,7 +219,15 @@ def load_checkpoint(
     the restore layout and is validated against the manifest's tree
     signature, so loading the wrong model's checkpoint raises instead
     of mis-predicting.
+
+    Leaves WITHOUT a sharding restore onto the default device
+    explicitly: orbax would otherwise read the sharding recorded at
+    SAVE time, which names devices of the saving topology — a
+    checkpoint trained on the TPU must load on a CPU-attached server
+    (train-on-chip, serve-anywhere), and did not before this pinned
+    the restore layout locally.
     """
+    import jax
     import orbax.checkpoint as ocp
 
     path = Path(path).absolute()
@@ -233,6 +241,25 @@ def load_checkpoint(
                 f"{expect}, checkpoint has {meta.tree_signature} "
                 f"(step {meta.step}, config {meta.config})"
             )
+    else:
+        # No layout given: build one from the checkpoint's own array
+        # metadata (shapes/dtypes) so the topology pin below applies
+        # to this path too — not just to callers that know the tree.
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as mck:
+            im = mck.metadata(path / _PARAMS_DIR).item_metadata
+        abstract_params = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+            im.tree if hasattr(im, "tree") else im,
+        )
+    local = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract_params = jax.tree.map(
+        lambda a: (
+            a
+            if getattr(a, "sharding", None) is not None
+            else jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=local)
+        ),
+        abstract_params,
+    )
 
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(path / _PARAMS_DIR, abstract_params)

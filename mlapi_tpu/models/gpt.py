@@ -532,15 +532,27 @@ def decode_valid_and_shift(max_len, pos, n_pad, prefix_len=None,
     ``s - prefix_lo - n_pad[b]`` (prefix real count + suffix index),
     which likewise reduces to ``s - n_pad[b]``.
     Returns ``(valid [B,1,1,L], shift [B])``.
+
+    ``pos`` may be a traced scalar (all rows at the same slot — the
+    serving decode loop) or a per-row ``[B]`` vector (rows at
+    DESYNCHRONIZED slots — batched speculation, where per-row
+    acceptance lengths advance each row's cache independently).
+    ``prefix_lo`` likewise: scalar for a batch sharing ONE prefix, or
+    per-row ``[B]`` when rows carry DIFFERENT prefixes right-aligned
+    to the common region end ``prefix_len`` (cross-batch prefix
+    sharing — each row's real prefix occupies ``[lo_b, prefix_len)``;
+    ``lo_b == prefix_len`` is an empty region).
     """
     if prefix_len is None:
         prefix_len = jnp.int32(0)
     if prefix_lo is None:
         prefix_lo = jnp.int32(0)
     idx = jnp.arange(max_len)[None, :]
+    posk = pos[:, None] if jnp.ndim(pos) else pos
+    lok = prefix_lo[:, None] if jnp.ndim(prefix_lo) else prefix_lo
     valid = (
-        (idx <= pos)
-        & (idx >= prefix_lo)
+        (idx <= posk)
+        & (idx >= lok)
         & ((idx < prefix_len) | (idx >= prefix_len + n_pad[:, None]))
     )[:, None, None, :]
     shift = prefix_lo + n_pad
@@ -554,20 +566,25 @@ def extend_positions_and_mask(max_len, u, pos0, n_pad, prefix_len=None,
     positions ``[B, U]`` (clipped at 0 for pad slots) and the
     ``[B, 1, U, L]`` key mask — earlier valid slots plus the causal
     part of the block itself, minus the prefix pad and the per-row
-    suffix pad hole."""
+    suffix pad hole. ``pos0``: traced scalar, or per-row ``[B]`` for
+    desynchronized rows (batched speculation). ``prefix_lo``: scalar,
+    or per-row ``[B]`` for cross-batch prefix sharing (see
+    :func:`decode_valid_and_shift`)."""
     if prefix_len is None:
         prefix_len = jnp.int32(0)
     if prefix_lo is None:
         prefix_lo = jnp.int32(0)
     idx = jnp.arange(max_len)
-    qpos = pos0 + jnp.arange(u)                       # [U] slot ids
+    pos0k = pos0[:, None] if jnp.ndim(pos0) else pos0
+    lok = prefix_lo[:, None] if jnp.ndim(prefix_lo) else prefix_lo
+    qpos = pos0k + jnp.arange(u)[None, :]          # [B|1, U] slot ids
     shift = prefix_lo + n_pad                          # [B]
-    posq = jnp.maximum(qpos[None, :] - shift[:, None], 0)
-    valid_k = (idx[None, :] >= prefix_lo) & (
+    posq = jnp.maximum(qpos - shift[:, None], 0)
+    valid_k = (idx[None, :] >= lok) & (
         (idx[None, :] < prefix_len)
         | (idx[None, :] >= prefix_len + n_pad[:, None])
     )                                                  # [B, L]
-    causal = idx[None, None, :] <= qpos[None, :, None]  # [1, U, L]
+    causal = idx[None, None, :] <= qpos[:, :, None]  # [B|1, U, L]
     mask = (valid_k[:, None, :] & causal)[:, None, :, :]
     return posq, mask
 
@@ -580,16 +597,32 @@ def cached_attend(
     ``[B, 1]`` query against the whole cache under the ``valid`` mask.
     ``expand`` broadcasts kv-heads to query heads (GQA families pass
     their repeat; MHA passes nothing). Returns ``(ctx, new_layer)``.
+
+    ``pos`` scalar: one fused slice-update writes every row at the
+    same slot (the serving layout). ``pos`` per-row ``[B]``: the
+    write vmaps over rows so each lands at its own slot — the layout
+    batched speculation needs, where per-row acceptance lengths
+    desynchronize row positions. Scalar callers compile the exact
+    HLO they always did.
     """
     from mlapi_tpu.ops.attention import NEG
 
     expand = expand or (lambda t: t)
-    ck = jax.lax.dynamic_update_slice(
-        cache_layer["k"], k_new.astype(cdt), (0, pos, 0, 0)
-    )
-    cv = jax.lax.dynamic_update_slice(
-        cache_layer["v"], v_new.astype(cdt), (0, pos, 0, 0)
-    )
+    if jnp.ndim(pos):
+        row_write = jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (p, 0, 0)
+            )
+        )
+        ck = row_write(cache_layer["k"], k_new.astype(cdt), pos)
+        cv = row_write(cache_layer["v"], v_new.astype(cdt), pos)
+    else:
+        ck = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k_new.astype(cdt), (0, pos, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v_new.astype(cdt), (0, pos, 0, 0)
+        )
     scores = (
         jnp.einsum(
             "bqhd,bkhd->bhqk", q, expand(ck),
@@ -798,7 +831,11 @@ def prefix_prefill_fn(model, suffix_len: int, total: int):
 
     Per-row suffix pads (``hole [B]``) are masked via the pad hole in
     :func:`extend_positions_and_mask`; ``lo`` is the prefix's OWN
-    left-pad inside its bucket. The suffix runs as ONE fused block
+    left-pad inside its bucket. Cross-batch prefix sharing rides the
+    same program shapes: ``prefix_kv`` may be a per-row ``[B, P]``
+    stack (each row's own prefix, right-aligned to the common region
+    end ``P``) with ``lo`` a per-row ``[B]`` vector — the broadcast
+    becomes the identity and the mask helpers handle the vector. The suffix runs as ONE fused block
     forward (``extend_core``) — a single weight pass, like the plain
     prefill, so the KV path beats re-prefilling the concatenation for
     every nonempty prefix. Sampling draws at each row's stream index

@@ -250,6 +250,14 @@ def main(argv=None) -> None:
              "block — speeds up single-stream greedy generation",
     )
     parser.add_argument(
+        "--spec-sample", action="store_true",
+        help="with --draft-checkpoint: also speculate SAMPLED "
+             "(temperature > 0) single-stream requests via "
+             "acceptance-rejection — exact target distribution, but "
+             "streams under concurrent admission churn are not "
+             "byte-reproducible per seed (solo runs are)",
+    )
+    parser.add_argument(
         "--profiler-port", type=int, default=0,
         help="start a jax.profiler server on this port (XProf/TensorBoard "
              "can attach live)",
@@ -292,6 +300,7 @@ def main(argv=None) -> None:
     engine = InferenceEngine.from_checkpoint(
         ckpt, quantize=args.quantize,
         draft_checkpoint=args.draft_checkpoint,
+        spec_sample=args.spec_sample,
     )
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
     server = Server(app, host=args.host, port=args.port,

@@ -110,6 +110,7 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         quantize: str | None = None,
         draft_checkpoint=None,
+        spec_sample: bool = False,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -207,6 +208,7 @@ class InferenceEngine:
                 tokenizer=tokenizer,
                 mesh=mesh,
                 draft=draft,
+                spec_sample=spec_sample,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"draft": str(draft_checkpoint)}
@@ -520,6 +522,7 @@ class TextGenerationEngine:
         max_queue: int = 256,
         draft: tuple | None = None,
         spec_k: int = 4,
+        spec_sample: bool = False,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -547,6 +550,15 @@ class TextGenerationEngine:
             self.draft_model = None
             self.draft_params = None
         self.spec_k = max(1, int(spec_k))
+        # Opt-in: run SAMPLED (temperature > 0) single-row requests
+        # through acceptance-rejection speculation (Leviathan/Chen —
+        # ops/speculative.speculative_sample's scheme). The emitted
+        # stream keeps the exact target sampling distribution and a
+        # solo run is deterministic per seed, but a stream interleaved
+        # with admission churn is NOT byte-reproducible across runs
+        # (re-engagement shifts the draft's stream offsets) — hence a
+        # deployment flag (--spec-sample), not a default.
+        self.spec_sample = bool(spec_sample)
         self.model = model
         self.tokenizer = tokenizer
         self.mesh = mesh
@@ -640,6 +652,14 @@ class TextGenerationEngine:
         # strict non-eager mode a resize outside this set is skipped
         # (decode stays at full width) rather than compiled mid-batch.
         self._warmed_shrink: set = set()
+        # Cross-batch prefix sharing: right-aligned [1, P] widenings
+        # of registered prefix KVs (keyed (fp, P), LRU-bounded) and
+        # the region widths P whose stacked program grid is warmed
+        # (strict mode groups cross-prefix only within this set).
+        self._wide_prefix_cache: collections.OrderedDict = (
+            collections.OrderedDict()
+        )
+        self._prefix_mix_warmed: set = set()
 
     @property
     def queue_depth(self) -> int:
@@ -775,27 +795,98 @@ class TextGenerationEngine:
         batches = [1]
         while batches[-1] < self.max_batch:
             batches.append(batches[-1] * 2)
+        from mlapi_tpu.models.gpt import decode_chunk_fn
+
+        p = entry.bucket
         for sb in self.prompt_buckets:
-            if entry.bucket + sb + 1 > self.model.max_positions:
+            if p + sb + 1 > self.model.max_positions:
                 continue  # no room for such suffixes behind this prefix
             total = self._cache_len(
-                entry.bucket + sb, self.default_max_new_tokens
+                p + sb, self.default_max_new_tokens
             )
             for bsz in batches:
                 suffix = np.full(
                     (bsz, sb), self.tokenizer.pad_id, np.int32
                 )
-                prefix_prefill_fn(self.model, sb, total)(
-                    self.params, entry.kv, jnp.asarray(suffix),
-                    jnp.asarray(np.full((bsz,), sb - 1, np.int32)),
-                    jnp.int32(entry.lo),
-                    jnp.asarray(
-                        np.stack([self._key_data(0)] * bsz)
-                    ),
-                    jnp.asarray(np.zeros((bsz,), np.float32)),
-                    jnp.asarray(np.zeros((bsz,), np.int32)),
-                    jnp.asarray(np.ones((bsz,), np.float32)),
+                hole = jnp.asarray(np.full((bsz,), sb - 1, np.int32))
+                keys = jnp.asarray(
+                    np.stack([self._key_data(0)] * bsz)
                 )
+                zt = jnp.asarray(np.zeros((bsz,), np.float32))
+                zk = jnp.asarray(np.zeros((bsz,), np.int32))
+                op = jnp.asarray(np.ones((bsz,), np.float32))
+                _, cache = prefix_prefill_fn(self.model, sb, total)(
+                    self.params, entry.kv, jnp.asarray(suffix),
+                    hole, jnp.int32(entry.lo), keys, zt, zk, op,
+                )
+                # Cross-prefix (stacked) variants: per-row KV stack +
+                # lo vector, and the vector-lo decode-chunk program —
+                # these are keyed on SHAPES only, so warming them once
+                # per region width covers every combination of
+                # registered prefixes whose group max is this bucket.
+                # bsz == 1 is a mixed batch compacted to one row: the
+                # scalar-path cache with the vector-lo decode.
+                lo_vec = jnp.asarray(np.full((bsz,), entry.lo, np.int32))
+                if bsz > 1:
+                    kv_stack = jax.tree.map(
+                        lambda a: jnp.broadcast_to(
+                            a, (bsz,) + a.shape[1:]
+                        ),
+                        entry.kv,
+                    )
+                    _, cache = prefix_prefill_fn(self.model, sb, total)(
+                        self.params, kv_stack, jnp.asarray(suffix),
+                        hole, lo_vec, keys, zt, zk, op,
+                    )
+                decode_chunk_fn(self.model, self.chunk)(
+                    self.params, cache,
+                    jnp.asarray(np.zeros((bsz,), np.int32)),
+                    jnp.int32(p + sb), hole, zt, keys,
+                    jnp.asarray(np.ones((bsz,), np.int32)), zk, op,
+                    jnp.int32(p), lo_vec,
+                )
+        self._prefix_mix_warmed.add(p)
+
+    def _widen_prefix_kv(self, kv, own_len: int, p_len: int):
+        """``[1, own_len]`` prefix-KV pytree → ``[1, p_len]``,
+        right-aligned (real content ends at the common region end)."""
+        if own_len == p_len:
+            return kv
+        off = p_len - own_len
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_update_slice(
+                jnp.zeros((1, p_len) + a.shape[2:], a.dtype), a,
+                (0, off) + (0,) * (a.ndim - 2),
+            ),
+            kv,
+        )
+
+    def _stacked_prefix_kv(self, reqs, p_len: int, b_pad: int):
+        """Per-row ``[b_pad, p_len]`` prefix-KV stack for a
+        cross-prefix batch: each live row's own prefix right-aligned
+        to the common region end (cached per (fp, p_len) — the widen
+        runs once per prefix per width, not once per batch); dummy
+        rows are zeros, fully masked by ``lo == p_len``."""
+        rows = []
+        for r in reqs:
+            key = (r.prefix_fp, p_len)
+            wide = self._wide_prefix_cache.get(key)
+            if wide is None:
+                wide = self._widen_prefix_kv(
+                    r.prefix_kv, r.prefix_len, p_len
+                )
+                self._wide_prefix_cache[key] = wide
+                while len(self._wide_prefix_cache) > 2 * self.max_prefixes:
+                    self._wide_prefix_cache.popitem(last=False)
+            else:
+                self._wide_prefix_cache.move_to_end(key)
+            rows.append(wide)
+        if b_pad > len(reqs):
+            zero = jax.tree.map(jnp.zeros_like, rows[0])
+            rows.extend([zero] * (b_pad - len(reqs)))
+        return jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *rows
+        )
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
@@ -904,11 +995,18 @@ class TextGenerationEngine:
             self.batch_calls += 1
             bucket = max(len(r.row) for r in reqs)
             n_new_max = max(r.n_new for r in reqs)
-            # All members share one prefix (collector grouping
-            # invariant); p_len slots of every row's cache hold its
-            # scattered KV.
-            p_len = reqs[0].prefix_len
+            # The prefix region spans [0, p_len) of every row's cache.
+            # Same-fp batches share ONE scattered KV (scalar lo);
+            # cross-prefix batches stack each row's own KV
+            # right-aligned to the common region end p_len, masked by
+            # a per-row lo vector (lo == p_len ⇒ empty region, the
+            # dummy-row case).
+            p_len = max((r.prefix_len for r in reqs), default=0)
             p_lo = reqs[0].prefix_lo
+            mixed_prefix = bool(p_len) and any(
+                r.prefix_fp != reqs[0].prefix_fp or r.prefix_len != p_len
+                for r in reqs
+            )
             total = self._cache_len(p_len + bucket, n_new_max)
             n_new_max = min(n_new_max, total - p_len - bucket)
             b = len(reqs)
@@ -928,12 +1026,14 @@ class TextGenerationEngine:
             temps = np.zeros((b_pad,), np.float32)
             topk = np.zeros((b_pad,), np.int32)
             topp = np.ones((b_pad,), np.float32)
+            lo = np.full((b_pad,), p_len, np.int32)
             for i, r in enumerate(reqs):
                 prompt[i, bucket - len(r.row):] = r.row
                 n_pad[i] = bucket - r.used
                 temps[i] = r.temperature
                 topk[i] = r.top_k
                 topp[i] = r.top_p
+                lo[i] = p_len - r.prefix_len + r.prefix_lo
             keys = np.stack(
                 [self._key_data(r.seed) for r in reqs]
                 + [self._key_data(0)] * (b_pad - b)
@@ -943,12 +1043,22 @@ class TextGenerationEngine:
                 # Shared-prefix batch: the prefix KV is scattered into
                 # every row and only the suffix block is computed —
                 # the prefix's forward work is paid once per prefix,
-                # not once per request.
+                # not once per request. Cross-prefix batches pass the
+                # per-row right-aligned KV stack + lo vector; same-fp
+                # batches keep the broadcast [1, P] + scalar-lo
+                # program they always compiled.
+                lo_arg = (
+                    jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo)
+                )
+                kv_arg = (
+                    self._stacked_prefix_kv(reqs, p_len, b_pad)
+                    if mixed_prefix else reqs[0].prefix_kv
+                )
                 first, cache = prefix_prefill_fn(
                     self.model, bucket, total
                 )(
-                    self.params, reqs[0].prefix_kv, jnp.asarray(prompt),
-                    jnp.asarray(n_pad), jnp.int32(p_lo),
+                    self.params, kv_arg, jnp.asarray(prompt),
+                    jnp.asarray(n_pad), lo_arg,
                     jnp.asarray(keys), jnp.asarray(temps),
                     jnp.asarray(topk), jnp.asarray(topp),
                 )
@@ -1010,10 +1120,10 @@ class TextGenerationEngine:
             b_cur = b_pad
 
             def mirrors_take(sel: np.ndarray) -> None:
-                nonlocal n_pad, temps, topk, topp, keys, tok, step
-                n_pad, temps, topk, topp, tok, step = (
+                nonlocal n_pad, temps, topk, topp, keys, tok, step, lo
+                n_pad, temps, topk, topp, tok, step, lo = (
                     n_pad[sel], temps[sel], topk[sel], topp[sel],
-                    tok[sel], step[sel],
+                    tok[sel], step[sel], lo[sel],
                 )
                 keys = keys[sel]
 
@@ -1055,7 +1165,10 @@ class TextGenerationEngine:
                 self.draft_model is not None
                 and b == 1 and p_len == 0
                 and not reqs[0].cancelled
-                and temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0
+                and (
+                    (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
+                    or (self.spec_sample and temps[0] > 0.0)
+                )
             ):
                 spec_hist = [int(tok[0])]
 
@@ -1065,7 +1178,7 @@ class TextGenerationEngine:
                     return
                 cache, pos = self._spec_phase(
                     reqs[0], cache, pos, total, bucket, tok, step,
-                    produced, n_pad, keys, spec_hist,
+                    produced, n_pad, keys, spec_hist, temps, topk, topp,
                 )
                 if produced[0] >= reqs[0].n_new:
                     reqs[0].push(None)
@@ -1087,11 +1200,14 @@ class TextGenerationEngine:
                             unstage(cand)  # drop silently
                             continue
                         if p_len or cand.prefix_fp is not None:
-                            # Prefix layouts are one shared scalar
-                            # region per batch: a prefix request can
-                            # only batch at formation time, and a
-                            # prefix batch admits nobody — defer to
-                            # the collector's next batch.
+                            # Prefix rows batch only at FORMATION time
+                            # (incl. cross-prefix groups): mid-batch
+                            # admission would need the running batch's
+                            # region re-stacked and the joiner's lo
+                            # spliced into the live mirrors — the
+                            # admission scatter/regroup paths don't
+                            # handle the prefix mirrors (yet). Defer
+                            # to the collector's next batch.
                             unstage(cand)
                             with self._alock:
                                 self._deferred.append(cand)
@@ -1288,7 +1404,8 @@ class TextGenerationEngine:
                     jnp.asarray(n_pad), jnp.asarray(temps),
                     jnp.asarray(keys), jnp.asarray(step),
                     jnp.asarray(topk), jnp.asarray(topp),
-                    jnp.int32(p_len), jnp.int32(p_lo),
+                    jnp.int32(p_len),
+                    jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo),
                 )
                 toks_host = np.asarray(toks)
                 got = toks_host.shape[1]
@@ -1334,23 +1451,30 @@ class TextGenerationEngine:
                     pass
 
     def _spec_phase(self, r, cache, pos, total, bucket, tok, step,
-                    produced, n_pad, keys, history):
-        """Run speculative rounds for a single greedy request against
-        the engine's live target cache; returns ``(cache, pos)`` for
+                    produced, n_pad, keys, history, temps, topk, topp):
+        """Run speculative rounds for a single request against the
+        engine's live target cache; returns ``(cache, pos)`` for
         the normal decode loop to resume from. Mutates the host
         mirrors (``tok``, ``step``, ``produced``) in place — the
-        handoff contract with ``_run_batch``. Library twin:
-        ``ops/speculative.speculative_generate`` (same round algebra,
-        pinned byte-exact there); this variant adds the engine's
-        per-row pad mask, streaming pushes, admission handoff, and
-        RE-ENGAGEMENT: ``history`` (the row's emitted tokens so far)
-        replays into a fresh draft cache through already-compiled
-        chunk programs, so a stream whose transient joiners departed
-        speculates again for its tail."""
+        handoff contract with ``_run_batch``. Library twins:
+        ``ops/speculative.speculative_generate`` (greedy rows —
+        byte-exact stream) and ``.speculative_sample`` (sampled rows
+        under ``spec_sample=True`` — exact target distribution); this
+        variant adds the engine's per-row pad mask, streaming pushes,
+        admission handoff, and RE-ENGAGEMENT: ``history`` (the row's
+        emitted tokens so far) replays into a fresh draft cache
+        through already-compiled chunk programs, so a stream whose
+        transient joiners departed speculates again for its tail.
+
+        Each round is TWO device dispatches (scan-propose + verify)
+        regardless of k — through the tunneled attach this, not the
+        acceptance rate, is what sets the wall-clock win."""
         from mlapi_tpu.models.gpt import (
             decode_chunk_fn, extend_chunk_fn, prefill_fn,
         )
-        from mlapi_tpu.ops.speculative import verify_fn
+        from mlapi_tpu.ops.speculative import (
+            propose_fn, sample_verify_fn, verify_fn,
+        )
 
         k = self.spec_k
         # The draft prefill/replay are EXPENSIVE compiles: strict mode
@@ -1412,6 +1536,10 @@ class TextGenerationEngine:
             d_replay_upto += 1
             ri += 1
 
+        sampled = bool(temps[0] > 0.0)
+        temps_j = jnp.asarray(temps)
+        topk_j = jnp.asarray(topk)
+        topp_j = jnp.asarray(topp)
         d_upto = t_upto = pos
         d_pend = [int(tok[0])]
         while not r.cancelled and produced[0] < r.n_new:
@@ -1421,40 +1549,62 @@ class TextGenerationEngine:
             budget = r.n_new - produced[0]
             if budget <= 1 or t_upto + 1 + k + 1 > total:
                 break
-            for t_tok in d_pend:
-                d_tok, d_cache = dstep(d_cache, t_tok, d_upto)
-                d_upto += 1
-            proposals = [d_tok]
-            while len(proposals) < k:
-                d_tok, d_cache = dstep(d_cache, d_tok, d_upto)
-                d_upto += 1
-                proposals.append(d_tok)
-            block = np.asarray([[int(tok[0]), *proposals]], np.int32)
-            cache, expect = verify_fn(self.model, k + 1)(
-                self.params, cache, jnp.asarray(block),
-                jnp.int32(t_upto), npj,
+            # Draft phase: ONE scanned dispatch consumes the pending
+            # accepted tokens and chains all k proposals. Greedy rows
+            # (temp 0) argmax inside the same program; sampled rows
+            # draw from the draft's warped distribution at the
+            # DRAFT-tagged per-token streams.
+            step0 = int(produced[0])
+            d_cache, props, q_probs = propose_fn(
+                self.draft_model, len(d_pend), k, sampled
+            )(
+                self.draft_params, d_cache,
+                jnp.asarray(np.asarray(d_pend, np.int32)),
+                jnp.int32(d_upto), npj, keys_j, temps_j, topk_j,
+                topp_j, jnp.int32(step0),
             )
-            expect = np.asarray(expect)[0]
+            d_upto += len(d_pend) + k - 1
             usable = min(k, budget - 1)
-            m = 0
-            while m < usable and proposals[m] == int(expect[m]):
-                m += 1
-            bonus = int(expect[m])
-            emitted = [*proposals[:m], bonus]
+            if sampled:
+                cache, packed = sample_verify_fn(self.model, k + 1)(
+                    self.params, cache, jnp.int32(int(tok[0])), props,
+                    jnp.int32(t_upto), npj, q_probs, keys_j, temps_j,
+                    topk_j, topp_j, jnp.int32(step0),
+                    jnp.int32(usable),
+                )
+                packed = np.asarray(packed)
+                m = int(packed[k + 1])
+                emitted = packed[: m + 1].tolist()
+                kth = int(packed[k - 1])  # props[k-1] when m == k
+            else:
+                proposals = np.asarray(props).tolist()
+                cache, expect = verify_fn(self.model, k + 1)(
+                    self.params, cache,
+                    jnp.asarray(
+                        np.asarray([[int(tok[0]), *proposals]], np.int32)
+                    ),
+                    jnp.int32(t_upto), npj,
+                )
+                expect = np.asarray(expect)[0]
+                m = 0
+                while m < usable and proposals[m] == int(expect[m]):
+                    m += 1
+                emitted = [*proposals[:m], int(expect[m])]
+                kth = proposals[-1]
             r.push({"token_ids": emitted})
             history.extend(emitted)  # keeps replay state current
             produced[0] += m + 1
             step[0] = produced[0]
             t_upto += m + 1
-            tok[0] = bonus
+            tok[0] = emitted[-1]
             self.spec_rounds += 1
             self.spec_drafted += usable
             self.spec_accepted += m
             if m == k:
-                d_pend = [proposals[-1], bonus]
+                d_pend = [kth, emitted[-1]]
             else:
                 d_upto = t_upto
-                d_pend = [bonus]
+                d_pend = [emitted[-1]]
         return cache, t_upto
 
     # -- asyncio batcher ---------------------------------------------------
@@ -1481,16 +1631,34 @@ class TextGenerationEngine:
     def _compatible(self, group: list, r) -> bool:
         """Can ``r`` join ``group`` without clamping anyone? The batch
         decodes to ``max(n_new)`` from a ``max(bucket)``-wide prompt;
-        both maxima together (plus the shared prefix, if any) must
+        both maxima together (plus the prefix region, if any) must
         still fit the model's window (each request alone always does —
-        ``_encode`` guarantees it). Prefix-cached requests batch only
-        with requests naming the SAME prefix: the prefix region is one
-        shared scalar layout for the whole batch."""
-        if r.prefix_fp != group[0].prefix_fp:
+        ``_encode`` guarantees it).
+
+        Prefix-cached requests batch with each other across DIFFERENT
+        prefixes (cross-batch prefix regions): each row's prefix KV is
+        right-aligned to the group's common region end
+        ``max(prefix_len)`` and masked by its own per-row ``lo``.
+        Prefix and plain requests never mix (a plain row would pay the
+        whole region in dead cache slots). In strict (tunnel) mode a
+        cross-prefix group needs its stacked program shapes pre-warmed
+        (``_prefix_mix_warmed``, populated at entry registration);
+        unwarmed combinations fall back to same-prefix grouping."""
+        if (r.prefix_fp is None) != (group[0].prefix_fp is None):
             return False
+        p_len = 0
+        if r.prefix_fp is not None:
+            p_len = max(r.prefix_len, *(g.prefix_len for g in group))
+            mixed = any(g.prefix_fp != r.prefix_fp for g in group)
+            if (
+                mixed
+                and self._strict_admit
+                and p_len not in self._prefix_mix_warmed
+            ):
+                return False
         bucket = max(len(r.row), *(len(g.row) for g in group))
         n_new = max(r.n_new, *(g.n_new for g in group))
-        return r.prefix_len + bucket + n_new <= self.model.max_positions
+        return p_len + bucket + n_new <= self.model.max_positions
 
     async def _collect_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -1785,22 +1953,28 @@ class TextGenerationEngine:
         )
 
     def _warm_spec(self) -> int:
-        """Compile the speculative-phase programs (draft prefill,
-        draft step, verify block) for every prompt bucket at the
-        default cache tier, off the request path."""
+        """Compile the speculative-phase programs (draft prefill, the
+        scanned propose for both pending widths, the verify block —
+        greedy argmax and, under ``spec_sample``, the sampled
+        acceptance-rejection variant — and the replay-remainder step)
+        for every prompt bucket at the default cache tier, off the
+        request path."""
         from mlapi_tpu.models.gpt import (
             decode_chunk_fn, extend_chunk_fn, prefill_fn,
         )
-        from mlapi_tpu.ops.speculative import verify_fn
+        from mlapi_tpu.ops.speculative import (
+            propose_fn, sample_verify_fn, verify_fn,
+        )
 
         shapes = 0
         zt = jnp.zeros((1,), jnp.float32)
         z0 = jnp.zeros((1,), jnp.int32)
         o1 = jnp.ones((1,), jnp.float32)
         key1 = jnp.asarray(self._key_data(0)[None])
+        k = self.spec_k
         for bucket in self.prompt_buckets:
             total = self._cache_len(bucket, self.default_max_new_tokens)
-            if bucket + 1 + self.spec_k + 1 > total:
+            if bucket + 1 + k + 1 > total:
                 continue
             row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
             npj = jnp.asarray(np.asarray([bucket - 1], np.int32))
@@ -1808,6 +1982,21 @@ class TextGenerationEngine:
                 self.draft_params, jnp.asarray(row), key1, zt, npj,
                 z0, o1,
             )
+            # Rounds start from 1 pending token (partial acceptance)
+            # or 2 (a fully-accepted round's unfed k-th proposal);
+            # sampled speculation compiles its own propose variant.
+            variants = (False, True) if self.spec_sample else (False,)
+            for n_in in (1, 2):
+                for sampled in variants:
+                    d_cache, _, _ = propose_fn(
+                        self.draft_model, n_in, k, sampled
+                    )(
+                        self.draft_params, d_cache,
+                        jnp.asarray(np.zeros((n_in,), np.int32)),
+                        jnp.int32(bucket), npj, key1,
+                        o1 if sampled else zt, z0, o1,
+                        jnp.int32(0),
+                    )
             _, d_cache, _ = decode_chunk_fn(self.draft_model, 1)(
                 self.draft_params, d_cache, jnp.asarray(
                     np.zeros((1,), np.int32)
@@ -1815,11 +2004,21 @@ class TextGenerationEngine:
                 jnp.int32(bucket), npj, zt, key1, jnp.int32(0), z0, o1,
                 jnp.int32(0), jnp.int32(0),
             )
-            block = np.zeros((1, self.spec_k + 1), np.int32)
-            verify_fn(self.model, self.spec_k + 1)(
+            block = np.zeros((1, k + 1), np.int32)
+            verify_fn(self.model, k + 1)(
                 self.params, self.model.init_cache(1, total),
                 jnp.asarray(block), jnp.int32(bucket), npj,
             )
+            if self.spec_sample:
+                sample_verify_fn(self.model, k + 1)(
+                    self.params, self.model.init_cache(1, total),
+                    jnp.int32(0),
+                    jnp.asarray(np.zeros((k,), np.int32)),
+                    jnp.int32(bucket), npj,
+                    jnp.full((k, self.model.vocab_size),
+                             1.0 / self.model.vocab_size, np.float32),
+                    key1, o1, z0, o1, jnp.int32(0), jnp.int32(k),
+                )
             if bucket + self.chunk <= total:
                 # Re-engagement replays history in chunk-wide blocks.
                 extend_chunk_fn(self.draft_model, self.chunk, total)(

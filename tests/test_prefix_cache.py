@@ -140,16 +140,24 @@ async def test_same_prefix_requests_batch_and_match():
         await eng.stop()
 
 
-async def test_different_prefixes_do_not_share_a_batch():
+async def test_different_prefixes_share_a_batch_exactly():
+    """Cross-batch prefix regions (tests/test_prefix_mixed.py has the
+    full matrix): two requests naming DIFFERENT prefixes decode in
+    one batch, each on the KV path, streams exact."""
     eng = _engine(max_wait_ms=50.0)
+    ref1 = eng.generate_text("a" * 16 + "ij", max_new_tokens=4)
+    ref2 = eng.generate_text("b" * 16 + "ij", max_new_tokens=4)
+    # Register both prefixes first so the co-batch window isn't
+    # racing the entries' first-use prefill.
+    eng._prefix_entry("a" * 16)
+    eng._prefix_entry("b" * 16)
     await eng.start()
     try:
-        base = eng.batch_calls
         g1 = await eng.submit("ij", max_new_tokens=4, prefix="a" * 16)
         g2 = await eng.submit("ij", max_new_tokens=4, prefix="b" * 16)
         a, b = await _collect(g1), await _collect(g2)
-        assert len(a) == 4 and len(b) == 4
-        assert eng.batch_calls - base == 2
+        assert a == ref1["token_ids"]
+        assert b == ref2["token_ids"]
         assert eng.prefix_misses == 2  # both on the KV path
     finally:
         await eng.stop()

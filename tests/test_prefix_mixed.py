@@ -1,0 +1,180 @@
+"""Cross-batch prefix regions: requests naming DIFFERENT prefixes
+share one decode batch. Each row's prefix KV is right-aligned to the
+group's common region end ``p_len = max(prefix_len)`` and masked by a
+per-row ``lo`` vector (`engine._stacked_prefix_kv`,
+`models/gpt.py` mask helpers' vector ``prefix_lo``).
+
+The pin is the same equivalence the single-prefix tests hold: every
+stream must be byte-identical to serving the concatenated
+prefix+text through the plain path, now with rows whose prefixes —
+and prefix LENGTHS — differ inside one batch."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=256,
+    compute_dtype="float32",
+)
+
+P_A = "abcdefgh" * 3      # 24 tokens → bucket 64, padded (lo > 0)
+P_B = "zyxwvuts" * 8      # 64 tokens → bucket 64, aligned (lo == 0)
+P_C = "mnop" * 2          # 8 tokens → bucket 16 (different WIDTH)
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _engine(model_name="gpt_lm", **kw) -> TextGenerationEngine:
+    cfg = dict(CFG)
+    if model_name == "llama_lm":
+        cfg.pop("num_heads")
+        cfg.update(num_heads=4, num_kv_heads=2)
+    model = get_model(model_name, **cfg)
+    return TextGenerationEngine(
+        model,
+        model.init(jax.random.key(0)),
+        tokenizer=ByteTokenizer(),
+        chunk=4,
+        max_wait_ms=200.0,
+        **kw,
+    )
+
+
+async def _collect(gen) -> list[int]:
+    out: list[int] = []
+    while True:
+        item = await gen.queue.get()
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        out.extend(item["token_ids"])
+
+
+async def _run_pair(eng, specs):
+    """Submit all (prefix, text, n) at once so the collector batches
+    them; returns the collected streams in submit order. Prefix
+    entries are registered up front — the co-batch window must not
+    race the first-use prefix prefill."""
+    for prefix, _, _ in specs:
+        eng._prefix_entry(prefix)
+    await eng.start()
+    try:
+        gens = []
+        for prefix, text, n in specs:
+            gens.append(
+                await eng.submit(text, max_new_tokens=n, prefix=prefix)
+            )
+        return await asyncio.gather(*[_collect(g) for g in gens])
+    finally:
+        await eng.stop()
+
+
+@pytest.mark.parametrize("model_name", ["gpt_lm", "llama_lm"])
+async def test_two_prefixes_one_batch_exact_streams(model_name):
+    """Same-width buckets, different contents (one padded, one
+    aligned): both streams must equal their plain-path solo runs, and
+    the engine must have served them in ONE batch."""
+    eng = _engine(model_name)
+    ref_a = eng.generate_text(P_A + "ij", max_new_tokens=10)
+    ref_b = eng.generate_text(P_B + "kl", max_new_tokens=10)
+    base = eng.batch_calls
+    got_a, got_b = await _run_pair(
+        eng, [(P_A, "ij", 10), (P_B, "kl", 10)]
+    )
+    assert got_a == ref_a["token_ids"]
+    assert got_b == ref_b["token_ids"]
+    assert eng.batch_calls == base + 1, "prefixes were not co-batched"
+
+
+async def test_different_prefix_widths_right_aligned():
+    """Different prefix BUCKETS (64 vs 16): the narrow prefix
+    right-aligns into the wide region; both streams stay exact."""
+    eng = _engine()
+    ref_a = eng.generate_text(P_A + "ij", max_new_tokens=12)
+    ref_c = eng.generate_text(P_C + "kl", max_new_tokens=12)
+    base = eng.batch_calls
+    got_a, got_c = await _run_pair(
+        eng, [(P_A, "ij", 12), (P_C, "kl", 12)]
+    )
+    assert got_a == ref_a["token_ids"]
+    assert got_c == ref_c["token_ids"]
+    assert eng.batch_calls == base + 1
+
+
+async def test_mixed_batch_compaction_after_short_row_finishes():
+    """A short and a long request with different prefixes: after the
+    short row finishes the batch compacts, and the surviving row's
+    per-row lo must follow it through the gather."""
+    eng = _engine()
+    ref_long = eng.generate_text(P_B + "kl", max_new_tokens=40)
+    ref_short = eng.generate_text(P_C + "ij", max_new_tokens=4)
+    got_short, got_long = await _run_pair(
+        eng, [(P_C, "ij", 4), (P_B, "kl", 40)]
+    )
+    assert got_short == ref_short["token_ids"]
+    assert got_long == ref_long["token_ids"]
+
+
+async def test_three_prefixes_batch_and_seeded_sampling():
+    """Three distinct prefixes in one batch, one of them sampled with
+    a seed: sampled streams must also be byte-identical to their solo
+    plain-path runs (per-row PRNG streams are position-independent)."""
+    eng = _engine()
+    ref_a = eng.generate_text(P_A + "ij", max_new_tokens=8)
+    ref_b = eng.generate_text(
+        P_B + "kl", max_new_tokens=8, temperature=0.9, seed=7
+    )
+    ref_c = eng.generate_text(P_C + "mn", max_new_tokens=8)
+    for p in (P_A, P_B, P_C):
+        eng._prefix_entry(p)
+    await eng.start()
+    try:
+        g_a = await eng.submit("ij", max_new_tokens=8, prefix=P_A)
+        g_b = await eng.submit(
+            "kl", max_new_tokens=8, temperature=0.9, seed=7, prefix=P_B
+        )
+        g_c = await eng.submit("mn", max_new_tokens=8, prefix=P_C)
+        got = await asyncio.gather(
+            _collect(g_a), _collect(g_b), _collect(g_c)
+        )
+    finally:
+        await eng.stop()
+    assert got[0] == ref_a["token_ids"]
+    assert got[1] == ref_b["token_ids"]
+    assert got[2] == ref_c["token_ids"]
+
+
+async def test_plain_and_prefix_requests_do_not_mix():
+    """A plain request must not join a prefix batch (it would pay the
+    whole region in dead cache slots)."""
+    eng = _engine()
+    ref_p = eng.generate_text(P_A + "ij", max_new_tokens=6)
+    ref_n = eng.generate_text("hello", max_new_tokens=6)
+    base = eng.batch_calls
+    await eng.start()
+    try:
+        g_p = await eng.submit("ij", max_new_tokens=6, prefix=P_A)
+        g_n = await eng.submit("hello", max_new_tokens=6)
+        got_p, got_n = await asyncio.gather(_collect(g_p), _collect(g_n))
+    finally:
+        await eng.stop()
+    assert got_p == ref_p["token_ids"]
+    assert got_n == ref_n["token_ids"]
+    assert eng.batch_calls >= base + 2, "plain joined a prefix batch"
