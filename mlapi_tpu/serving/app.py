@@ -290,6 +290,11 @@ def _install_generate(app: App, engine) -> None:
                 top_k=req.top_k,
                 top_p=req.top_p,
                 prefix=req.prefix,
+                # Incremental consumers (NDJSON streams, stop-sequence
+                # watchers that cancel early) need tokens per chunk;
+                # plain requests let the decode loop chain dispatches
+                # and sync once.
+                stream=bool(req.stream) or bool(stops),
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None

@@ -360,13 +360,13 @@ class GenRequest:
 
     __slots__ = (
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
-        "cancelled", "top_k", "top_p",
+        "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
         "prompt_tokens",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
-                 top_k=0, top_p=1.0, prefix=None):
+                 top_k=0, top_p=1.0, prefix=None, stream=False):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -375,6 +375,13 @@ class GenRequest:
         self.loop = loop
         self.top_k = top_k        # 0 disables
         self.top_p = top_p        # 1.0 disables
+        # Incremental consumer (NDJSON stream or a stop-sequence
+        # watcher): the decode loop keeps at most one chunk in
+        # flight so tokens land promptly; non-incremental requests
+        # let the loop chain every chunk and sync once (the
+        # dispatch-bound single-stream win through a high-RTT
+        # attach).
+        self.stream = stream
         # Shared-prefix KV entry (engine._prefix_entry); only
         # same-prefix requests batch together.
         if prefix is not None:
@@ -432,6 +439,7 @@ class _SyncSink:
         self.top_k, self.top_p = req.top_k, req.top_p
         self.prefix_fp, self.prefix_kv = req.prefix_fp, req.prefix_kv
         self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
+        self.stream = req.stream
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
@@ -890,7 +898,8 @@ class TextGenerationEngine:
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
-                prefix: str | None = None) -> GenRequest:
+                prefix: str | None = None,
+                stream: bool = False) -> GenRequest:
         entry = None
         raw = None
         if prefix:
@@ -953,7 +962,7 @@ class TextGenerationEngine:
         row[-used:] = raw[-used:]
         return GenRequest(
             row, used, n_new, temperature, seed, loop, top_k, top_p,
-            prefix=entry,
+            prefix=entry, stream=stream,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -1160,6 +1169,11 @@ class TextGenerationEngine:
             # admission candidate arrives, and RE-engages for the
             # tail once transient joiners depart (spec_hist tracks
             # the row's emitted tokens for the draft-cache replay).
+            # produced as of the DISPATCH frontier (tokens already
+            # scheduled on device but possibly not yet drained); the
+            # chained-dispatch loop below schedules against this,
+            # while `produced` tracks what was delivered.
+            sched = list(produced)
             spec_hist: list | None = None
             if (
                 self.draft_model is not None
@@ -1180,15 +1194,79 @@ class TextGenerationEngine:
                     reqs[0], cache, pos, total, bucket, tok, step,
                     produced, n_pad, keys, spec_hist, temps, topk, topp,
                 )
+                sched[0] = produced[0]
                 if produced[0] >= reqs[0].n_new:
                     reqs[0].push(None)
                     done[0] = True
 
             try_spec()
 
+            # -- chained dispatch -----------------------------------
+            # decode_chunk_fn RETURNS the feedback token as a device
+            # array (last_tok), so consecutive chunks need no host
+            # round trip between them: the loop dispatches ahead and
+            # drains token readbacks lazily. Through a high-RTT
+            # attach (the tunneled chip: ~68 ms per synced readback,
+            # while argument uploads pipeline for free) this turns a
+            # request's serial cost from one RTT PER CHUNK into one
+            # readback at the end. Policy: non-incremental batches
+            # chain every chunk; a batch with any `stream` consumer
+            # keeps at most one chunk in flight (tokens land
+            # promptly); speculative solo batches stay synchronous
+            # (spec rounds read tokens by design). Anything that
+            # mutates batch state — admission, compaction, the spec
+            # phase — drains fully first and drops the device chain
+            # (the host mirrors are the source of truth again).
+            inflight: list = []  # (toks_dev [B,size], size, live-idx)
+            tok_dev = None       # device-resident feedback token
+
+            def drain(count: int | None = None) -> None:
+                nonlocal tok
+                take = inflight[:] if count is None else inflight[:count]
+                if not take:
+                    return
+                del inflight[: len(take)]
+                for toks_dev, _, _ in take:
+                    # Start every host copy before blocking on the
+                    # first: one overlapped transfer window instead
+                    # of a serial RTT per chunk.
+                    try:
+                        toks_dev.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                for toks_dev, got, plive in take:
+                    toks_host = np.asarray(toks_dev)
+                    tok = toks_host[:, -1].copy()
+                    for i in plive:
+                        r = reqs[i]
+                        if r.cancelled:
+                            continue
+                        want = r.n_new - produced[i]
+                        if want > 0:
+                            chunk_ids = toks_host[rows[i], : min(want, got)]
+                            r.push({"token_ids": chunk_ids.tolist()})
+                            if spec_hist is not None and i == 0:
+                                spec_hist.extend(chunk_ids.tolist())
+                            produced[i] += got
+                            if want <= got:
+                                r.push(None)
+                                done[i] = True
+
+            def invalidate_chain() -> None:
+                nonlocal tok_dev
+                drain()
+                tok_dev = None
+
+            def sdone(i: int) -> bool:
+                """done[] as of the DISPATCH frontier: a row whose
+                in-flight chunks already cover its budget must not be
+                scheduled more device work."""
+                return done[i] or sched[i] >= reqs[i].n_new
+
             while True:
                 pending_n = 0
                 if admit and self._admit:
+                    invalidate_chain()
                     with self._alock:
                         candidates = list(self._admit)
                     n_live = sum(
@@ -1324,6 +1402,7 @@ class TextGenerationEngine:
                         reqs.append(cand)
                         rows.append(row)
                         produced.append(1)
+                        sched.append(1)
                         cand.push({"token_ids": [ftok]})
                         fin = cand.n_new <= 1
                         if fin:
@@ -1336,11 +1415,13 @@ class TextGenerationEngine:
                         pending_n = len(self._admit)
                 live = [
                     i for i, r in enumerate(reqs)
-                    if not done[i] and not r.cancelled
+                    if not sdone(i) and not r.cancelled
                 ]
                 if not live:
-                    # Every remaining consumer disconnected or
-                    # finished: stop burning device time.
+                    # Every remaining consumer disconnected, finished,
+                    # or is fully covered by in-flight chunks: deliver
+                    # what's pending and stop scheduling device time.
+                    drain()
                     if not all(done):
                         self.cancelled_batches += 1
                     break
@@ -1353,7 +1434,13 @@ class TextGenerationEngine:
                 if (
                     spec_hist is not None and b_cur == 1
                     and live == [0] and not pending_n
+                    # Cheap frontier-side disqualifiers first: breaking
+                    # the dispatch chain (a full drain) is only worth it
+                    # when the spec phase could actually run rounds.
+                    and reqs[0].n_new - sched[0] > 1
+                    and pos + 1 + self.spec_k + 1 <= total
                 ):
+                    invalidate_chain()
                     try_spec()
                     if done[0]:
                         continue
@@ -1365,6 +1452,7 @@ class TextGenerationEngine:
                 # end and corrupted the tail positions).
                 size = min(self.chunk, total - pos)
                 if size <= 0:
+                    drain()
                     break  # cache exhausted — safety net below
                 want_b = 1
                 while want_b < len(live):
@@ -1387,6 +1475,7 @@ class TextGenerationEngine:
                     or (b_cur, want_b, total) in self._warmed_shrink
                 )
                 if want_b < b_cur and not pending_n and resize_ok:
+                    invalidate_chain()
                     sel = [rows[i] for i in live]
                     sel += [sel[0]] * (want_b - len(sel))
                     sel = np.asarray(sel, np.int32)
@@ -1399,33 +1488,42 @@ class TextGenerationEngine:
                     b_cur = want_b
                     self.compactions += 1
                 self.chunk_calls += 1
-                toks, cache, _ = decode_chunk_fn(self.model, size)(
-                    self.params, cache, jnp.asarray(tok), jnp.int32(pos),
+                toks, cache, last_tok = decode_chunk_fn(self.model, size)(
+                    self.params, cache,
+                    tok_dev if tok_dev is not None else jnp.asarray(tok),
+                    jnp.int32(pos),
                     jnp.asarray(n_pad), jnp.asarray(temps),
                     jnp.asarray(keys), jnp.asarray(step),
                     jnp.asarray(topk), jnp.asarray(topp),
                     jnp.int32(p_len),
                     jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo),
                 )
-                toks_host = np.asarray(toks)
-                got = toks_host.shape[1]
-                tok = toks_host[:, -1].copy()
-                step = step + np.int32(got)
+                inflight.append((toks, size, live))
                 for i in live:
-                    r = reqs[i]
-                    if r.cancelled:
-                        continue
-                    want = r.n_new - produced[i]
-                    if want > 0:
-                        chunk_ids = toks_host[rows[i], : min(want, got)]
-                        r.push({"token_ids": chunk_ids.tolist()})
-                        if spec_hist is not None and i == 0:
-                            spec_hist.extend(chunk_ids.tolist())
-                        produced[i] += got
-                        if want <= got:
-                            r.push(None)
-                            done[i] = True
-                pos += got
+                    sched[i] += size
+                step = step + np.int32(size)
+                pos += size
+                tok_dev = last_tok
+                if any(
+                    reqs[i].stream
+                    for _, _, plive in inflight
+                    for i in plive
+                ):
+                    # A chunk covering an incremental consumer may
+                    # wait behind at most ONE newer chunk — including
+                    # a stream row's FINAL chunk after it left `live`
+                    # (its terminator must not ride the chain until
+                    # the co-batched requests finish).
+                    if len(inflight) > 1:
+                        drain(len(inflight) - 1)
+                elif len(inflight) >= 4:
+                    # Bounded run-ahead: one overlapped readback
+                    # window per 4 chunks keeps ~the full RTT win
+                    # while cancellation and mid-batch admission get
+                    # a real sync point every few chunks instead of
+                    # after the whole generation.
+                    drain()
+            drain()
             # Safety net: every waiter MUST get a terminator. The
             # collector/admission only group window-compatible
             # requests, so this fires only if that invariant is ever
@@ -1807,6 +1905,7 @@ class TextGenerationEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         prefix: str | None = None,
+        stream: bool = False,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
@@ -1833,6 +1932,7 @@ class TextGenerationEngine:
             lambda: self._encode(
                 text, n_new, float(temperature), int(seed), loop,
                 int(top_k), float(top_p), prefix=prefix,
+                stream=bool(stream),
             ),
         )
         try:
