@@ -605,6 +605,172 @@ def speculative_generate_batched(
     return [o[:n] for o in out], stats
 
 
+@functools.lru_cache(maxsize=16)
+def fused_spec_fn(target, draft, p: int, n: int, k: int):
+    """The ENTIRE greedy speculative generation as ONE XLA program:
+    target + draft prefills, then a ``lax.while_loop`` whose body is
+    a full round — draft scan (consume pending + chain k proposals),
+    verify block, acceptance compare, accepted-segment scatter into
+    the output buffer, cache-position algebra — with no host
+    round-trip anywhere. Through a high-RTT attach a generation costs
+    ONE dispatch + ONE readback regardless of length; on any attach
+    it removes the per-round host sync the chunked engine pays.
+
+    Compiled per ``(target, draft, prompt_len, n, k)``. Requires
+    window headroom ``p + n + k + 1 <= max_positions`` for both
+    models (rounds never need plain-step fallback: a budget-1 round
+    emits exactly its bonus token via ``usable = 0``).
+
+    Returns ``(out [n], rounds, accepted, drafted)``.
+    """
+    kw = k + 1
+    total_t = total_d = p + n + k + 1
+
+    def _run(t_params, d_params, prompt_ids):
+        zb = jnp.zeros((1,), jnp.int32)
+        t_cache, t_logits = target.prefill_core(
+            t_params, prompt_ids, zb, total_t
+        )
+        d_cache, _ = draft.prefill_core(d_params, prompt_ids, zb, total_d)
+        t0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)[0]
+        out = jnp.zeros((n + kw,), jnp.int32).at[0].set(t0)
+
+        def body(s):
+            t_cache, d_cache, out, n_out, t_upto, d_upto, pend, n_pend = s
+
+            # Draft phase: consume the pending accepted tokens and
+            # chain k proposals (same schedule as propose_fn, with
+            # the pending width traced).
+            def dstep(carry, i):
+                d_cache, tok = carry
+                logits, d_cache = draft.decode_step(
+                    d_params, d_cache, tok[None, None], d_upto + i, zb
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+                feed = jnp.where(
+                    i + 1 < n_pend, pend[jnp.minimum(i + 1, 1)], nxt
+                )
+                return (d_cache, feed), nxt
+
+            (d_cache, _), toks = jax.lax.scan(
+                dstep, (d_cache, pend[0]), jnp.arange(kw)
+            )
+            j = (n_pend - 1) + jnp.arange(k)
+            props = toks[j]                       # [k]
+            d_upto = d_upto + n_pend + k - 1
+
+            # Verify: ONE target block forward over the LAST EMITTED
+            # token + proposals. `pend` is the DRAFT's pending list;
+            # its final entry (index n_pend - 1) is always the
+            # previous round's bonus — the target's own pending token
+            # (after a full round pend[0] is the draft's unfed k-th
+            # proposal, which must NOT head the verify block).
+            head = pend[n_pend - 1]
+            block = jnp.concatenate([head[None], props])[None]
+            t_cache, logits = target.extend_core(
+                t_params, t_cache, block, t_upto, zb,
+                jnp.int32(0), jnp.int32(0), all_logits=True,
+            )
+            expect = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+
+            usable = jnp.minimum(k, n - n_out - 1)
+            acc = (props == expect[:k]) & (jnp.arange(k) < usable)
+            m = jnp.argmin(
+                jnp.concatenate(
+                    [acc, jnp.zeros((1,), bool)]
+                ).astype(jnp.int32)
+            )
+            bonus = expect[m]
+            seg = jnp.where(
+                jnp.arange(kw) < m,
+                jnp.concatenate([props, jnp.zeros((1,), jnp.int32)]),
+                bonus,
+            )
+            out = jax.lax.dynamic_update_slice(out, seg, (n_out,))
+            t_upto = t_upto + m + 1
+            full = m == k
+            pend = jnp.where(
+                full,
+                jnp.stack([props[k - 1], bonus]),
+                jnp.stack([bonus, jnp.int32(0)]),
+            )
+            n_pend = jnp.where(full, jnp.int32(2), jnp.int32(1))
+            d_upto = jnp.where(full, d_upto, t_upto)
+            n_out = n_out + m + 1
+            return (
+                t_cache, d_cache, out, n_out, t_upto, d_upto, pend,
+                n_pend,
+            )
+
+        def cond2(s):
+            return s[0][3] < n
+
+        def body2(s):
+            core, rounds, accepted, drafted = s
+            usable = jnp.minimum(k, n - core[3] - 1)
+            nxt = body(core)
+            emitted = nxt[3] - core[3]
+            return (nxt, rounds + 1, accepted + emitted - 1,
+                    drafted + usable)
+
+        init = (
+            t_cache, d_cache, out, jnp.int32(1), jnp.int32(p),
+            jnp.int32(p), jnp.stack([t0, jnp.int32(0)]), jnp.int32(1),
+        )
+        (core, rounds, accepted, drafted) = jax.lax.while_loop(
+            cond2, body2, (init, jnp.int32(0), jnp.int32(0),
+                           jnp.int32(0))
+        )
+        # ONE packed readback: tokens + stats in a single transfer
+        # (separate scalar fetches each cost a full round trip
+        # through a tunneled attach).
+        return jnp.concatenate(
+            [core[2][:n], jnp.stack([rounds, accepted, drafted])]
+        )
+
+    return jax.jit(_run)
+
+
+def speculative_generate_fused(
+    target,
+    t_params,
+    draft,
+    d_params,
+    prompt_ids,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+) -> tuple[list[int], SpecStats]:
+    """Greedy speculative generation with the WHOLE loop on device
+    (:func:`fused_spec_fn`) — byte-identical to
+    :func:`speculative_generate` and plain target greedy decoding,
+    at one dispatch + one readback per generation."""
+    b, p = prompt_ids.shape
+    if b != 1:
+        raise ValueError("speculative decoding is single-row (batch=1)")
+    if target.vocab_size != draft.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    n = int(max_new_tokens)
+    k = max(1, min(int(k), n))
+    total = p + n + k + 1
+    if total > target.max_positions or total > draft.max_positions:
+        raise ValueError(
+            f"fused speculation needs prompt + max_new_tokens + k + 1 "
+            f"(= {total}) cache slots within both model windows; use "
+            "speculative_generate near the window edge"
+        )
+    packed = np.asarray(
+        fused_spec_fn(target, draft, p, n, k)(
+            t_params, d_params, jnp.asarray(prompt_ids)
+        )
+    )
+    stats = SpecStats(
+        rounds=int(packed[n]), drafted=int(packed[n + 2]),
+        accepted=int(packed[n + 1]), emitted=n,
+    )
+    return packed[:n].tolist(), stats
+
+
 def speculative_sample(
     target,
     t_params,

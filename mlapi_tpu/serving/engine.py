@@ -1104,20 +1104,41 @@ class TextGenerationEngine:
                     jnp.asarray(temps), jnp.asarray(n_pad),
                     jnp.asarray(topk), jnp.asarray(topp),
                 )
-            # np.array (copy): the spec phase mutates tok[0] in place,
-            # and np.asarray of a device array is a read-only view.
-            tok = np.array(first)
+            # The speculative phase reads/writes the host token
+            # mirror, so spec-eligible batches sync the first token
+            # here as before; everyone else CHAINS it — the prefill's
+            # sampled token stays on device as the first chunk's
+            # feedback and is delivered by the first drain, saving
+            # one readback round trip per request.
+            spec_eligible = (
+                self.draft_model is not None
+                and b == 1 and p_len == 0
+                and not reqs[0].cancelled
+                and (
+                    (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
+                    or (self.spec_sample and temps[0] > 0.0)
+                )
+            )
             # step[row]: the row's NEXT sampling-stream index — its own
             # produced-token count, NOT a batch-global counter, so a
             # row admitted later still reproduces its solo stream.
             step = np.ones((b_pad,), np.int32)
-            produced = [1] * b
             done = [False] * b
-            for i, r in enumerate(reqs):
-                r.push({"token_ids": [int(tok[i])]})
-                if r.n_new <= 1:
-                    r.push(None)
-                    done[i] = True
+            if spec_eligible:
+                # np.array (copy): the spec phase mutates tok[0] in
+                # place; np.asarray of a device array is read-only.
+                tok = np.array(first)
+                produced = [1] * b
+                for i, r in enumerate(reqs):
+                    r.push({"token_ids": [int(tok[i])]})
+                    if r.n_new <= 1:
+                        r.push(None)
+                        done[i] = True
+                first_chunk = None
+            else:
+                tok = np.zeros((b_pad,), np.int32)  # set by first drain
+                produced = [0] * b
+                first_chunk = first[:, None]  # [B, 1] device, deferred
 
             pos = p_len + bucket
             # rows[i]: request i's current row in the (possibly
@@ -1175,15 +1196,7 @@ class TextGenerationEngine:
             # while `produced` tracks what was delivered.
             sched = list(produced)
             spec_hist: list | None = None
-            if (
-                self.draft_model is not None
-                and b == 1 and p_len == 0
-                and not reqs[0].cancelled
-                and (
-                    (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
-                    or (self.spec_sample and temps[0] > 0.0)
-                )
-            ):
+            if spec_eligible:
                 spec_hist = [int(tok[0])]
 
             def try_spec():
@@ -1229,7 +1242,10 @@ class TextGenerationEngine:
                 for toks_dev, _, _ in take:
                     # Start every host copy before blocking on the
                     # first: one overlapped transfer window instead
-                    # of a serial RTT per chunk.
+                    # of a serial RTT per chunk. (A device-side
+                    # concat + single readback was measured too: it
+                    # lands in the same noise band on the tunneled
+                    # attach, so the simpler form stays.)
                     try:
                         toks_dev.copy_to_host_async()
                     except AttributeError:
@@ -1263,10 +1279,19 @@ class TextGenerationEngine:
                 scheduled more device work."""
                 return done[i] or sched[i] >= reqs[i].n_new
 
+            if first_chunk is not None:
+                # The deferred first token rides the chain as a
+                # width-1 chunk: delivered by the first drain, chained
+                # into chunk 1 on device.
+                all_rows = list(range(b))
+                inflight.append((first_chunk, 1, all_rows))
+                for i in all_rows:
+                    sched[i] += 1
+                tok_dev = first
+
             while True:
                 pending_n = 0
                 if admit and self._admit:
-                    invalidate_chain()
                     with self._alock:
                         candidates = list(self._admit)
                     n_live = sum(
@@ -1348,10 +1373,19 @@ class TextGenerationEngine:
                                 continue
                         if not free and not grow:
                             break
-                        # Committed: leave the staging list BEFORE the
-                        # device work, so a mid-admission failure
-                        # (outer except delivers the error to every
-                        # member of ``reqs``) cannot also re-serve an
+                        # Committed: the joiner will mutate the host
+                        # mirrors and possibly the cache layout, so
+                        # the dispatch chain ends here (draining also
+                        # brings `done` current for the bookkeeping
+                        # below). Candidates that merely unstage or
+                        # defer above never pay this — a camping
+                        # incompatible candidate must not degrade the
+                        # batch to synced per-chunk readbacks.
+                        invalidate_chain()
+                        # Leave the staging list BEFORE the device
+                        # work, so a mid-admission failure (outer
+                        # except delivers the error to every member
+                        # of ``reqs``) cannot also re-serve an
                         # already-admitted joiner from ``_admit``.
                         unstage(cand)
                         if grow:

@@ -1,0 +1,107 @@
+"""Fused speculative generation (`ops/speculative.fused_spec_fn`):
+the ENTIRE propose/verify/accept loop as one XLA program
+(`lax.while_loop`), no host round-trip per round.
+
+Pin: byte-identical to the host-loop `speculative_generate` (itself
+pinned byte-identical to plain target greedy) for random and equal
+draft/target pairs, both decoder families, across k — plus the
+window-headroom validation."""
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.speculative import (
+    speculative_generate,
+    speculative_generate_fused,
+)
+
+T_CFG = dict(
+    vocab_size=260, hidden_size=48, num_layers=3, num_heads=4,
+    max_positions=160, compute_dtype="float32",
+)
+D_CFG = dict(
+    vocab_size=260, hidden_size=24, num_layers=1, num_heads=2,
+    max_positions=160, compute_dtype="float32",
+)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_fused_matches_host_loop_random_models(k):
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompt = (np.arange(9, dtype=np.int32)[None] % 200) + 3
+    ref, ref_stats = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=24, k=k,
+    )
+    got, stats = speculative_generate_fused(
+        target, tp, draft, dp, prompt, max_new_tokens=24, k=k,
+    )
+    assert got == ref, (k, stats)
+    # Same acceptance algebra; the host loop's budget-1 PLAIN steps
+    # (fallback_steps) are usable-0 rounds in the fused loop — each
+    # emits exactly the bonus token, so rounds line up as the sum.
+    assert stats.rounds == ref_stats.rounds + ref_stats.fallback_steps
+    assert stats.accepted == ref_stats.accepted
+
+
+def test_fused_draft_equals_target_full_acceptance():
+    target = get_model("gpt_lm", **T_CFG)
+    tp = target.init(jax.random.key(0))
+    prompt = (np.arange(7, dtype=np.int32)[None] % 150) + 5
+    ref, _ = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=21, k=4,
+    )
+    got, stats = speculative_generate_fused(
+        target, tp, target, tp, prompt, max_new_tokens=21, k=4,
+    )
+    assert got == ref
+    assert stats.acceptance_rate == 1.0, stats
+
+
+def test_fused_llama_family():
+    cfg = dict(T_CFG, hidden_size=32, num_layers=2)
+    cfg.pop("num_heads")
+    target = get_model("llama_lm", **cfg, num_heads=4, num_kv_heads=2)
+    tp = target.init(jax.random.key(0))
+    prompt = (np.arange(6, dtype=np.int32)[None] % 120) + 3
+    ref, _ = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=12, k=2,
+    )
+    got, stats = speculative_generate_fused(
+        target, tp, target, tp, prompt, max_new_tokens=12, k=2,
+    )
+    assert got == ref
+    assert stats.acceptance_rate == 1.0
+
+
+def test_fused_budget_not_round_multiple():
+    """n not a multiple of k+1: the budget-capped final round
+    (usable < k) must land exactly n tokens."""
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(2))
+    dp = draft.init(jax.random.key(3))
+    prompt = (np.arange(8, dtype=np.int32)[None] % 150) + 5
+    ref, _ = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=13, k=4,
+    )
+    got, _ = speculative_generate_fused(
+        target, tp, draft, dp, prompt, max_new_tokens=13, k=4,
+    )
+    assert got == ref
+    assert len(got) == 13
+
+
+def test_fused_window_headroom_validated():
+    cfg = dict(T_CFG, max_positions=32)
+    target = get_model("gpt_lm", **cfg)
+    tp = target.init(jax.random.key(0))
+    prompt = (np.arange(8, dtype=np.int32)[None] % 100) + 3
+    with pytest.raises(ValueError, match="cache slots"):
+        speculative_generate_fused(
+            target, tp, target, tp, prompt, max_new_tokens=24, k=4,
+        )
