@@ -231,6 +231,51 @@ def propose_fn(model, n_in: int, k: int, sampled: bool = False):
     return jax.jit(_run, donate_argnums=(1,))
 
 
+def _accept_and_draw(key, pr, q_probs, props, usable, step0):
+    """The distribution-critical acceptance-rejection core shared by
+    the jitted verify (:func:`sample_verify_fn`) and the fused loop
+    (:func:`fused_spec_fn`): test each proposal with ``u*q < p``
+    (ACC-tagged per-token uniforms), find the first rejection ``m``
+    (capped by ``usable``), and draw the round's final token — from
+    the normalized residual ``max(p_m - q_m, 0)`` at a NATURAL
+    rejection, else from the full target distribution ``p_m``
+    (all-accepted bonus / budget-capped round) — on the RES-tagged
+    stream at the token's own index. Returns ``(m, final_token)``.
+
+    ``pr``: warped target probs ``[k+1, V]``; ``q_probs``: draft
+    probs ``[k, V]``; ``props``: ``[k]`` proposal ids.
+    """
+    k, v = q_probs.shape[0], pr.shape[-1]
+    idx = jnp.arange(k)
+    ukeys = jax.vmap(
+        lambda i: jax.random.fold_in(
+            jax.random.fold_in(key, _ACC_TAG), step0 + i
+        )
+    )(idx)
+    us = jax.vmap(jax.random.uniform)(ukeys)
+    p_at = pr[idx, props]
+    q_at = q_probs[idx, props]
+    # u < p/q as u*q < p: no divide, exact at q == 0 (unreachable
+    # for a draft-sampled token, but cheap insurance).
+    acc = (us * q_at < p_at) & (idx < usable)
+    m = jnp.argmin(
+        jnp.concatenate([acc, jnp.zeros((1,), bool)]).astype(jnp.int32)
+    )
+    natural = m < usable  # a tested proposal actually failed
+    q_ext = jnp.concatenate([q_probs, jnp.zeros((1, v), q_probs.dtype)])
+    r = jnp.where(natural, jnp.maximum(pr[m] - q_ext[m], 0.0), pr[m])
+    rsum = jnp.sum(r)
+    # Degenerate residual (p <= q everywhere, float ties): fall back
+    # to the target distribution — still a valid sample and
+    # unreachable in exact arithmetic.
+    r = jnp.where(rsum > 0.0, r / rsum, pr[m] / jnp.sum(pr[m]))
+    skey = jax.random.fold_in(
+        jax.random.fold_in(key, _RES_TAG), step0 + m
+    )
+    final = jax.random.categorical(skey, jnp.log(r)).astype(jnp.int32)
+    return m, final
+
+
 @functools.lru_cache(maxsize=32)
 def sample_verify_fn(model, width: int):
     """Jitted SAMPLED verify: the whole acceptance-rejection round on
@@ -262,39 +307,10 @@ def sample_verify_fn(model, width: int):
             jnp.int32(0), jnp.int32(0), all_logits=True,
         )
         lg = logits[0]  # [width, V]
-        v = lg.shape[-1]
         wide = lambda x: jnp.broadcast_to(x, (width,))
         p = _warped_probs(lg, wide(temp[0]), wide(topk[0]), wide(topp[0]))
         key = jax.random.wrap_key_data(key_data[0])
-        ukeys = jax.vmap(
-            lambda i: jax.random.fold_in(
-                jax.random.fold_in(key, _ACC_TAG), step0 + i
-            )
-        )(jnp.arange(k))
-        us = jax.vmap(jax.random.uniform)(ukeys)
-        idx = jnp.arange(k)
-        p_at = p[idx, props]
-        q_at = q_probs[idx, props]
-        # u < p/q as u*q < p: no divide, exact at q == 0 (unreachable
-        # for a draft-sampled token, but cheap insurance).
-        acc = (us * q_at < p_at) & (idx < usable)
-        m = jnp.argmin(
-            jnp.concatenate([acc, jnp.zeros((1,), bool)]).astype(jnp.int32)
-        )
-        natural = m < usable  # a tested proposal actually failed
-        q_ext = jnp.concatenate(
-            [q_probs, jnp.zeros((1, v), q_probs.dtype)]
-        )
-        r = jnp.where(natural, jnp.maximum(p[m] - q_ext[m], 0.0), p[m])
-        rsum = jnp.sum(r)
-        # Degenerate residual (p <= q everywhere, float ties): fall
-        # back to the target distribution — still a valid sample and
-        # unreachable in exact arithmetic.
-        r = jnp.where(rsum > 0.0, r / rsum, p[m] / jnp.sum(p[m]))
-        skey = jax.random.fold_in(
-            jax.random.fold_in(key, _RES_TAG), step0 + m
-        )
-        last = jax.random.categorical(skey, jnp.log(r)).astype(jnp.int32)
+        m, last = _accept_and_draw(key, p, q_probs, props, usable, step0)
         out = jnp.where(
             jnp.arange(width) < m,
             jnp.concatenate([props, jnp.zeros((1,), jnp.int32)]),
@@ -606,33 +622,53 @@ def speculative_generate_batched(
 
 
 @functools.lru_cache(maxsize=16)
-def fused_spec_fn(target, draft, p: int, n: int, k: int):
-    """The ENTIRE greedy speculative generation as ONE XLA program:
-    target + draft prefills, then a ``lax.while_loop`` whose body is
-    a full round — draft scan (consume pending + chain k proposals),
-    verify block, acceptance compare, accepted-segment scatter into
-    the output buffer, cache-position algebra — with no host
-    round-trip anywhere. Through a high-RTT attach a generation costs
-    ONE dispatch + ONE readback regardless of length; on any attach
-    it removes the per-round host sync the chunked engine pays.
+def fused_spec_fn(target, draft, p: int, n: int, k: int,
+                  sampled: bool = False):
+    """The ENTIRE speculative generation as ONE XLA program: target +
+    draft prefills, then a ``lax.while_loop`` whose body is a full
+    round — draft scan (consume pending + chain k proposals), verify
+    block, acceptance, accepted-segment scatter into the output
+    buffer, cache-position algebra — with no host round-trip
+    anywhere. Through a high-RTT attach a generation costs ONE
+    dispatch + ONE packed readback regardless of length; on any
+    attach it removes the per-round host sync the chunked engine
+    pays.
 
-    Compiled per ``(target, draft, prompt_len, n, k)``. Requires
-    window headroom ``p + n + k + 1 <= max_positions`` for both
-    models (rounds never need plain-step fallback: a budget-1 round
-    emits exactly its bonus token via ``usable = 0``).
+    ``sampled`` is STATIC: the greedy variant argmaxes everywhere;
+    the sampled variant draws the first token at the untagged stream
+    index 0, proposals from the draft's warped distribution
+    (DRAFT-tagged per-token streams), acceptance uniforms and the
+    residual/bonus draw from the ACC/RES-tagged streams — the same
+    key discipline as the host-loop scheme, so the emitted stream
+    keeps the exact target sampling distribution for any draft.
 
-    Returns ``(out [n], rounds, accepted, drafted)``.
+    Compiled per ``(target, draft, prompt_len, n, k, sampled)``.
+    Requires window headroom ``p + n + k + 1 <= max_positions`` for
+    both models (rounds never need plain-step fallback: a budget-1
+    round emits exactly its final token via ``usable = 0``).
+
+    Returns ``packed [n + 3]``: tokens then (rounds, accepted,
+    drafted).
     """
     kw = k + 1
     total_t = total_d = p + n + k + 1
 
-    def _run(t_params, d_params, prompt_ids):
+    def _run(t_params, d_params, prompt_ids, key_data, temps, topk,
+             topp):
+        from mlapi_tpu.models.gpt import _pick_token
+
         zb = jnp.zeros((1,), jnp.int32)
+        key = jax.random.wrap_key_data(key_data[0])
         t_cache, t_logits = target.prefill_core(
             t_params, prompt_ids, zb, total_t
         )
         d_cache, _ = draft.prefill_core(d_params, prompt_ids, zb, total_d)
-        t0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)[0]
+        if sampled:
+            t0 = _pick_token(
+                temps, t_logits, key_data, 0, topk, topp
+            )[0]
+        else:
+            t0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)[0]
         out = jnp.zeros((n + kw,), jnp.int32).at[0].set(t0)
 
         def body(s):
@@ -646,13 +682,24 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int):
                 logits, d_cache = draft.decode_step(
                     d_params, d_cache, tok[None, None], d_upto + i, zb
                 )
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+                if sampled:
+                    probs = _warped_probs(logits, temps, topk, topp)
+                    prop_i = jnp.maximum(i - (n_pend - 1), 0) + n_out
+                    kk = jax.random.fold_in(
+                        jax.random.fold_in(key, _DRAFT_TAG), prop_i
+                    )
+                    nxt = jax.random.categorical(
+                        kk, jnp.log(probs[0])
+                    ).astype(jnp.int32)
+                else:
+                    probs = jnp.zeros((1, 0), jnp.float32)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
                 feed = jnp.where(
                     i + 1 < n_pend, pend[jnp.minimum(i + 1, 1)], nxt
                 )
-                return (d_cache, feed), nxt
+                return (d_cache, feed), (nxt, probs[0])
 
-            (d_cache, _), toks = jax.lax.scan(
+            (d_cache, _), (toks, qrows) = jax.lax.scan(
                 dstep, (d_cache, pend[0]), jnp.arange(kw)
             )
             j = (n_pend - 1) + jnp.arange(k)
@@ -671,16 +718,26 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int):
                 t_params, t_cache, block, t_upto, zb,
                 jnp.int32(0), jnp.int32(0), all_logits=True,
             )
-            expect = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
-
             usable = jnp.minimum(k, n - n_out - 1)
-            acc = (props == expect[:k]) & (jnp.arange(k) < usable)
-            m = jnp.argmin(
-                jnp.concatenate(
-                    [acc, jnp.zeros((1,), bool)]
-                ).astype(jnp.int32)
-            )
-            bonus = expect[m]
+            if sampled:
+                q_probs = qrows[j]                # [k, V]
+                wide = lambda x: jnp.broadcast_to(x, (kw,))
+                pr = _warped_probs(
+                    logits[0], wide(temps[0]), wide(topk[0]),
+                    wide(topp[0]),
+                )
+                m, bonus = _accept_and_draw(
+                    key, pr, q_probs, props, usable, n_out
+                )
+            else:
+                expect = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+                acc = (props == expect[:k]) & (jnp.arange(k) < usable)
+                m = jnp.argmin(
+                    jnp.concatenate(
+                        [acc, jnp.zeros((1,), bool)]
+                    ).astype(jnp.int32)
+                )
+                bonus = expect[m]
             seg = jnp.where(
                 jnp.arange(kw) < m,
                 jnp.concatenate([props, jnp.zeros((1,), jnp.int32)]),
@@ -731,6 +788,38 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int):
     return jax.jit(_run)
 
 
+def _fused_run(target, t_params, draft, d_params, prompt_ids,
+               max_new_tokens, k, sampled, key_data, temps, topk, topp):
+    """Shared validation + dispatch + packed-stats unpack for both
+    fused wrappers (the packed layout and the headroom formula live
+    in exactly one place)."""
+    b, p = prompt_ids.shape
+    if b != 1:
+        raise ValueError("speculative decoding is single-row (batch=1)")
+    if target.vocab_size != draft.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    n = int(max_new_tokens)
+    k = max(1, min(int(k), n))
+    total = p + n + k + 1
+    if total > target.max_positions or total > draft.max_positions:
+        raise ValueError(
+            f"fused speculation needs prompt + max_new_tokens + k + 1 "
+            f"(= {total}) cache slots within both model windows; use "
+            "the host-loop variant near the window edge"
+        )
+    packed = np.asarray(
+        fused_spec_fn(target, draft, p, n, k, sampled)(
+            t_params, d_params, jnp.asarray(prompt_ids), key_data,
+            temps, topk, topp,
+        )
+    )
+    stats = SpecStats(
+        rounds=int(packed[n]), drafted=int(packed[n + 2]),
+        accepted=int(packed[n + 1]), emitted=n,
+    )
+    return packed[:n].tolist(), stats
+
+
 def speculative_generate_fused(
     target,
     t_params,
@@ -745,30 +834,54 @@ def speculative_generate_fused(
     (:func:`fused_spec_fn`) — byte-identical to
     :func:`speculative_generate` and plain target greedy decoding,
     at one dispatch + one readback per generation."""
-    b, p = prompt_ids.shape
-    if b != 1:
-        raise ValueError("speculative decoding is single-row (batch=1)")
-    if target.vocab_size != draft.vocab_size:
-        raise ValueError("draft and target must share a vocabulary")
-    n = int(max_new_tokens)
-    k = max(1, min(int(k), n))
-    total = p + n + k + 1
-    if total > target.max_positions or total > draft.max_positions:
-        raise ValueError(
-            f"fused speculation needs prompt + max_new_tokens + k + 1 "
-            f"(= {total}) cache slots within both model windows; use "
-            "speculative_generate near the window edge"
-        )
-    packed = np.asarray(
-        fused_spec_fn(target, draft, p, n, k)(
-            t_params, d_params, jnp.asarray(prompt_ids)
-        )
+    return _fused_run(
+        target, t_params, draft, d_params, prompt_ids,
+        max_new_tokens, k, False, _zero_key(),
+        jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.float32),
     )
-    stats = SpecStats(
-        rounds=int(packed[n]), drafted=int(packed[n + 2]),
-        accepted=int(packed[n + 1]), emitted=n,
+
+
+def speculative_sample_fused(
+    target,
+    t_params,
+    draft,
+    d_params,
+    prompt_ids,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seed: int = 0,
+) -> tuple[list[int], SpecStats]:
+    """SAMPLED speculative generation with the WHOLE loop on device
+    (:func:`fused_spec_fn` with ``sampled=True``): one dispatch + one
+    packed readback per generation, emitted stream distributed
+    exactly as plain target sampling under the same warp for ANY
+    draft (the same acceptance-rejection scheme and tagged-stream
+    key discipline as :func:`speculative_sample`; the two are not
+    byte-identical only because the host loop serves budget-1 tails
+    with an untagged plain step while the fused loop uses a
+    ``usable = 0`` round — both draw from the full target
+    distribution). ``temperature <= 0`` delegates to the byte-exact
+    greedy :func:`speculative_generate_fused`."""
+    if temperature <= 0.0:
+        return speculative_generate_fused(
+            target, t_params, draft, d_params, prompt_ids,
+            max_new_tokens=max_new_tokens, k=k,
+        )
+    key_data = jnp.asarray(
+        np.asarray(jax.random.key_data(jax.random.key(seed)))[None]
     )
-    return packed[:n].tolist(), stats
+    return _fused_run(
+        target, t_params, draft, d_params, prompt_ids,
+        max_new_tokens, k, True, key_data,
+        jnp.asarray(np.asarray([temperature], np.float32)),
+        jnp.asarray(np.asarray([top_k], np.int32)),
+        jnp.asarray(np.asarray([top_p], np.float32)),
+    )
 
 
 def speculative_sample(

@@ -96,6 +96,108 @@ def test_fused_budget_not_round_multiple():
     assert len(got) == 13
 
 
+def test_fused_sampled_draft_equals_target_accepts_all():
+    """Sampled fused: p == q bitwise with draft == target → every
+    usable proposal accepted, deterministic per seed."""
+    from mlapi_tpu.ops.speculative import speculative_sample_fused
+
+    target = get_model("gpt_lm", **T_CFG)
+    tp = target.init(jax.random.key(0))
+    prompt = (np.arange(6, dtype=np.int32)[None] % 150) + 5
+    got, stats = speculative_sample_fused(
+        target, tp, target, tp, prompt,
+        max_new_tokens=16, k=3, temperature=0.8,
+        top_k=12, top_p=0.9, seed=7,
+    )
+    assert len(got) == 16
+    assert stats.acceptance_rate == 1.0, stats
+    again, _ = speculative_sample_fused(
+        target, tp, target, tp, prompt,
+        max_new_tokens=16, k=3, temperature=0.8,
+        top_k=12, top_p=0.9, seed=7,
+    )
+    assert again == got
+    other, _ = speculative_sample_fused(
+        target, tp, target, tp, prompt,
+        max_new_tokens=16, k=3, temperature=0.8,
+        top_k=12, top_p=0.9, seed=8,
+    )
+    assert other != got
+
+
+def test_fused_sampled_greedy_delegates():
+    from mlapi_tpu.ops.speculative import speculative_sample_fused
+
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompt = (np.arange(7, dtype=np.int32)[None] % 150) + 5
+    ref, _ = speculative_generate_fused(
+        target, tp, draft, dp, prompt, max_new_tokens=12, k=3,
+    )
+    got, _ = speculative_sample_fused(
+        target, tp, draft, dp, prompt,
+        max_new_tokens=12, k=3, temperature=0.0, seed=4,
+    )
+    assert got == ref
+
+
+def test_fused_sampled_marginal_matches_exact():
+    """Distributional pin for the fused sampled scheme: the SECOND
+    token's empirical marginal over fixed seeds matches the exact
+    warped target marginal (the same bound the host-loop scheme
+    passes); a draft-biased or wrong-residual scheme lands far
+    outside. Deterministic (fixed seed list)."""
+    import jax.numpy as jnp
+
+    from mlapi_tpu.ops.speculative import (
+        _warped_probs,
+        speculative_sample_fused,
+    )
+
+    cfg_t = dict(
+        vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+        max_positions=64, compute_dtype="float32",
+    )
+    cfg_d = dict(
+        vocab_size=32, hidden_size=16, num_layers=1, num_heads=2,
+        max_positions=64, compute_dtype="float32",
+    )
+    target = get_model("gpt_lm", **cfg_t)
+    draft = get_model("gpt_lm", **cfg_d)
+    tp = target.init(jax.random.key(4))
+    dp = draft.init(jax.random.key(9))
+    prompt = (np.arange(3, dtype=np.int32)[None] % 20) + 5
+    temperature = 1.2
+    v = 32
+    n_runs = 600
+    counts = np.zeros(v)
+    for seed in range(n_runs):
+        toks, _ = speculative_sample_fused(
+            target, tp, draft, dp, prompt,
+            max_new_tokens=2, k=1, temperature=temperature, seed=seed,
+        )
+        counts[toks[1]] += 1
+    emp = counts / n_runs
+
+    temps = jnp.asarray([temperature], jnp.float32)
+    z0 = jnp.zeros((1,), jnp.int32)
+    o1 = jnp.ones((1,), jnp.float32)
+    logits0 = target.apply(tp, jnp.asarray(prompt))[0, -1][None]
+    p0 = np.asarray(_warped_probs(logits0, temps, z0, o1))[0]
+    exact = np.zeros(v)
+    for t0 in range(v):
+        if p0[t0] < 1e-9:
+            continue
+        seq = np.concatenate([prompt[0], [t0]])[None].astype(np.int32)
+        lg1 = target.apply(tp, jnp.asarray(seq))[0, -1][None]
+        p1 = np.asarray(_warped_probs(lg1, temps, z0, o1))[0]
+        exact += p0[t0] * p1
+    tv = 0.5 * np.abs(emp - exact).sum()
+    assert tv < 0.2, f"TV {tv:.3f} vs exact marginal"
+
+
 def test_fused_window_headroom_validated():
     cfg = dict(T_CFG, max_positions=32)
     target = get_model("gpt_lm", **cfg)
