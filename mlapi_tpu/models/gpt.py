@@ -766,6 +766,33 @@ def admit_scatter_fn():
     return jax.jit(_run, donate_argnums=(0,))
 
 
+@functools.cache
+def realign_fn():
+    """Jitted per-row cache ROLL for batched-speculation handoff:
+    shift row ``b``'s slots right by ``delta[b]`` (``new[b, i] =
+    old[b, i - delta_b]``, clamped reads below 0 land on slot 0 and
+    are garbage). Callers bump ``n_pad[b] += delta_b`` so the rolled
+    rows' effective positions (``slot - n_pad``) are UNCHANGED —
+    wpe indices and stored rotary phases both key on effective
+    position, so the roll is exact for every decoder family. This is
+    what lets desynchronized per-row speculative positions rejoin
+    the scalar-``pos`` chunk loop (and its admission machinery) at a
+    round boundary."""
+
+    def _run(cache, delta):
+        def roll(a):
+            L = a.shape[1]
+            idx = jnp.arange(L)[None, :] - delta[:, None]  # [B, L]
+            idx = jnp.clip(idx, 0, L - 1)
+            return jnp.take_along_axis(
+                a, idx.reshape(idx.shape + (1,) * (a.ndim - 2)), axis=1
+            )
+
+        return jax.tree.map(roll, cache)
+
+    return jax.jit(_run, donate_argnums=(0,))
+
+
 @functools.lru_cache(maxsize=64)
 def decode_chunk_fn(model, chunk: int):
     """Jitted ``chunk``-step decode program:

@@ -15,7 +15,13 @@ capability scaled up TPU-first):
 from mlapi_tpu.ops.attention import full_attention
 from mlapi_tpu.ops.quant import dequantize_tree, quantize_tree
 from mlapi_tpu.ops.ring_attention import ring_attention, ring_self_attention
-from mlapi_tpu.ops.speculative import speculative_generate
+from mlapi_tpu.ops.speculative import (
+    speculative_generate,
+    speculative_generate_batched,
+    speculative_generate_fused,
+    speculative_sample,
+    speculative_sample_fused,
+)
 
 __all__ = [
     "full_attention",
@@ -24,4 +30,8 @@ __all__ = [
     "quantize_tree",
     "dequantize_tree",
     "speculative_generate",
+    "speculative_generate_batched",
+    "speculative_generate_fused",
+    "speculative_sample",
+    "speculative_sample_fused",
 ]

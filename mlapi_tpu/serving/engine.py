@@ -1119,12 +1119,40 @@ class TextGenerationEngine:
                     or (self.spec_sample and temps[0] > 0.0)
                 )
             )
+            # BATCHED speculation: a freshly-formed all-greedy batch
+            # speculates as a whole — per-row acceptance lengths
+            # desynchronize row positions (rank-polymorphic pos +
+            # vmapped cache writes), and the phase REALIGNS the cache
+            # (per-row roll, n_pad bump) before handing off to the
+            # scalar-pos chunk loop, so admission keeps working.
+            # Needs k+1 slots of cache headroom past every row's
+            # budget for the final round's verify block.
+            spec_batched = (
+                self.draft_model is not None
+                and b > 1 and p_len == 0
+                and bool(
+                    np.all(temps[:b] <= 0.0)
+                    and np.all(topk[:b] == 0)
+                    and np.all(topp[:b] >= 1.0)
+                )
+                and total >= bucket + n_new_max + self.spec_k + 1
+                # In strict (tunnel) mode an unwarmed batched-spec
+                # shape would decline inside the phase anyway —
+                # decide at formation so such batches keep the
+                # chained (deferred) first token instead of paying a
+                # synchronous readback for nothing.
+                and (
+                    not self._strict_admit
+                    or (bucket, total, b_pad, "batched")
+                    in self._warmed_spec
+                )
+            )
             # step[row]: the row's NEXT sampling-stream index — its own
             # produced-token count, NOT a batch-global counter, so a
             # row admitted later still reproduces its solo stream.
             step = np.ones((b_pad,), np.int32)
             done = [False] * b
-            if spec_eligible:
+            if spec_eligible or spec_batched:
                 # np.array (copy): the spec phase mutates tok[0] in
                 # place; np.asarray of a device array is read-only.
                 tok = np.array(first)
@@ -1213,6 +1241,13 @@ class TextGenerationEngine:
                     done[0] = True
 
             try_spec()
+
+            if spec_batched and not all(done):
+                cache, pos = self._spec_phase_batched(
+                    reqs, cache, pos, total, bucket, prompt, tok,
+                    step, produced, done, n_pad, keys, b_pad,
+                )
+                sched[:] = produced
 
             # -- chained dispatch -----------------------------------
             # decode_chunk_fn RETURNS the feedback token as a device
@@ -1618,9 +1653,8 @@ class TextGenerationEngine:
         # speculate, no block room, or joiners already waiting.
         if r.n_new - produced[0] <= 1 or pos + 1 + k + 1 > total:
             return cache, pos
-        with self._alock:
-            if self._admit:
-                return cache, pos
+        if self._spec_should_yield():
+            return cache, pos
 
         npj = jnp.asarray(n_pad)
         zt = jnp.zeros((1,), jnp.float32)
@@ -1675,9 +1709,8 @@ class TextGenerationEngine:
         d_upto = t_upto = pos
         d_pend = [int(tok[0])]
         while not r.cancelled and produced[0] < r.n_new:
-            with self._alock:
-                if self._admit:
-                    break  # joiners waiting: normal loop admits them
+            if self._spec_should_yield():
+                break  # joiners waiting: normal loop admits them
             budget = r.n_new - produced[0]
             if budget <= 1 or t_upto + 1 + k + 1 > total:
                 break
@@ -1738,6 +1771,151 @@ class TextGenerationEngine:
                 d_upto = t_upto
                 d_pend = [emitted[-1]]
         return cache, t_upto
+
+    def _spec_should_yield(self) -> bool:
+        """Admission candidates end a speculative phase at the next
+        round boundary — the handoff seam (tests patch this to force
+        a deterministic mid-phase handoff; in production a joiner can
+        land during the phase's first compiles, in which case
+        yielding before round one is the correct behavior)."""
+        with self._alock:
+            return bool(self._admit)
+
+    def _spec_phase_batched(self, reqs, cache, pos, total, bucket,
+                            prompt, tok, step, produced, done, n_pad,
+                            keys, b_cur):
+        """Speculative rounds for a WHOLE freshly-formed greedy batch:
+        every row drafts k proposals and verifies them in one block
+        per round, advancing by its OWN acceptance length (the
+        rank-polymorphic per-row position layout). Rows that finish
+        (or cancel) freeze and ride as dummies — their writes land
+        beyond their valid bound, masked until the batch ends.
+
+        Handoff: the phase exits at a round boundary when admission
+        candidates arrive (or every row is done) and REALIGNS the
+        cache — each row rolls right by ``max(t_upto) - t_upto_b``
+        with ``n_pad`` bumped by the same amount, which keeps every
+        effective position identical (wpe indices and stored rotary
+        phases key on effective position) — so the scalar-``pos``
+        chunk loop resumes exactly as if the batch had always been
+        synchronized. Engages only at batch FORMATION; after a
+        handoff the batch stays on the chunk loop (library twin with
+        the full algebra: ``ops.speculative.speculative_generate_batched``).
+        """
+        from mlapi_tpu.models.gpt import prefill_fn, realign_fn
+        from mlapi_tpu.ops.speculative import (
+            propose_batched_fn, verify_fn,
+        )
+
+        k = self.spec_k
+        key = (bucket, total, b_cur, "batched")
+        if self._strict_admit and key not in self._warmed_spec:
+            return cache, pos
+
+        if self._spec_should_yield():
+            return cache, pos  # joiners already staged: skip the
+            # whole-batch draft prefill, not just round one
+        zb = jnp.zeros((b_cur,), jnp.int32)
+        zt = jnp.zeros((b_cur,), jnp.float32)
+        ob = jnp.ones((b_cur,), jnp.float32)
+        npj = jnp.asarray(n_pad)
+        keys_j = jnp.asarray(keys)
+        _, d_cache = prefill_fn(self.draft_model, total)(
+            self.draft_params, jnp.asarray(prompt), keys_j, zt, npj,
+            zb, ob,
+        )
+        self._warmed_spec.add(key)
+
+        b = len(reqs)
+        t_upto = np.full((b_cur,), pos, np.int64)
+        d_upto = np.full((b_cur,), pos, np.int64)
+        d_pend = [[int(tok[i])] for i in range(b_cur)]
+
+        while True:
+            if self._spec_should_yield():
+                break  # joiners waiting: realign and hand off
+            active = [
+                i for i in range(b)
+                if not done[i] and not reqs[i].cancelled
+                and reqs[i].n_new - produced[i] >= 1
+            ]
+            if not active:
+                break
+            # Desync-headroom invariant: after ANY round, the realign
+            # frontier (max position, growing by <= k+1) plus the
+            # laggiest row's remaining budget (shrinking by >= 1)
+            # must still fit the cache — otherwise a lopsided round
+            # could strand a slow row past the window and the chunk
+            # loop would truncate it. Stop speculating one round
+            # early instead; the synchronized chunk loop finishes
+            # within the formation guarantee.
+            rem = max(reqs[i].n_new - produced[i] for i in active)
+            if int(t_upto.max()) + k + 1 + rem - 1 > total:
+                break
+            pend_buf = np.zeros((b_cur, 2), np.int32)
+            n_in = np.ones((b_cur,), np.int32)
+            for i in range(b_cur):
+                pend = d_pend[i]
+                n_in[i] = len(pend)
+                pend_buf[i, : len(pend)] = pend
+            d_cache, props, _ = propose_batched_fn(self.draft_model, k)(
+                self.draft_params, d_cache, jnp.asarray(pend_buf),
+                jnp.asarray(n_in),
+                jnp.asarray(d_upto.astype(np.int32)), npj, keys_j,
+                zt, zb, ob, zb,
+            )
+            props = np.asarray(props)
+            d_upto += n_in + k - 1
+
+            block = np.concatenate(
+                [np.asarray(tok[:b_cur], np.int32)[:, None], props],
+                axis=1,
+            )
+            cache, expect = verify_fn(self.model, k + 1)(
+                self.params, cache, jnp.asarray(block),
+                jnp.asarray(t_upto.astype(np.int32)), npj,
+            )
+            expect = np.asarray(expect)
+            self.spec_rounds += 1
+            for i in active:
+                r = reqs[i]
+                budget = r.n_new - produced[i]
+                usable = min(k, budget - 1)
+                m = 0
+                while m < usable and props[i, m] == int(expect[i, m]):
+                    m += 1
+                bonus = int(expect[i, m])
+                emitted = [int(t) for t in props[i, :m]] + [bonus]
+                r.push({"token_ids": emitted})
+                produced[i] += m + 1
+                step[i] = produced[i]
+                t_upto[i] += m + 1
+                tok[i] = bonus
+                self.spec_drafted += usable
+                self.spec_accepted += m
+                if m == k:
+                    d_pend[i] = [int(props[i, -1]), bonus]
+                else:
+                    d_upto[i] = t_upto[i]
+                    d_pend[i] = [bonus]
+                if produced[i] >= r.n_new:
+                    r.push(None)
+                    done[i] = True
+            for i in range(b_cur):
+                if i >= b or done[i] or (
+                    i < b and reqs[i].cancelled
+                ):
+                    # Frozen/dummy rows: keep their state pinned so
+                    # the realign delta stays correct.
+                    d_upto[i] = t_upto[i]
+                    d_pend[i] = d_pend[i][-1:]
+
+        top = int(t_upto.max())
+        if int(t_upto.min()) < top:
+            delta = (top - t_upto).astype(np.int32)
+            cache = realign_fn()(cache, jnp.asarray(delta))
+            n_pad += delta  # in place: the chunk loop's mirror
+        return cache, top
 
     # -- asyncio batcher ---------------------------------------------------
     async def start(self) -> None:
@@ -2164,6 +2342,52 @@ class TextGenerationEngine:
                 )
             self._warmed_spec.add((bucket, total))
             shapes += 1
+            # Batched-speculation grid: the whole-batch draft
+            # prefill, the per-row propose scan, the vector-position
+            # verify retrace, and the realign roll, per batch size.
+            from mlapi_tpu.models.gpt import realign_fn
+            from mlapi_tpu.ops.speculative import propose_batched_fn
+
+            bsz = 2
+            while bsz <= max(
+                2, 1 << (self.max_batch - 1).bit_length()
+            ):
+                bt = total  # the enclosing loop's tier
+                rows_b = np.full(
+                    (bsz, bucket), self.tokenizer.pad_id, np.int32
+                )
+                np_b = jnp.asarray(
+                    np.full((bsz,), bucket - 1, np.int32)
+                )
+                keys_b = jnp.asarray(
+                    np.stack([self._key_data(0)] * bsz)
+                )
+                ztb = jnp.zeros((bsz,), jnp.float32)
+                zbb = jnp.zeros((bsz,), jnp.int32)
+                obb = jnp.ones((bsz,), jnp.float32)
+                _, dcb = prefill_fn(self.draft_model, bt)(
+                    self.draft_params, jnp.asarray(rows_b), keys_b,
+                    ztb, np_b, zbb, obb,
+                )
+                propose_batched_fn(self.draft_model, k)(
+                    self.draft_params, dcb,
+                    jnp.asarray(np.zeros((bsz, 2), np.int32)),
+                    jnp.asarray(np.ones((bsz,), np.int32)),
+                    jnp.asarray(np.full((bsz,), bucket, np.int32)),
+                    np_b, keys_b, ztb, zbb, obb, zbb,
+                )
+                verify_fn(self.model, k + 1)(
+                    self.params, self.model.init_cache(bsz, bt),
+                    jnp.asarray(np.zeros((bsz, k + 1), np.int32)),
+                    jnp.asarray(np.full((bsz,), bucket, np.int32)),
+                    np_b,
+                )
+                realign_fn()(
+                    self.model.init_cache(bsz, bt), zbb,
+                )
+                self._warmed_spec.add((bucket, bt, bsz, "batched"))
+                shapes += 1
+                bsz *= 2
         return shapes
 
     def _warm_admission(self, batches: list) -> int:

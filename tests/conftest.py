@@ -54,6 +54,20 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables after each test module. A full-suite
+    run accumulates hundreds of XLA CPU programs in one process and
+    eventually SEGFAULTS inside a later compile (reproduced twice at
+    the same test with ~128 GB RAM free — compiler-internal state,
+    not host memory). Clearing between modules keeps the process
+    within whatever envelope the compiler needs; modules recompile
+    their own shapes, which costs seconds and buys a deterministic
+    green suite."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     """An 8-device (data=8, model=1) mesh on virtual CPU devices."""

@@ -195,6 +195,57 @@ async def test_concurrent_requests_coalesce_and_match_single_stream(
         await app.shutdown()
 
 
+async def test_stream_terminator_not_starved_by_dispatch_chain(
+    gpt_checkpoint,
+):
+    """A streaming consumer co-batched with a long plain request must
+    receive its final chunk and terminator promptly (≤ one chunk of
+    lag), NOT when the whole batch finishes — the chained-dispatch
+    loop's ≤1-in-flight rule must keep applying to a stream row's
+    LAST chunk after the row leaves the live set."""
+    engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    engine.max_wait_s = 0.2  # make co-batching deterministic
+    await engine.start()
+    try:
+        short_g = await engine.submit("ab", max_new_tokens=6,
+                                      stream=True)
+        long_g = await engine.submit("abab", max_new_tokens=72)
+        got = []
+        while True:
+            item = await short_g.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got.extend(item["token_ids"])
+        assert len(got) == 6
+        # The moment the stream completed, the co-batched long plain
+        # request must still be decoding: its terminator cannot have
+        # been delivered yet (72 tokens >> 6 at the same chunk
+        # cadence). If the chain had parked the stream's final chunk,
+        # both terminators would arrive together at batch end.
+        leftovers = []
+        while not long_g.queue.empty():
+            leftovers.append(long_g.queue.get_nowait())
+        assert None not in leftovers, (
+            "long request finished before the stream's terminator "
+            "was delivered — the chain starved the stream row"
+        )
+        long_ids = [
+            t for item in leftovers
+            if item is not None
+            for t in item["token_ids"]
+        ]
+        while True:
+            item = await long_g.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            long_ids.extend(item["token_ids"])
+        assert len(long_ids) == 72
+    finally:
+        await engine.stop()
+
+
 async def test_streaming_ndjson(gpt_checkpoint):
     """stream=true yields incremental NDJSON chunks whose tokens
     concatenate to the non-streamed answer, ending with a done line."""

@@ -1,0 +1,190 @@
+"""Engine-integrated BATCHED speculation: a freshly-formed all-greedy
+batch speculates as a whole (per-row positions), then REALIGNS the
+cache (per-row roll + n_pad bump — effective positions invariant) to
+hand off to the scalar-pos chunk loop when admission candidates
+arrive. Every stream must stay byte-identical to its draft-less solo
+run — including streams that continue on the chunk loop AFTER the
+realign, which is the part that would break first if the roll
+arithmetic were wrong."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+T_CFG = dict(
+    vocab_size=260, hidden_size=48, num_layers=3, num_heads=4,
+    max_positions=256, compute_dtype="float32",
+)
+D_CFG = dict(
+    vocab_size=260, hidden_size=24, num_layers=1, num_heads=2,
+    max_positions=256, compute_dtype="float32",
+)
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _engines(**kw):
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    tok = ByteTokenizer()
+    # A wide batching window makes co-batch formation deterministic
+    # on a loaded box: if a submit ever misses the window, the
+    # engine legitimately serves it via admission instead (solo spec
+    # yields to joiners), which would make the engage asserts racy.
+    plain = TextGenerationEngine(target, tp, tokenizer=tok, chunk=4,
+                                 max_wait_ms=2000.0)
+    spec = TextGenerationEngine(
+        target, tp, tokenizer=tok, chunk=4, max_wait_ms=2000.0,
+        draft=(draft, dp), spec_k=3, **kw,
+    )
+    return plain, spec
+
+
+async def _collect(gen) -> list[int]:
+    out: list[int] = []
+    while True:
+        item = await gen.queue.get()
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        out.extend(item["token_ids"])
+
+
+async def test_batched_greedy_batch_speculates_and_stays_exact():
+    plain, spec = _engines()
+    prompts = ["abcabcab", "xyzxyz", "hello wor"]
+    refs = [
+        plain.generate_text(p, max_new_tokens=18)["token_ids"]
+        for p in prompts
+    ]
+    await spec.start()
+    try:
+        gens = [
+            await spec.submit(p, max_new_tokens=18) for p in prompts
+        ]
+        got = await asyncio.gather(*[_collect(g) for g in gens])
+    finally:
+        await spec.stop()
+    assert got == refs
+    assert spec.spec_rounds > 0, "batch never speculated"
+
+
+async def test_batched_spec_handoff_realign_exact_tail():
+    """Force the realign handoff DETERMINISTICALLY: the patched yield
+    seam ends the batched spec phase after 3 rounds, mid-generation,
+    with rows at desynchronized positions. Their TAILS then decode
+    through the scalar-pos chunk loop on the ROLLED cache — byte-exact
+    streams prove the per-row roll + n_pad bump preserved every
+    effective position."""
+    plain, spec = _engines()
+    prompts = ["abcabcab", "xyzxyz"]
+    refs = [
+        plain.generate_text(p, max_new_tokens=48)["token_ids"]
+        for p in prompts
+    ]
+    calls = {"n": 0}
+    real = spec._spec_should_yield
+
+    def yield_after_three():
+        calls["n"] += 1
+        return calls["n"] > 3 or real()
+
+    spec._spec_should_yield = yield_after_three
+    await spec.start()
+    try:
+        gens = [
+            await spec.submit(p, max_new_tokens=48) for p in prompts
+        ]
+        got = await asyncio.gather(*[_collect(g) for g in gens])
+    finally:
+        await spec.stop()
+    assert got[0] == refs[0]
+    assert got[1] == refs[1]
+    assert 0 < spec.spec_rounds <= 3, spec.spec_rounds
+
+
+async def test_batched_spec_joiner_integration_exact():
+    """Integration smoke: a joiner submitted mid-batch. Whether it
+    lands during the spec phase (phase yields before or between
+    rounds) or after, every stream must stay exact — the engine may
+    legitimately serve the whole thing without speculating if the
+    joiner arrives during the phase's first compiles."""
+    plain, spec = _engines()
+    prompts = ["abcabcab", "xyzxyz"]
+    refs = [
+        plain.generate_text(p, max_new_tokens=48)["token_ids"]
+        for p in prompts
+    ]
+    ref_j = plain.generate_text("qrs", max_new_tokens=6)["token_ids"]
+    await spec.start()
+    try:
+        gens = [
+            await spec.submit(p, max_new_tokens=48) for p in prompts
+        ]
+        first = await gens[0].queue.get()
+        joiner = await spec.submit("qrs", max_new_tokens=6)
+        got_j = await _collect(joiner)
+        got = [list(first["token_ids"]) + await _collect(gens[0]),
+               await _collect(gens[1])]
+    finally:
+        await spec.stop()
+    assert got[0] == refs[0]
+    assert got[1] == refs[1]
+    assert got_j == ref_j
+
+
+async def test_batched_spec_uneven_budgets_freeze_and_finish():
+    """Rows with very different budgets: the short row freezes as a
+    dummy while the long rows keep speculating; all exact."""
+    plain, spec = _engines()
+    specs = [("abcabcab", 30), ("xy", 3), ("hello wor", 21)]
+    refs = [
+        plain.generate_text(p, max_new_tokens=n)["token_ids"]
+        for p, n in specs
+    ]
+    await spec.start()
+    try:
+        gens = [
+            await spec.submit(p, max_new_tokens=n) for p, n in specs
+        ]
+        got = await asyncio.gather(*[_collect(g) for g in gens])
+    finally:
+        await spec.stop()
+    assert got == refs
+
+
+async def test_sampled_row_disables_batched_spec():
+    """A batch containing any sampled row must not speculate (greedy
+    exactness is the only batched contract); streams stay exact on
+    the plain chunk path."""
+    plain, spec = _engines()
+    ref_a = plain.generate_text("abcab", max_new_tokens=10)["token_ids"]
+    ref_b = plain.generate_text(
+        "xyz", max_new_tokens=10, temperature=0.8, seed=3
+    )["token_ids"]
+    await spec.start()
+    try:
+        g_a = await spec.submit("abcab", max_new_tokens=10)
+        g_b = await spec.submit(
+            "xyz", max_new_tokens=10, temperature=0.8, seed=3
+        )
+        got_a, got_b = await asyncio.gather(_collect(g_a), _collect(g_b))
+    finally:
+        await spec.stop()
+    assert got_a == ref_a
+    assert got_b == ref_b
+    assert spec.spec_rounds == 0, "mixed batch speculated"
