@@ -20,6 +20,7 @@ from mlapi_tpu.ops.speculative import (
     speculative_generate_batched,
     speculative_generate_fused,
     speculative_sample,
+    speculative_sample_batched,
     speculative_sample_fused,
 )
 
@@ -33,5 +34,6 @@ __all__ = [
     "speculative_generate_batched",
     "speculative_generate_fused",
     "speculative_sample",
+    "speculative_sample_batched",
     "speculative_sample_fused",
 ]

@@ -276,6 +276,21 @@ def _accept_and_draw(key, pr, q_probs, props, usable, step0):
     return m, final
 
 
+def _verify_pack_row(key, pr, q_probs, props, usable, step0):
+    """One row's accept/draw plus the packed output layout shared by
+    the solo and batched sampled verifies: ``[width + 1]`` = emitted
+    tokens (``[:m]`` accepted proposals, ``[m]`` the final draw, rest
+    garbage) then ``m``."""
+    width = props.shape[0] + 1
+    m, fin = _accept_and_draw(key, pr, q_probs, props, usable, step0)
+    out = jnp.where(
+        jnp.arange(width) < m,
+        jnp.concatenate([props, jnp.zeros((1,), jnp.int32)]),
+        fin,
+    )
+    return jnp.concatenate([out, m[None].astype(jnp.int32)])
+
+
 @functools.lru_cache(maxsize=32)
 def sample_verify_fn(model, width: int):
     """Jitted SAMPLED verify: the whole acceptance-rejection round on
@@ -310,13 +325,9 @@ def sample_verify_fn(model, width: int):
         wide = lambda x: jnp.broadcast_to(x, (width,))
         p = _warped_probs(lg, wide(temp[0]), wide(topk[0]), wide(topp[0]))
         key = jax.random.wrap_key_data(key_data[0])
-        m, last = _accept_and_draw(key, p, q_probs, props, usable, step0)
-        out = jnp.where(
-            jnp.arange(width) < m,
-            jnp.concatenate([props, jnp.zeros((1,), jnp.int32)]),
-            last,
+        return cache, _verify_pack_row(
+            key, p, q_probs, props, usable, step0
         )
-        return cache, jnp.concatenate([out, m[None].astype(jnp.int32)])
 
     return jax.jit(_run, donate_argnums=(1,))
 
@@ -504,6 +515,164 @@ def propose_batched_fn(model, k: int, sampled: bool = False):
         return cache, props, q
 
     return jax.jit(_run, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def sample_verify_batched_fn(model, width: int):
+    """Batched SAMPLED verify: one target block forward over every
+    row at its OWN cache position (``pos0 [B]``), then the shared
+    acceptance-rejection core (:func:`_accept_and_draw`) vmapped per
+    row with per-row keys, warps, budgets, and stream offsets.
+    Returns ``(cache, packed [B, width + 1])`` — per row the emitted
+    tokens then ``m`` (same layout as :func:`sample_verify_fn`)."""
+    k = width - 1
+
+    def _run(params, cache, tok0, props, pos0, n_pad, q_probs,
+             key_data, temps, topk, topp, step0, usable):
+        block = jnp.concatenate([tok0[:, None], props], axis=1)
+        cache, logits = model.extend_core(
+            params, cache, block, pos0, n_pad,
+            jnp.int32(0), jnp.int32(0), all_logits=True,
+        )
+
+        # Warp OUTSIDE the per-row vmap: under vmap the
+        # no-filter lax.cond would become a select and the two
+        # per-row sorts in the top-k/top-p filter would run even
+        # when disabled (the batch-wide `need` branch must survive).
+        bsz, w, v = logits.shape
+        pr_all = _warped_probs(
+            logits.reshape(bsz * w, v),
+            jnp.repeat(temps, w), jnp.repeat(topk, w),
+            jnp.repeat(topp, w),
+        ).reshape(bsz, w, v)
+        packed = jax.vmap(
+            lambda pr, kd, q, pr_, u, s0: _verify_pack_row(
+                jax.random.wrap_key_data(kd), pr, q, pr_, u, s0
+            )
+        )(pr_all, key_data, q_probs, props, usable, step0)
+        return cache, packed
+
+    return jax.jit(_run, donate_argnums=(1,))
+
+
+def speculative_sample_batched(
+    target,
+    t_params,
+    draft,
+    d_params,
+    prompt_ids,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seeds=None,
+) -> tuple[list[list[int]], SpecStats]:
+    """SAMPLED speculative generation for a WHOLE BATCH of rows, each
+    with its own PRNG stream (``seeds``: one per row, default
+    ``0..B-1``) and its own acceptance-driven cache position. Every
+    row's emitted stream is byte-identical to its solo
+    :func:`speculative_sample_fused` run (same tagged-stream
+    discipline, same ``usable = 0`` budget-capped rounds) and hence
+    exactly target-distributed for any draft. Same window-headroom
+    requirement as the greedy batched variant. ``temperature <= 0``
+    delegates to :func:`speculative_generate_batched`."""
+    if temperature <= 0.0:
+        return speculative_generate_batched(
+            target, t_params, draft, d_params, prompt_ids,
+            max_new_tokens=max_new_tokens, k=k,
+        )
+    b, p = prompt_ids.shape
+    if target.vocab_size != draft.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    n = int(max_new_tokens)
+    k = max(1, min(int(k), n))
+    total = p + n + k + 1
+    if total > target.max_positions or total > draft.max_positions:
+        raise ValueError(
+            f"batched speculation needs prompt + max_new_tokens + k + 1 "
+            f"(= {total}) cache slots within both model windows; use "
+            "speculative_sample per row near the window edge"
+        )
+    if seeds is None:
+        seeds = list(range(b))
+    if len(seeds) != b:
+        raise ValueError(f"need {b} seeds, got {len(seeds)}")
+
+    stats = SpecStats()
+    prompt_ids = jnp.asarray(prompt_ids)
+    zb = jnp.zeros((b,), jnp.int32)
+    keys = jnp.asarray(
+        np.stack([
+            np.asarray(jax.random.key_data(jax.random.key(int(s))))
+            for s in seeds
+        ])
+    )
+    temps = jnp.full((b,), temperature, jnp.float32)
+    topk_v = jnp.full((b,), top_k, jnp.int32)
+    topp_v = jnp.full((b,), top_p, jnp.float32)
+
+    from mlapi_tpu.models.gpt import prefill_fn
+
+    first, t_cache = prefill_fn(target, total)(
+        t_params, prompt_ids, keys, temps, zb, topk_v, topp_v,
+    )
+    _, d_cache = prefill_fn(draft, total)(
+        d_params, prompt_ids, keys, jnp.zeros((b,), jnp.float32), zb,
+        zb, jnp.ones((b,), jnp.float32),
+    )
+    first = np.asarray(first)
+
+    out = [[int(first[i])] for i in range(b)]
+    t_upto = np.full((b,), p, np.int64)
+    d_upto = np.full((b,), p, np.int64)
+    d_pend = [[int(first[i])] for i in range(b)]
+
+    while any(len(o) < n for o in out):
+        pend_buf = np.zeros((b, 2), np.int32)
+        n_in = np.ones((b,), np.int32)
+        step0 = np.zeros((b,), np.int32)
+        usable = np.zeros((b,), np.int32)
+        for i in range(b):
+            n_in[i] = len(d_pend[i])
+            pend_buf[i, : n_in[i]] = d_pend[i]
+            step0[i] = len(out[i])
+            usable[i] = max(0, min(k, n - len(out[i]) - 1))
+        d_cache, props, q_probs = propose_batched_fn(draft, k, True)(
+            d_params, d_cache, jnp.asarray(pend_buf),
+            jnp.asarray(n_in), jnp.asarray(d_upto.astype(np.int32)),
+            zb, keys, temps, topk_v, topp_v, jnp.asarray(step0),
+        )
+        d_upto += n_in + k - 1
+
+        tok0 = np.asarray([o[-1] for o in out], np.int32)
+        t_cache, packed = sample_verify_batched_fn(target, k + 1)(
+            t_params, t_cache, jnp.asarray(tok0), props,
+            jnp.asarray(t_upto.astype(np.int32)), zb, q_probs, keys,
+            temps, topk_v, topp_v, jnp.asarray(step0),
+            jnp.asarray(usable),
+        )
+        packed = np.asarray(packed)
+        stats.rounds += 1
+        for i in range(b):
+            budget = n - len(out[i])
+            if budget <= 0:
+                d_upto[i] = t_upto[i]
+                continue
+            m = int(packed[i, k + 1])
+            emitted = [int(t) for t in packed[i, : m + 1]]
+            out[i].extend(emitted)
+            stats.drafted += int(usable[i])
+            stats.accepted += m
+            stats.emitted += m + 1
+            t_upto[i] += m + 1
+            if m == k:
+                d_pend[i] = [int(packed[i, k - 1]), emitted[-1]]
+            else:
+                d_upto[i] = t_upto[i]
+                d_pend[i] = [emitted[-1]]
+    return [o[:n] for o in out], stats
 
 
 def speculative_generate_batched(

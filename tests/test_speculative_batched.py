@@ -128,6 +128,77 @@ def test_window_headroom_validated():
         )
 
 
+def test_sampled_batched_rows_match_fused_solo():
+    """Batched SAMPLED speculation: every row is byte-identical to
+    its solo fused-sampled run (same tagged-stream discipline, same
+    usable=0 budget-capped rounds) — per-row seeds, desynchronized
+    positions and all."""
+    from mlapi_tpu.ops.speculative import (
+        speculative_sample_batched,
+        speculative_sample_fused,
+    )
+
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompts = np.stack([
+        (np.arange(8, dtype=np.int32) % 200) + 3,
+        (np.arange(8, dtype=np.int32)[::-1] % 150) + 7,
+        (np.full(8, 31, dtype=np.int32)),
+    ])
+    n, k, temp, seeds = 17, 3, 0.9, [5, 11, 42]
+    refs = [
+        speculative_sample_fused(
+            target, tp, draft, dp, prompts[i][None],
+            max_new_tokens=n, k=k, temperature=temp, seed=seeds[i],
+        )[0]
+        for i in range(3)
+    ]
+    got, stats = speculative_sample_batched(
+        target, tp, draft, dp, prompts,
+        max_new_tokens=n, k=k, temperature=temp, seeds=seeds,
+    )
+    assert got == refs, stats
+    assert stats.rounds > 0
+
+
+def test_sampled_batched_draft_equals_target_accepts_all():
+    from mlapi_tpu.ops.speculative import speculative_sample_batched
+
+    target = get_model("gpt_lm", **T_CFG)
+    tp = target.init(jax.random.key(2))
+    prompts = np.stack([
+        (np.arange(6, dtype=np.int32) % 150) + 5,
+        (np.arange(6, dtype=np.int32) % 90) + 11,
+    ])
+    got, stats = speculative_sample_batched(
+        target, tp, target, tp, prompts,
+        max_new_tokens=16, k=4, temperature=0.8,
+        top_k=12, top_p=0.9, seeds=[1, 2],
+    )
+    assert all(len(g) == 16 for g in got)
+    assert stats.acceptance_rate == 1.0, stats
+
+
+def test_sampled_batched_greedy_delegates():
+    from mlapi_tpu.ops.speculative import speculative_sample_batched
+
+    target = get_model("gpt_lm", **T_CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompts = (np.arange(7, dtype=np.int32) % 150)[None] + 5
+    ref, _ = speculative_generate_batched(
+        target, tp, draft, dp, prompts, max_new_tokens=12, k=3,
+    )
+    got, _ = speculative_sample_batched(
+        target, tp, draft, dp, prompts,
+        max_new_tokens=12, k=3, temperature=0.0,
+    )
+    assert got == ref
+
+
 def test_uneven_finish_rows_ride_as_dummies():
     """All rows share max_new_tokens, but acceptance differences make
     rows REACH the budget at different rounds; late rows must finish
