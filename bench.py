@@ -52,6 +52,62 @@ FLOWER = {
     "petal_width": 0.2,
 }
 
+_TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_RESULTS.json"
+)
+
+
+def _load_tpu_cache() -> dict:
+    try:
+        with open(_TPU_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — a missing/corrupt cache is empty
+        return {"metrics": {}}
+
+
+def record_tpu_result(metric: str, result: dict) -> None:
+    """Persist an on-TPU measurement as the freshest hardware record
+    for ``metric`` (date-stamped, merged into ``TPU_RESULTS.json``).
+    Called after every bench run whose backend probed AND measured as
+    ``tpu`` — the cache is what keeps the driver artifact carrying
+    hardware truth across the chip's wedge windows."""
+    cache = _load_tpu_cache()
+    cache.setdefault("metrics", {})[metric] = {
+        "date": time.strftime("%Y-%m-%d", time.gmtime()),
+        **{k: result[k] for k in ("value", "unit", "vs_baseline")
+           if k in result},
+        "extras": result.get("extras", {}),
+        "source": "recorded by bench.py on the live chip",
+    }
+    cache["updated"] = time.strftime("%Y-%m-%d", time.gmtime())
+    try:
+        # Atomic replace: this file accumulates the on-TPU records
+        # across wedge windows — an interrupt mid-write must not
+        # truncate it (the harness SIGTERMs on timeouts routinely).
+        tmp = _TPU_CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, _TPU_CACHE_PATH)
+    except OSError:
+        pass
+
+
+def finish(result: dict) -> None:
+    """Print the bench's ONE JSON line, after (a) recording it as the
+    freshest hardware result when it ran on the chip, and (b) merging
+    the freshest recorded on-TPU row in as a structured ``last_tpu``
+    field when it did NOT — so a CPU-fallback artifact still carries
+    the best hardware numbers machine-readably, not as prose."""
+    backend = (result.get("extras") or {}).get("backend")
+    if backend == "tpu":
+        record_tpu_result(result["metric"], result)
+    else:
+        row = _load_tpu_cache().get("metrics", {}).get(result["metric"])
+        if row:
+            result["last_tpu"] = row
+    print(json.dumps(result))
+
 _PROBE_SRC = """
 import json, sys, time
 t0 = time.time()
@@ -231,28 +287,22 @@ def _choose_backend() -> tuple[dict | None, str | None, dict]:
                 f"({len(diag['attempts'])} attempts, see BENCH_DIAG.json); "
                 "measured on CPU fallback (same serving stack)"
             )
-            # The chip comes and goes (wedged r01-r02, alive the
-            # morning of r03, wedged again that afternoon). If real-
-            # TPU numbers were captured while it was up, point at
-            # them so a fallback run doesn't read as "never measured".
-            try:
-                with open(
-                    os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "BASELINE.json",
-                    )
-                ) as f:
-                    pub = json.load(f).get("published", {})
-                tpu_row = pub.get("serving_predict", {})
-                if tpu_row.get("backend") == "tpu":
-                    note += (
-                        "; most recent recorded on-TPU measurement: "
-                        f"{tpu_row.get('req_per_s_per_chip')} req/s/chip "
-                        f"(round {pub.get('round')}, {pub.get('date')} - "
-                        "BASELINE.json.published)"
-                    )
-            except Exception:  # noqa: BLE001 — the note is best-effort;
-                pass           # a malformed file must not kill the bench
+            # The chip comes and goes (wedge windows are the norm). A
+            # fallback run must not read as "never measured": the
+            # per-metric hardware record rides the output JSON as the
+            # structured `last_tpu` field (see ``finish``), sourced
+            # from TPU_RESULTS.json — the ONE place hardware truth is
+            # cached, so the note and the structured row cannot
+            # disagree.
+            row = _load_tpu_cache().get("metrics", {}).get(
+                "predict_requests_per_sec_per_chip"
+            )
+            if row:
+                note += (
+                    f"; freshest recorded on-TPU north star: "
+                    f"{row.get('value')} {row.get('unit', '')} "
+                    f"({row.get('date')} - TPU_RESULTS.json)"
+                )
     env = {}
     if probe is None or probe.get("backend") != "tpu":
         env["MLAPI_TPU_PLATFORM"] = "cpu"
@@ -446,8 +496,7 @@ def bench_generate() -> None:
             mixed_tokens / mixed_r.wall_seconds
             if mixed_r.wall_seconds else 0.0
         )
-        print(
-            json.dumps(
+        finish(
                 {
                     "metric": "generate_tokens_per_sec",
                     "value": round(batched_tps, 1),
@@ -493,7 +542,6 @@ def bench_generate() -> None:
                         or "vs_baseline here = batched/single speedup",
                     },
                 }
-            )
         )
     finally:
         server.send_signal(signal.SIGTERM)
@@ -556,8 +604,7 @@ def main() -> None:
             )
         else:
             note = "measured on CPU (same serving stack)"
-        print(
-            json.dumps(
+        finish(
                 {
                     "metric": "predict_requests_per_sec_per_chip",
                     "value": round(rps_per_chip, 1),
@@ -577,7 +624,6 @@ def main() -> None:
                         "note": note,
                     },
                 }
-            )
         )
     finally:
         server.send_signal(signal.SIGTERM)
@@ -713,7 +759,7 @@ print(json.dumps({{
                 f"{out.stderr[-1200:]}"
             )
         inner = json.loads(out.stdout.strip().splitlines()[-1])
-        print(json.dumps({
+        finish({
             "metric": "spec_single_stream_tokens_per_sec",
             "value": inner["fused_spec_tokens_per_s"],
             "unit": "tokens/s",
@@ -723,7 +769,7 @@ print(json.dumps({{
             ),
             "extras": {**inner, "backend": backend,
                        **({"note": note_extra} if note_extra else {})},
-        }))
+        })
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

@@ -226,6 +226,14 @@ def load_checkpoint(
     checkpoint trained on the TPU must load on a CPU-attached server
     (train-on-chip, serve-anywhere), and did not before this pinned
     the restore layout locally.
+
+    CONTRACT: this pin applies to every sharding-less leaf, including
+    the ``abstract_params=None`` path (abstracts built from checkpoint
+    metadata). Callers that want the save-time sharding back on a
+    multi-device topology — e.g. a model-parallel tree larger than one
+    device — must pass ``abstract_params`` with explicit shardings
+    (training resume does: it passes the live train-state layout);
+    relying on orbax's recorded sharding is no longer supported.
     """
     import jax
     import orbax.checkpoint as ocp

@@ -715,6 +715,52 @@ def _generate_fn(model, max_new_tokens: int):
 
 
 @functools.lru_cache(maxsize=64)
+def generate_tier_fn(model, tier: int):
+    """The whole single-row generation as ONE XLA program — prefill +
+    a ``lax.while_loop`` of cached decode steps writing into a
+    ``[tier]`` output buffer — with the actual budget ``n_actual <=
+    tier`` TRACED. One compile per (model, prompt bucket, tier)
+    serves every request budget in the tier, and through a high-RTT
+    attach (the tunneled chip pays ~one RTT per dispatch, chained or
+    not) a generation costs ONE dispatch + ONE readback instead of
+    one per chunk — the serving engine's batch-1 fast path.
+
+    ``(params, prompt_ids [1, P], key_data [1, ...], temps [1],
+    n_pad [1], top_k [1], top_p [1], n_actual scalar)`` →
+    ``tokens [tier]`` (first ``n_actual`` valid). The emitted stream
+    is byte-identical to the chunked engine path: same left-padded
+    prefill, same per-token ``_pick_token`` stream indices (first
+    token at 0, then 1, 2, ...).
+    """
+
+    def _run(params, prompt_ids, key_data, temps, n_pad, top_k, top_p,
+             n_actual):
+        p = prompt_ids.shape[1]
+        cache, logits = _prefill_core(
+            model, params, prompt_ids, n_pad, p + tier
+        )
+        first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
+        out = jnp.zeros((tier,), jnp.int32).at[0].set(first[0])
+
+        def cond(s):
+            return s[3] < n_actual
+
+        def body(s):
+            cache, tok, pos, i, out = s
+            logits, cache = model.decode_step(
+                params, cache, tok[:, None], pos, n_pad
+            )
+            nxt = _pick_token(temps, logits, key_data, i, top_k, top_p)
+            out = out.at[i].set(nxt[0])
+            return (cache, nxt, pos + 1, i + 1, out)
+
+        s = (cache, first, jnp.int32(p), jnp.int32(1), out)
+        return jax.lax.while_loop(cond, body, s)[4]
+
+    return jax.jit(_run)
+
+
+@functools.lru_cache(maxsize=64)
 def prefill_fn(model, total_len: int):
     """Jitted prefill + first-token program for incremental decoding:
     ``(params, prompt_ids [B,P], key_data, temps, n_pad)`` →

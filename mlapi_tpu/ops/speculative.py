@@ -63,10 +63,16 @@ import numpy as np
 # independent while all deriving from the ONE request key. Within a
 # tag, index = the emitted-token position it decides — each
 # output-affecting draw has a unique (tag, index) and is never reused
-# for a different role.
-_DRAFT_TAG = 101
-_ACC_TAG = 103
-_RES_TAG = 107
+# for a different role. The tags sit far above any reachable token
+# index (engine max_new_tokens tiers are << 2**30) so a tagged
+# namespace root can never collide with an untagged per-token
+# fold_in(key, token_index) drawn by the plain chunked decode path —
+# threefry fold_in and random-bits share one counter space, so a
+# collision would correlate draft/acceptance key material with an
+# emitted token's draw.
+_DRAFT_TAG = 1 << 30
+_ACC_TAG = (1 << 30) + 1
+_RES_TAG = (1 << 30) + 2
 
 
 @dataclass
@@ -790,7 +796,12 @@ def speculative_generate_batched(
     return [o[:n] for o in out], stats
 
 
-@functools.lru_cache(maxsize=16)
+# maxsize must dominate the serving engine's fused warm grid
+# (buckets x tiers x greedy/sampled — up to ~24 entries on a wide
+# config): an evicted entry would rebuild its jax.jit wrapper with an
+# EMPTY compile cache, and strict mode would then stall a request on
+# a remote recompile for a shape ``_warmed_fused`` claims is warm.
+@functools.lru_cache(maxsize=64)
 def fused_spec_fn(target, draft, p: int, n: int, k: int,
                   sampled: bool = False):
     """The ENTIRE speculative generation as ONE XLA program: target +
@@ -811,27 +822,36 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int,
     key discipline as the host-loop scheme, so the emitted stream
     keeps the exact target sampling distribution for any draft.
 
-    Compiled per ``(target, draft, prompt_len, n, k, sampled)``.
-    Requires window headroom ``p + n + k + 1 <= max_positions`` for
-    both models (rounds never need plain-step fallback: a budget-1
-    round emits exactly its final token via ``usable = 0``).
+    Compiled per ``(target, draft, prompt_width, n_tier, k,
+    sampled)``. ``p`` is the PROMPT WIDTH (a serving bucket: real
+    tokens right-aligned, ``n_pad`` left-pad slots masked — pass
+    zeros for an exact-length prompt) and ``n`` the OUTPUT TIER: the
+    jitted program additionally takes ``(n_pad [1] int32, n_actual
+    scalar int32)`` TRACED arguments and emits ``n_actual <= n``
+    tokens, so one compile per (bucket, tier) serves every request
+    budget — the serving engine's compile-count contract, honoured by
+    the fused path. Requires window headroom ``p + n + k + 1 <=
+    max_positions`` for both models (rounds never need plain-step
+    fallback: a budget-1 round emits exactly its final token via
+    ``usable = 0``).
 
-    Returns ``packed [n + 3]``: tokens then (rounds, accepted,
-    drafted).
+    Returns ``packed [n + 3]``: tokens (first ``n_actual`` valid)
+    then (rounds, accepted, drafted).
     """
     kw = k + 1
     total_t = total_d = p + n + k + 1
 
     def _run(t_params, d_params, prompt_ids, key_data, temps, topk,
-             topp):
+             topp, n_pad, n_actual):
         from mlapi_tpu.models.gpt import _pick_token
 
-        zb = jnp.zeros((1,), jnp.int32)
         key = jax.random.wrap_key_data(key_data[0])
         t_cache, t_logits = target.prefill_core(
-            t_params, prompt_ids, zb, total_t
+            t_params, prompt_ids, n_pad, total_t
         )
-        d_cache, _ = draft.prefill_core(d_params, prompt_ids, zb, total_d)
+        d_cache, _ = draft.prefill_core(
+            d_params, prompt_ids, n_pad, total_d
+        )
         if sampled:
             t0 = _pick_token(
                 temps, t_logits, key_data, 0, topk, topp
@@ -849,7 +869,7 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int,
             def dstep(carry, i):
                 d_cache, tok = carry
                 logits, d_cache = draft.decode_step(
-                    d_params, d_cache, tok[None, None], d_upto + i, zb
+                    d_params, d_cache, tok[None, None], d_upto + i, n_pad
                 )
                 if sampled:
                     probs = _warped_probs(logits, temps, topk, topp)
@@ -884,10 +904,10 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int,
             head = pend[n_pend - 1]
             block = jnp.concatenate([head[None], props])[None]
             t_cache, logits = target.extend_core(
-                t_params, t_cache, block, t_upto, zb,
+                t_params, t_cache, block, t_upto, n_pad,
                 jnp.int32(0), jnp.int32(0), all_logits=True,
             )
-            usable = jnp.minimum(k, n - n_out - 1)
+            usable = jnp.minimum(k, n_actual - n_out - 1)
             if sampled:
                 q_probs = qrows[j]                # [k, V]
                 wide = lambda x: jnp.broadcast_to(x, (kw,))
@@ -929,11 +949,11 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int,
             )
 
         def cond2(s):
-            return s[0][3] < n
+            return s[0][3] < n_actual
 
         def body2(s):
             core, rounds, accepted, drafted = s
-            usable = jnp.minimum(k, n - core[3] - 1)
+            usable = jnp.minimum(k, n_actual - core[3] - 1)
             nxt = body(core)
             emitted = nxt[3] - core[3]
             return (nxt, rounds + 1, accepted + emitted - 1,
@@ -979,7 +999,8 @@ def _fused_run(target, t_params, draft, d_params, prompt_ids,
     packed = np.asarray(
         fused_spec_fn(target, draft, p, n, k, sampled)(
             t_params, d_params, jnp.asarray(prompt_ids), key_data,
-            temps, topk, topp,
+            temps, topk, topp, jnp.zeros((1,), jnp.int32),
+            jnp.int32(n),
         )
     )
     stats = SpecStats(

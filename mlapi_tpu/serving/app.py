@@ -602,6 +602,10 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.spec_accepted"] = (
                 engine.spec_accepted
             )
+            snap["counters"]["generate.fused_calls"] = engine.fused_calls
+            snap["counters"]["generate.fused_spec_calls"] = (
+                engine.fused_spec_calls
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

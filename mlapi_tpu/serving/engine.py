@@ -531,6 +531,8 @@ class TextGenerationEngine:
         draft: tuple | None = None,
         spec_k: int = 4,
         spec_sample: bool = False,
+        fused_single: bool = True,
+        fused_max_new: int | None = None,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -567,6 +569,23 @@ class TextGenerationEngine:
         # (re-engagement shifts the draft's stream offsets) — hence a
         # deployment flag (--spec-sample), not a default.
         self.spec_sample = bool(spec_sample)
+        # Batch-1 fast path: a solo non-streaming request runs as ONE
+        # fused XLA program (prefill + whole decode loop — plus the
+        # draft rounds when speculating) instead of chunked dispatches.
+        # Through a high-RTT attach every dispatch costs ~one round
+        # trip whether or not its inputs are chained on device (the
+        # r03 measurements: chunked-chained 194 tok/s vs fused 861 on
+        # the tunneled chip — exactly one RTT per dispatch), so the
+        # only way to the single-stream RTT floor is one dispatch per
+        # GENERATION. ``fused_max_new`` caps the eligible budget —
+        # one fused run is one uninterruptible device program, so the
+        # cap bounds how long a joiner can wait behind it.
+        self.fused_single = bool(fused_single)
+        self.fused_max_new = int(
+            fused_max_new
+            if fused_max_new is not None
+            else max(64, default_max_new_tokens)
+        )
         self.model = model
         self.tokenizer = tokenizer
         self.mesh = mesh
@@ -655,7 +674,14 @@ class TextGenerationEngine:
         self.spec_rounds = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        self.fused_calls = 0
+        self.fused_spec_calls = 0
         self._warmed_spec: set = set()
+        # (bucket, tier, "plain"|"spec") fused single-stream programs
+        # proven compiled — strict mode takes the fast path only for
+        # these (an unwarmed fused shape falls back to the chunked
+        # programs rather than stalling on a remote compile).
+        self._warmed_fused: set = set()
         # Batch-resize (compaction) shapes proven compiled — in
         # strict non-eager mode a resize outside this set is skipped
         # (decode stays at full width) rather than compiled mid-batch.
@@ -970,7 +996,125 @@ class TextGenerationEngine:
     def _key_data(seed: int) -> np.ndarray:
         return np.asarray(jax.random.key_data(jax.random.key(seed)))
 
-    def _run_batch(self, reqs: list, admit: bool = False) -> None:
+    def _fused_tiers(self) -> list:
+        """The fused-program output-tier ladder, ascending: powers of
+        two (of ``chunk``) from the DEFAULT budget's tier up to the
+        ``fused_max_new`` cap's. The floor is the default tier because
+        ``n_actual`` is traced — the default-tier program already
+        serves every smaller budget, so smaller tiers would only
+        multiply compiles. ONE definition shared by the request path
+        (``_fused_single_run``) and the warm grid (``_warm_fused``):
+        strict mode silently falls back to chunked on a warm-set miss,
+        so the two must be tier-identical by construction."""
+        t = self.chunk
+        while t < self.default_max_new_tokens:
+            t *= 2
+        tiers = [t]
+        while t < self.fused_max_new:
+            t *= 2
+            tiers.append(t)
+        return tiers
+
+    def _fused_single_run(self, r, admit: bool) -> bool:
+        """Batch-1 fast path: run ``r``'s WHOLE generation as one XLA
+        program (``generate_tier_fn``, or ``fused_spec_fn`` with the
+        draft) — one dispatch + one readback, the single-stream RTT
+        floor through a tunneled attach. Returns ``False`` to fall
+        through to the chunked path: streaming consumers, prefix rows,
+        long (chunked-prefill) prompts, budgets past ``fused_max_new``,
+        unwarmed shapes in strict mode, and batches with staged
+        joiners all decode chunked exactly as before. The emitted
+        stream is byte-identical to the chunked path (same pads, same
+        per-token PRNG stream indices; greedy speculation is
+        argmax-exact), so which path served a request is invisible in
+        the response.
+
+        One fused run is one uninterruptible device program — a
+        request arriving mid-run waits for it (bounded by
+        ``fused_max_new``), the price of removing per-chunk
+        dispatches. Mirrors the host spec phase's yield discipline at
+        ENTRY instead: staged admission candidates suppress the fast
+        path entirely.
+        """
+        if admit:
+            with self._alock:
+                if self._admit or self._deferred:
+                    return False
+        bucket = len(r.row)
+        if bucket > self.prompt_buckets[-1]:
+            return False  # chunked-prefill territory
+        n_new = r.n_new
+        if n_new > self.fused_max_new:
+            return False
+        tier = next(t for t in self._fused_tiers() if t >= n_new)
+        greedy = (
+            r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
+        )
+        spec = self.draft_model is not None and (
+            greedy or (self.spec_sample and r.temperature > 0.0)
+        )
+        k = max(1, min(self.spec_k, tier))
+        if spec and (
+            bucket + tier + k + 1 > self.model.max_positions
+            or bucket + tier + k + 1 > self.draft_model.max_positions
+        ):
+            spec = False
+        if not spec and bucket + tier > self.model.max_positions:
+            return False
+        # Greedy and sampled speculation are DIFFERENT compiled
+        # programs (``sampled`` is static in ``fused_spec_fn``) —
+        # strict warm-gating must distinguish them.
+        kind = (
+            "plain" if not spec
+            else ("spec_sampled" if r.temperature > 0.0 else "spec")
+        )
+        if (
+            self._strict_admit
+            and (bucket, tier, kind) not in self._warmed_fused
+        ):
+            return False
+
+        from mlapi_tpu.models.gpt import generate_tier_fn
+
+        row = jnp.asarray(np.asarray(r.row)[None])
+        kd = jnp.asarray(self._key_data(r.seed)[None])
+        temps = jnp.asarray(np.asarray([r.temperature], np.float32))
+        topk = jnp.asarray(np.asarray([r.top_k], np.int32))
+        topp = jnp.asarray(np.asarray([r.top_p], np.float32))
+        n_pad = jnp.asarray(np.asarray([bucket - r.used], np.int32))
+        if spec:
+            from mlapi_tpu.ops.speculative import fused_spec_fn
+
+            packed = np.asarray(
+                fused_spec_fn(
+                    self.model, self.draft_model, bucket, tier, k,
+                    r.temperature > 0.0,
+                )(
+                    self.params, self.draft_params, row, kd, temps,
+                    topk, topp, n_pad, jnp.int32(n_new),
+                )
+            )
+            ids = packed[:n_new]
+            self.spec_rounds += int(packed[tier])
+            self.spec_accepted += int(packed[tier + 1])
+            self.spec_drafted += int(packed[tier + 2])
+            self.fused_spec_calls += 1
+        else:
+            ids = np.asarray(
+                generate_tier_fn(self.model, tier)(
+                    self.params, row, kd, temps, n_pad, topk, topp,
+                    jnp.int32(n_new),
+                )
+            )[:n_new]
+            self.fused_calls += 1
+        self._warmed_fused.add((bucket, tier, kind))
+        if not r.cancelled:
+            r.push({"token_ids": ids.tolist()})
+            r.push(None)
+        return True
+
+    def _run_batch(self, reqs: list, admit: bool = False,
+                   fused_ok: bool = True) -> None:
         """Decode one coalesced batch, streaming chunks to each
         request's queue; a ``None`` sentinel marks completion, an
         exception object marks failure.
@@ -1002,6 +1146,13 @@ class TextGenerationEngine:
 
         try:
             self.batch_calls += 1
+            if (
+                fused_ok and self.fused_single and len(reqs) == 1
+                and reqs[0].prefix_len == 0 and not reqs[0].stream
+                and not reqs[0].cancelled
+                and self._fused_single_run(reqs[0], admit)
+            ):
+                return
             bucket = max(len(r.row) for r in reqs)
             n_new_max = max(r.n_new for r in reqs)
             # The prefix region spans [0, p_len) of every row's cache.
@@ -2169,9 +2320,12 @@ class TextGenerationEngine:
         top_p: float = 1.0,
         prefix: str | None = None,
     ) -> dict:
-        """One prompt → generated continuation (text + ids), decoded
-        through the same chunked programs the batcher uses (so there
-        is exactly one decode implementation to trust)."""
+        """One prompt → generated continuation (text + ids), through
+        the same ``_run_batch`` the batcher uses — including its
+        batch-1 fused fast path (one XLA program per generation) when
+        eligible; pass ``fused_single=False`` at construction to pin
+        the chunked programs (e.g. when reproducing a chunked-path
+        decode bug)."""
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         req = self._encode(
             text, n_new, float(temperature), int(seed), None,
@@ -2245,10 +2399,15 @@ class TextGenerationEngine:
                         0.0, 0, None,
                     )
                     sinks.append(_SyncSink(req, []))
-                self._run_batch(sinks)
+                # fused_ok=False: the warm grid exists to compile the
+                # CHUNKED programs (prefill/decode/compaction); the
+                # fused fast path has its own grid below.
+                self._run_batch(sinks, fused_ok=False)
                 if sinks[0].error is not None:
                     raise sinks[0].error
                 shapes += 1
+        if self.fused_single:
+            shapes += self._warm_fused(full)
         if full:
             shapes += self._warm_admission(batches)
             if self.draft_model is not None:
@@ -2263,6 +2422,65 @@ class TextGenerationEngine:
             "chunk=%d",
             shapes, self.chunk,
         )
+
+    def _warm_fused(self, full: bool) -> int:
+        """Compile the batch-1 fused-generation grid off the request
+        path: per prompt bucket, the whole-generation program at the
+        default-``max_new_tokens`` tier and at the ``fused_max_new``
+        tier (one program serves every budget in a tier — ``n_actual``
+        is traced), plus the fused speculation program when a draft is
+        attached. Executed with ``n_actual=1`` so the warm run costs
+        one prefill + one loop iteration, not a full generation.
+        Populates ``_warmed_fused``, which strict mode requires."""
+        from mlapi_tpu.models.gpt import generate_tier_fn
+
+        tiers = self._fused_tiers()
+        buckets = self.prompt_buckets if full else self.prompt_buckets[:1]
+        kd = jnp.asarray(self._key_data(0)[None])
+        z1f = jnp.zeros((1,), jnp.float32)
+        z1i = jnp.zeros((1,), jnp.int32)
+        o1f = jnp.ones((1,), jnp.float32)
+        shapes = 0
+        for bucket in buckets:
+            row = jnp.asarray(
+                np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            )
+            n_pad = jnp.asarray(np.asarray([bucket - 1], np.int32))
+            for tier in sorted(tiers):
+                if bucket + tier <= self.model.max_positions:
+                    generate_tier_fn(self.model, tier)(
+                        self.params, row, kd, z1f, n_pad, z1i, o1f,
+                        jnp.int32(1),
+                    )
+                    self._warmed_fused.add((bucket, tier, "plain"))
+                    shapes += 1
+                if self.draft_model is None:
+                    continue
+                k = max(1, min(self.spec_k, tier))
+                if (
+                    bucket + tier + k + 1 <= self.model.max_positions
+                    and bucket + tier + k + 1
+                    <= self.draft_model.max_positions
+                ):
+                    from mlapi_tpu.ops.speculative import fused_spec_fn
+
+                    # Greedy speculation serves every engine; the
+                    # sampled variant is a SECOND program, warmed
+                    # only when --spec-sample can route to it.
+                    variants = [(False, "spec")]
+                    if self.spec_sample:
+                        variants.append((True, "spec_sampled"))
+                    for sampled, kind in variants:
+                        fused_spec_fn(
+                            self.model, self.draft_model, bucket,
+                            tier, k, sampled,
+                        )(
+                            self.params, self.draft_params, row, kd,
+                            z1f, z1i, o1f, n_pad, jnp.int32(1),
+                        )
+                        self._warmed_fused.add((bucket, tier, kind))
+                        shapes += 1
+        return shapes
 
     def _warm_spec(self) -> int:
         """Compile the speculative-phase programs (draft prefill, the
@@ -2348,8 +2566,11 @@ class TextGenerationEngine:
             from mlapi_tpu.models.gpt import realign_fn
             from mlapi_tpu.ops.speculative import propose_batched_fn
 
+            # No batch of size 2 can ever form when max_batch < 2 —
+            # skip the whole batched grid rather than paying its
+            # draft-prefill/propose/verify/realign compiles at startup.
             bsz = 2
-            while bsz <= max(
+            while self.max_batch > 1 and bsz <= max(
                 2, 1 << (self.max_batch - 1).bit_length()
             ):
                 bt = total  # the enclosing loop's tier

@@ -46,6 +46,10 @@ def _engine(**kw) -> TextGenerationEngine:
         model.init(jax.random.key(0)),
         tokenizer=ByteTokenizer(),
         chunk=2,  # many admission boundaries even for short runs
+        # These tests exercise the CHUNKED path's admission machinery;
+        # the batch-1 fused fast path would (correctly) serve the solo
+        # requests in one dispatch and never form a joinable batch.
+        fused_single=False,
         **kw,
     )
 

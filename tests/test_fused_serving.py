@@ -1,0 +1,201 @@
+"""The batch-1 fused fast path: a solo non-streaming /generate runs as
+ONE XLA program (``generate_tier_fn`` / ``fused_spec_fn``) and its
+output is byte-identical to the chunked path it replaces.
+
+This is the round-4 serving canary for the r03 library-only fused
+programs (VERDICT "Next" #1): the engine must match the library fused
+rate up to dispatch overhead, which on CPU reduces to "same tokens,
+one device program instead of many".
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=160,
+    compute_dtype="float32",
+)
+
+D_CFG = dict(CFG, hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    target = get_model("gpt_lm", **CFG)
+    draft = get_model("gpt_lm", **D_CFG)
+    return (
+        target, target.init(jax.random.key(0)),
+        draft, draft.init(jax.random.key(1)),
+    )
+
+
+def _engine(pair, *, fused=True, draft=False, **kw):
+    t, tp, d, dp = pair
+    return TextGenerationEngine(
+        t, tp, tokenizer=ByteTokenizer(), chunk=8,
+        draft=(d, dp) if draft else None,
+        fused_single=fused, **kw,
+    )
+
+
+PROMPT = "the quick brown fox"
+
+
+def test_fused_path_engages_and_matches_chunked(pair):
+    fused = _engine(pair)
+    chunked = _engine(pair, fused=False)
+    for kw in (
+        dict(max_new_tokens=20),                      # greedy, off-tier n
+        dict(max_new_tokens=8),                       # exactly one tier
+        dict(max_new_tokens=1),                       # prefill-only
+        dict(max_new_tokens=17, temperature=0.9, seed=5),
+        dict(max_new_tokens=17, temperature=0.8, top_k=12, top_p=0.9,
+             seed=3),
+    ):
+        a = fused.generate_text(PROMPT, **kw)
+        b = chunked.generate_text(PROMPT, **kw)
+        assert a["token_ids"] == b["token_ids"], kw
+    assert fused.fused_calls == 5
+    assert fused.chunk_calls == 0
+    assert chunked.fused_calls == 0
+    assert chunked.chunk_calls > 0
+
+
+def test_fused_spec_greedy_matches_plain(pair):
+    spec = _engine(pair, draft=True)
+    plain = _engine(pair, fused=False)
+    a = spec.generate_text(PROMPT, max_new_tokens=24)
+    b = plain.generate_text(PROMPT, max_new_tokens=24)
+    assert a["token_ids"] == b["token_ids"]
+    assert spec.fused_spec_calls == 1
+    assert spec.fused_calls == 0
+    assert spec.spec_rounds > 0
+    assert spec.spec_drafted > 0
+
+
+def test_fused_spec_sampled_matches_library(pair):
+    """A prompt that exactly fills its bucket (n_pad == 0) must emit
+    the library ``speculative_sample_fused`` stream verbatim — the
+    engine adds nothing but bucketing to the fused program."""
+    from mlapi_tpu.ops.speculative import speculative_sample_fused
+
+    t, tp, d, dp = pair
+    eng = _engine(pair, draft=True, spec_sample=True)
+    text = "x" * 16  # 16 one-byte tokens -> bucket 16, no pads
+    got = eng.generate_text(
+        text, max_new_tokens=16, temperature=0.7, seed=9,
+    )["token_ids"]
+    ids = np.asarray(
+        ByteTokenizer().token_ids(text), np.int32
+    )[None]
+    want, _ = speculative_sample_fused(
+        t, tp, d, dp, ids, max_new_tokens=16,
+        k=eng.spec_k, temperature=0.7, seed=9,
+    )
+    assert got == want
+    assert eng.fused_spec_calls == 1
+
+
+def test_fused_respects_budget_cap_and_falls_back(pair):
+    eng = _engine(pair, fused_max_new=16)
+    out = eng.generate_text(PROMPT, max_new_tokens=32)
+    assert len(out["token_ids"]) == 32
+    assert eng.fused_calls == 0          # over the cap -> chunked
+    assert eng.chunk_calls > 0
+    out = eng.generate_text(PROMPT, max_new_tokens=16)
+    assert eng.fused_calls == 1          # within the cap -> fused
+
+
+def test_strict_mode_requires_warmed_fused_shape(pair):
+    eng = _engine(pair)
+    eng._strict_admit = True             # tunnel discipline, no warmup
+    eng.generate_text(PROMPT, max_new_tokens=8)
+    assert eng.fused_calls == 0          # unwarmed shape -> chunked
+    eng._strict_admit = False
+    eng.generate_text(PROMPT, max_new_tokens=8)
+    assert eng.fused_calls == 1          # proves itself once allowed
+    eng._strict_admit = True
+    eng.generate_text(PROMPT, max_new_tokens=8)
+    assert eng.fused_calls == 2          # now warmed -> fused in strict
+
+
+def test_warmup_populates_fused_grid(pair):
+    eng = _engine(pair, draft=True)
+    eng.warmup(full=False)
+    # Minimal warmup covers the smallest bucket at both fused tiers.
+    assert any(k[2] == "plain" for k in eng._warmed_fused)
+    assert any(k[2] == "spec" for k in eng._warmed_fused)
+    eng._strict_admit = True
+    eng.generate_text("ab", max_new_tokens=8)
+    assert eng.fused_spec_calls == 1
+
+
+@pytest.mark.anyio
+async def test_staged_joiners_suppress_fused_path(pair):
+    """A collector batch (admit=True) with joiners already staged must
+    NOT take the fused path — one uninterruptible fused program would
+    strand the joiners for a whole generation. With the staging lists
+    empty the same batch runs fused."""
+    eng = _engine(pair)
+    loop = asyncio.get_running_loop()
+    req = eng._encode(PROMPT, 12, 0.0, 0, loop)
+    camper = eng._encode("xy", 2, 0.0, 1, loop)
+    with eng._alock:
+        eng._admit.append(camper)
+    await loop.run_in_executor(None, lambda: eng._run_batch([req], True))
+    assert eng.fused_calls == 0          # fell back to chunked
+    assert eng.chunk_calls > 0
+    # The camper was actually admitted into the running batch (it is
+    # compatible), so both got terminators.
+    assert eng.admitted == 1
+    for r in (req, camper):
+        items = []
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            items.append(item)
+        assert items
+    with eng._alock:
+        assert not eng._admit
+    req2 = eng._encode(PROMPT, 12, 0.0, 0, loop)
+    await loop.run_in_executor(None, lambda: eng._run_batch([req2], True))
+    assert eng.fused_calls == 1          # staging empty -> fused
+    while await req2.queue.get() is not None:
+        pass
+
+
+@pytest.mark.anyio
+async def test_streaming_requests_stay_chunked(pair):
+    eng = _engine(pair)
+    loop = asyncio.get_running_loop()
+    req = eng._encode(PROMPT, 12, 0.0, 0, loop, stream=True)
+    await loop.run_in_executor(None, eng._run_batch, [req])
+    chunks = []
+    while True:
+        item = await req.queue.get()
+        if item is None:
+            break
+        assert not isinstance(item, Exception), item
+        chunks.append(item["token_ids"])
+    assert eng.fused_calls == 0
+    assert len(chunks) > 1               # incremental delivery kept
+    ref = _engine(pair).generate_text(PROMPT, max_new_tokens=12)
+    assert [t for c in chunks for t in c] == ref["token_ids"]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -152,9 +152,15 @@ def _engines():
     tp = target.init(jax.random.key(0))
     dp = draft.init(jax.random.key(1))
     tok = ByteTokenizer()
-    plain = TextGenerationEngine(target, tp, tokenizer=tok, chunk=4)
+    # fused_single=False: these tests exercise the HOST spec phase and
+    # its admission handoff; the batch-1 fused fast path would serve
+    # the solo requests as one program and never run host rounds.
+    plain = TextGenerationEngine(
+        target, tp, tokenizer=tok, chunk=4, fused_single=False,
+    )
     spec = TextGenerationEngine(
         target, tp, tokenizer=tok, chunk=4, draft=(draft, dp), spec_k=3,
+        fused_single=False,
     )
     return plain, spec
 
