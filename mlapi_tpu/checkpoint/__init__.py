@@ -5,6 +5,7 @@ from mlapi_tpu.checkpoint.io import (  # noqa: F401
     gc_checkpoints,
     latest_step,
     load_checkpoint,
+    read_manifest,
     save_checkpoint,
     tree_signature,
 )

@@ -39,6 +39,18 @@ class TrainConfig:
 
     checkpoint_dir: str | None = None
 
+    # Knowledge distillation (drafts for speculative decoding): train
+    # against a teacher checkpoint's softened logits. ``distill_from``
+    # is a checkpoint path — usually given per-run via the CLI's
+    # ``--distill-from`` rather than baked into a preset. A preset
+    # designed AROUND distillation sets ``distill_required=True`` so
+    # running it without a teacher fails loudly instead of silently
+    # training a plain hard-label model under a "distilled" name.
+    distill_from: str | None = None
+    distill_temperature: float = 2.0
+    distill_alpha: float = 0.5
+    distill_required: bool = False
+
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
         d["mesh_shape"] = list(self.mesh_shape) if self.mesh_shape else None
@@ -211,7 +223,16 @@ register_preset(
     TrainConfig(
         name="sst2-bert",
         model="bert_classifier",
-        model_kwargs={"bert_preset": "bert-base-uncased", "num_classes": 2},
+        # attention_impl="flash": the in-house Pallas kernel. Full
+        # attention materializes [B, H, L, L] scores per layer — at
+        # batch 128 that is the dominant HBM traffic and why MFU FELL
+        # with batch size (0.503@32 -> 0.486@128, r03); the flash
+        # kernel keeps scores in VMEM tiles, so the flagship training
+        # config now exercises the kernel the repo built for it.
+        model_kwargs={
+            "bert_preset": "bert-base-uncased", "num_classes": 2,
+            "attention_impl": "flash",
+        },
         dataset="sst2",
         steps=3000,
         batch_size=32,
@@ -266,6 +287,35 @@ register_preset(
         optimizer="adamw",
         learning_rate=1e-3,
         eval_every=100,
+    )
+)
+
+# DISTILLED draft for docs-gpt: same serving-side contract as
+# docs-gpt-draft, but trained against the target's softened logits
+# (pass --distill-from <docs-gpt ckpt>). A hard-label draft agrees
+# with the target only where the data forces it; a distilled draft
+# matches the target's own distribution — the quantity speculative
+# acceptance actually tests — which is what moves acceptance (0.31-
+# 0.46 on the independent pair) toward useful territory.
+register_preset(
+    TrainConfig(
+        name="docs-gpt-draft-distilled",
+        model="gpt_lm",
+        model_kwargs={
+            "vocab_size": 260, "hidden_size": 48, "num_layers": 1,
+            "num_heads": 4, "max_positions": 256,
+            "compute_dtype": "float32",
+        },
+        dataset="docs_text",
+        dataset_kwargs={"seq_len": 128},
+        steps=600,
+        batch_size=64,
+        optimizer="adamw",
+        learning_rate=1e-3,
+        eval_every=200,
+        distill_temperature=2.0,
+        distill_alpha=0.1,  # mostly match the teacher, lightly ground
+        distill_required=True,
     )
 )
 

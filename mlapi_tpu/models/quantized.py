@@ -76,9 +76,17 @@ class QuantizedModel:
         return run_generate(self, params, prompt_ids, **kwargs)
 
     def param_shardings(self, layout=None):
-        raise NotImplementedError(
-            "quantized serving on a mesh is not supported yet: the "
-            "quantized tree's {'q','scale'} leaves do not match the "
-            "float param specs; serve quantized models single-chip or "
-            "load float params for mesh serving"
-        )
+        """The INNER model's TP layout, verbatim: placement
+        (``parallel.mesh.place_params``) maps each float leaf's spec
+        onto the quantized ``{"q", "scale"}`` pair — ``q`` takes the
+        float spec, per-channel ``scale`` keeps the channel axis's
+        placement — so ``--quantize int8`` composes with
+        ``--mesh-shape`` with no model-specific code."""
+        spec_fn = getattr(self.inner, "param_shardings", None)
+        if spec_fn is None:
+            raise NotImplementedError(
+                f"{type(self.inner).__name__} declares no param "
+                "shardings; quantized mesh serving needs the inner "
+                "model's TP layout"
+            )
+        return spec_fn(layout)

@@ -56,16 +56,37 @@ def place_params(params, mesh: Mesh, spec_tree=None):
     placement, ``jax.jit`` sees the shardings on its inputs and GSPMD
     partitions the whole step — gathers, all-to-alls, gradient
     reductions — with no further annotation.
+
+    Weight-only-quantized trees compose transparently: where a float
+    leaf became ``{"q": int8, "scale": f32}`` (``ops/quant.py``), the
+    float leaf's spec applies to ``q`` verbatim, and ``scale`` — whose
+    reduced axes have length 1 — keeps only the LAST axis's placement
+    (per-channel scales live on the channel axis; a length-1 axis
+    cannot shard). The dequantized product then carries exactly the
+    float layout, so every downstream program partitions identically.
     """
     if spec_tree is None:
         return replicate_for_mesh(params, mesh)
 
+    from mlapi_tpu.ops.quant import _is_quant_leaf
+
     def put(leaf, spec):
+        if _is_quant_leaf(leaf):
+            q, scale = leaf["q"], leaf["scale"]
+            full = tuple(spec) if spec is not None else ()
+            full = full + (None,) * (q.ndim - len(full))
+            sspec = P(
+                *((None,) * (scale.ndim - 1) + (full[q.ndim - 1],))
+            )
+            return {
+                "q": jax.device_put(q, NamedSharding(mesh, P(*full))),
+                "scale": jax.device_put(scale, NamedSharding(mesh, sspec)),
+            }
         return jax.device_put(
             leaf, NamedSharding(mesh, spec if spec is not None else P())
         )
 
-    return jax.tree.map(put, params, spec_tree)
+    return jax.tree.map(put, params, spec_tree, is_leaf=_is_quant_leaf)
 
 
 def params_for_model(model, params, mesh: Mesh, layout=None):

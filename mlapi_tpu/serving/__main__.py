@@ -151,6 +151,12 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--max-batch", str(args.max_batch)]
     if getattr(args, "quantize", None):
         cmd += ["--quantize", args.quantize]
+    if getattr(args, "mesh_shape", None):
+        cmd += ["--mesh-shape", args.mesh_shape]
+    if getattr(args, "draft_checkpoint", None):
+        cmd += ["--draft-checkpoint", args.draft_checkpoint]
+    if getattr(args, "spec_sample", False):
+        cmd += ["--spec-sample"]
     # systemd/docker stop the supervisor with SIGTERM; without a
     # handler the finally below never runs and the workers are
     # orphaned still bound to the port (SO_REUSEPORT would then let a
@@ -258,6 +264,14 @@ def main(argv=None) -> None:
              "byte-reproducible per seed (solo runs are)",
     )
     parser.add_argument(
+        "--mesh-shape", default=None,
+        help="serve sharded over a (data, model) device mesh, e.g. "
+             "'1,4' or '2,4' — params follow the model's declared TP "
+             "layout (classification AND generative engines; the "
+             "draft, if any, rides the same mesh). Shape must cover "
+             "the visible devices",
+    )
+    parser.add_argument(
         "--profiler-port", type=int, default=0,
         help="start a jax.profiler server on this port (XProf/TensorBoard "
              "can attach live)",
@@ -297,10 +311,42 @@ def main(argv=None) -> None:
                          "(every worker binds the same one)")
         sys.exit(_supervise_workers(args.workers, ckpt, args))
 
+    mesh = None
+    if args.mesh_shape:
+        import math
+
+        import jax
+
+        from mlapi_tpu.parallel import create_mesh
+
+        try:
+            shape = tuple(int(d) for d in args.mesh_shape.split(","))
+        except ValueError:
+            parser.error(
+                f"--mesh-shape {args.mesh_shape!r} is not a "
+                "comma-separated list of integers (e.g. '1,4')"
+            )
+        if not shape or any(d < 1 for d in shape):
+            parser.error(
+                f"--mesh-shape {args.mesh_shape!r}: every dimension "
+                "must be a positive integer"
+            )
+        need = math.prod(shape)
+        devices = jax.devices()
+        if need > len(devices):
+            parser.error(
+                f"--mesh-shape {args.mesh_shape} needs {need} devices; "
+                f"{len(devices)} visible"
+            )
+        # A shape smaller than the host's device count serves on the
+        # first `need` devices (e.g. a (1,4) TP mesh on an 8-device
+        # host) — the deployment decides the slice, not the host size.
+        mesh = create_mesh(shape, devices=devices[:need])
     engine = InferenceEngine.from_checkpoint(
         ckpt, quantize=args.quantize,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
+        mesh=mesh,
     )
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
     server = Server(app, host=args.host, port=args.port,

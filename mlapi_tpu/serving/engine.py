@@ -123,7 +123,10 @@ class InferenceEngine:
         weight-only per-channel int8 at load time and serves through
         the transparent :class:`~mlapi_tpu.models.quantized.QuantizedModel`
         wrapper — half the parameter HBM, dequantization fused into
-        each matmul inside the jitted programs. Single-chip only.
+        each matmul inside the jitted programs. Composes with
+        ``mesh``: the ``q`` leaves take the inner model's TP layout,
+        per-channel scales ride the channel axis
+        (``parallel.mesh.place_params``).
         """
         from mlapi_tpu.checkpoint import load_checkpoint
         from mlapi_tpu.models import get_model
@@ -153,11 +156,6 @@ class InferenceEngine:
         if quantize is not None:
             if quantize != "int8":
                 raise ValueError(f"unsupported quantize={quantize!r}")
-            if mesh is not None:
-                raise NotImplementedError(
-                    "quantized serving on a mesh is not supported; "
-                    "drop --quantize or serve single-chip"
-                )
             from mlapi_tpu.models.quantized import QuantizedModel
             from mlapi_tpu.ops.quant import quantize_tree, quantized_bytes
 
@@ -555,7 +553,19 @@ class TextGenerationEngine:
                     f"the target's ({model.max_positions})"
                 )
             self.draft_model = d_model
-            self.draft_params = jax.device_put(d_params)
+            if mesh is not None:
+                # The draft rides the same mesh as the target (its own
+                # declared TP layout): fused/host spec programs take
+                # BOTH param trees, and mixing a sharded target with a
+                # single-device draft would force GSPMD to reshard the
+                # draft on every dispatch.
+                from mlapi_tpu.parallel import params_for_model
+
+                self.draft_params = params_for_model(
+                    d_model, d_params, mesh
+                )
+            else:
+                self.draft_params = jax.device_put(d_params)
         else:
             self.draft_model = None
             self.draft_params = None

@@ -129,6 +129,9 @@ def run(
         profile_dir=profile_dir,
         debug_checks=debug_checks,
         init_params=init_params,
+        distill_from=cfg.distill_from,
+        distill_temperature=cfg.distill_temperature,
+        distill_alpha=cfg.distill_alpha,
     )
     _log.info(
         "%s: %d steps in %.2fs, final_loss=%.4f, test_accuracy=%s",
@@ -192,6 +195,11 @@ def main(argv=None) -> None:
         "--bench-steps", type=int, default=10,
         help="measured steps per preset in --bench mode",
     )
+    parser.add_argument(
+        "--bench-batch", type=int, default=None,
+        help="override the preset's batch size in --bench mode (MFU "
+             "sweeps: run once per batch size)",
+    )
     parser.add_argument("--out", help="checkpoint output dir")
     parser.add_argument(
         "--steps", type=int, default=None, help="override config steps"
@@ -231,6 +239,14 @@ def main(argv=None) -> None:
         help="seed training from this committed checkpoint's weights "
              "(full fine-tune, or the frozen base for --lora-rank)",
     )
+    parser.add_argument(
+        "--distill-from", default=None,
+        help="knowledge distillation: train against this checkpoint's "
+             "softened logits (teacher forward runs inside the jitted "
+             "step). The way to train a speculative-decoding draft "
+             "that matches its target — e.g. --preset "
+             "docs-gpt-draft-distilled --distill-from <docs-gpt ckpt>",
+    )
     args = parser.parse_args(argv)
 
     if args.bench:
@@ -243,17 +259,28 @@ def main(argv=None) -> None:
         else:
             targets = [p for p in DEFAULT_BENCH_PRESETS if p in preset_names()]
         for t in targets:
-            row = bench_train(t, bench_steps=args.bench_steps)
+            row = bench_train(
+                t, bench_steps=args.bench_steps,
+                batch_size=args.bench_batch,
+            )
             print(json.dumps(row))
         return
     if not args.preset and not args.config:
         parser.error("need --preset, --config, or --bench")
 
     cfg = get_preset(args.preset) if args.preset else TrainConfig.from_yaml(args.config)
-    if args.steps is not None:
-        import dataclasses
+    import dataclasses
 
+    if args.steps is not None:
         cfg = dataclasses.replace(cfg, steps=args.steps)
+    if args.distill_from is not None:
+        cfg = dataclasses.replace(cfg, distill_from=args.distill_from)
+    if cfg.distill_required and cfg.distill_from is None:
+        parser.error(
+            f"preset {cfg.name!r} is a DISTILLATION config: running it "
+            "without --distill-from <teacher checkpoint> would silently "
+            "train a plain hard-label model under a 'distilled' name"
+        )
 
     summary = run(
         cfg,

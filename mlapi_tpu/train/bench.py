@@ -41,10 +41,19 @@ _PEAK_FLOPS = {
     "TPU v6 lite": 918e12,   # v6e/Trillium
 }
 
+# Peak HBM bandwidth (bytes/s, per chip) — the roofline's other axis.
+_PEAK_BW = {
+    "TPU v5 lite": 819e9,    # v5e: 819 GB/s
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+}
 
-def _peak_for(device) -> float | None:
+
+def _peak_for(device, table=_PEAK_FLOPS) -> float | None:
     kind = getattr(device, "device_kind", "")
-    for name, peak in _PEAK_FLOPS.items():
+    for name, peak in table.items():
         if kind.startswith(name) or name.startswith(kind):
             return peak
     return None
@@ -74,7 +83,19 @@ def bench_train(
 
     cfg = get_preset(preset) if isinstance(preset, str) else preset
     splits = get_dataset(cfg.dataset, **cfg.dataset_kwargs)
-    model = get_model(cfg.model, **cfg.model_kwargs)
+    model_kwargs = dict(cfg.model_kwargs)
+    attn_fallback = False
+    if (
+        model_kwargs.get("attention_impl") == "flash"
+        and jax.default_backend() != "tpu"
+    ):
+        # Off the chip the flash kernel runs in the Pallas INTERPRETER
+        # — orders of magnitude slower than XLA:CPU and meaningless as
+        # a throughput canary. Bench full attention there; the real
+        # kernel is what the TPU run measures.
+        model_kwargs["attention_impl"] = "full"
+        attn_fallback = True
+    model = get_model(cfg.model, **model_kwargs)
     bs = batch_size or cfg.batch_size or min(256, len(splits.x_train))
 
     mesh = None
@@ -115,13 +136,22 @@ def bench_train(
     if mesh is not None:
         x, y = shard_batch_for_mesh((x, y), mesh)
 
-    # XLA's own flop count for the whole step (fwd + bwd + optimizer).
+    # XLA's own flop + byte counts for the whole step (fwd + bwd +
+    # optimizer). Bytes accessed is the roofline's other axis: with a
+    # measured step time, flops/peak vs bytes/bandwidth says which
+    # resource binds — the committed, quantitative basis for kernel
+    # decisions like SURVEY §7's "Pallas embedding gather only if
+    # profiling demands it" (criteo).
     flops = None
+    bytes_accessed = None
     try:
         cost = step_fn.lower(params, opt_state, x, y).compile().cost_analysis()
         if cost:
             cost = cost[0] if isinstance(cost, (list, tuple)) else cost
             flops = float(cost.get("flops", 0.0)) or None
+            bytes_accessed = (
+                float(cost.get("bytes accessed", 0.0)) or None
+            )
     except Exception:  # noqa: BLE001 — cost analysis is best-effort
         pass
 
@@ -161,6 +191,28 @@ def bench_train(
         if (flops and peak and jax.default_backend() == "tpu")
         else None
     )
+    # Roofline verdict: compare the step's FLOP time at peak MXU rate
+    # with its BYTE time at peak HBM bandwidth. Whichever dominates is
+    # the resource this program is bound by — the quantitative answer
+    # to "would a hand kernel help here" (a Pallas gather cannot beat
+    # the HBM roofline a memory-bound step already sits on).
+    bw = _peak_for(dev, _PEAK_BW)
+    roofline = None
+    if (
+        flops and bytes_accessed and peak and bw
+        and jax.default_backend() == "tpu"
+    ):
+        t_flops = flops / (peak * n_dev)
+        t_bytes = bytes_accessed / (bw * n_dev)
+        roofline = {
+            "t_flops_ms": round(t_flops * 1e3, 3),
+            "t_bytes_ms": round(t_bytes * 1e3, 3),
+            "bound": "memory" if t_bytes > t_flops else "compute",
+            "attained_bw_gb_s": round(
+                bytes_accessed / step_s / 1e9, 1
+            ),
+            "peak_bw_gb_s": round(bw * n_dev / 1e9, 1),
+        }
     return {
         "preset": cfg.name,
         "backend": jax.default_backend(),
@@ -171,9 +223,16 @@ def bench_train(
         "step_ms": round(step_s * 1e3, 3),
         "examples_per_s": round(bs / step_s, 1),
         "flops_per_step": flops,
+        "bytes_per_step": bytes_accessed,
         "tflops_per_s": round(flops / step_s / 1e12, 2) if flops else None,
         "mfu": mfu,
+        "roofline": roofline,
         "final_loss": final_loss,
+        **(
+            {"note": "flash attention benched as 'full' off-TPU "
+                     "(interpreter is not a throughput canary)"}
+            if attn_fallback else {}
+        ),
     }
 
 

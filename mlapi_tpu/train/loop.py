@@ -46,6 +46,9 @@ def make_train_step(
     weight_decay: float = 0.0,
     debug_checks: bool = False,
     task: str = "classify",
+    teacher: tuple | None = None,
+    distill_temperature: float = 2.0,
+    distill_alpha: float = 0.5,
 ) -> Callable:
     """Build a jit-compiled SGD step ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
@@ -58,6 +61,19 @@ def make_train_step(
     ``[B, L]`` id sequence as ``x``, targets are ``y`` shifted one
     left, pad positions (id 0) masked out of the loss).
 
+    ``teacher=(teacher_apply, teacher_params)`` enables knowledge
+    DISTILLATION (Hinton et al.): the loss becomes ``alpha * hard_CE
+    + (1 - alpha) * T^2 * KL(teacher_T || student_T)`` with both
+    distributions softened by ``distill_temperature``. The teacher
+    forward runs inside the same jitted step under ``stop_gradient``
+    (its params an undonated argument, re-passed each call), so
+    distilling costs one extra forward — no second program, no host
+    round trip. This is what trains a speculative-decoding DRAFT that
+    actually matches its target's distribution: a draft trained on
+    hard labels alone agrees with the target only where the data
+    does; a distilled draft matches the target's own probabilities,
+    which is the quantity acceptance sampling tests.
+
     ``debug_checks=True`` compiles the step through ``checkify`` with
     float checks (SURVEY §5 sanitizers row): NaN/inf produced anywhere
     inside the step — a grad, an optimizer moment, the loss — raises
@@ -67,20 +83,48 @@ def make_train_step(
     """
     if task not in ("classify", "lm"):
         raise ValueError(f"unknown task {task!r}")
+    t_apply, t_params = teacher if teacher is not None else (None, None)
 
-    def loss_fn(params, x, y):
+    def soft_kl(t_logits, s_logits):
+        """Per-position KL(teacher_T || student_T), both softened by
+        the distillation temperature — ONE definition for both tasks
+        (they differ only in how positions are masked/averaged)."""
+        t = distill_temperature
+        return jnp.sum(
+            jax.nn.softmax(t_logits / t)
+            * (jax.nn.log_softmax(t_logits / t)
+               - jax.nn.log_softmax(s_logits / t)),
+            axis=-1,
+        )
+
+    def blend(hard, soft):
+        t = distill_temperature
+        return distill_alpha * hard + (1.0 - distill_alpha) * (t * t) * soft
+
+    def loss_fn(params, x, y, tp):
         logits = apply_fn(params, x)
         if task == "lm":
             targets = y[:, 1:]
             keep = (targets != 0).astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(keep), 1.0)
+            s = logits[:, :-1]
             ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], targets
+                s, targets
             )
-            loss = jnp.sum(ce * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+            loss = jnp.sum(ce * keep) / denom
+            if t_apply is not None:
+                t_logits = jax.lax.stop_gradient(
+                    t_apply(tp, x)
+                )[:, :-1]
+                soft = jnp.sum(soft_kl(t_logits, s) * keep) / denom
+                loss = blend(loss, soft)
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
             ).mean()
+            if t_apply is not None:
+                t_logits = jax.lax.stop_gradient(t_apply(tp, x))
+                loss = blend(loss, soft_kl(t_logits, logits).mean())
         if weight_decay:
             # Penalise weight matrices only (ndim >= 2), never biases —
             # sklearn's LogisticRegression convention.
@@ -92,8 +136,8 @@ def make_train_step(
             loss = loss + 0.5 * weight_decay * l2
         return loss
 
-    def step(params, opt_state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    def step(params, opt_state, x, y, tp):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, tp)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -105,16 +149,27 @@ def make_train_step(
         # Donation shifts under checkify: the wrapped signature is the
         # same, but outputs gain the error prefix — jit still donates
         # the (params, opt_state) inputs safely.
-        jitted = jax.jit(checked, donate_argnums=(0, 1))
+        jitted_c = jax.jit(checked, donate_argnums=(0, 1))
 
         def checked_step(params, opt_state, x, y):
-            err, out = jitted(params, opt_state, x, y)
+            err, out = jitted_c(params, opt_state, x, y, t_params)
             checkify.check_error(err)  # throws with the first bad op
             return out
 
         return checked_step
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+
+    def run_step(params, opt_state, x, y):
+        # Teacher params ride as an ordinary (undonated) argument —
+        # NOT a closure constant, which would bake the whole teacher
+        # tree into the executable as literals.
+        return jitted(params, opt_state, x, y, t_params)
+
+    # The bench introspects the compiled program (cost_analysis);
+    # keep a .lower that binds the teacher like a call does.
+    run_step.lower = lambda p, o, x, y: jitted.lower(p, o, x, y, t_params)
+    return run_step
 
 
 @functools.lru_cache(maxsize=64)
@@ -308,6 +363,9 @@ def fit(
     debug_checks: bool = False,
     task: str = "auto",
     init_params=None,
+    distill_from: str | None = None,
+    distill_temperature: float = 2.0,
+    distill_alpha: float = 0.5,
 ) -> TrainResult:
     """Train ``model`` on ``splits``.
 
@@ -376,6 +434,25 @@ def fit(
     # The hyperparameters that define the optimisation trajectory; a
     # resumed run must match them exactly (steps may grow — extending
     # a finished run is legitimate).
+    # Knowledge distillation: load the teacher once, place it like the
+    # student (same mesh), and hand its (apply, params) to the step.
+    teacher = None
+    teacher_hash = None
+    if distill_from is not None:
+        from mlapi_tpu.checkpoint import load_checkpoint, read_manifest
+        from mlapi_tpu.models import get_model as _get_model
+
+        t_meta = read_manifest(distill_from)
+        t_model = _get_model(
+            t_meta.config["model"], **t_meta.config.get("model_kwargs", {})
+        )
+        t_abstract = jax.eval_shape(lambda: t_model.init(jax.random.key(0)))
+        t_params, t_meta = load_checkpoint(distill_from, t_abstract)
+        if mesh is not None:
+            t_params = params_for_model(t_model, t_params, mesh)
+        teacher = (t_model.apply, t_params)
+        teacher_hash = t_meta.config_hash
+
     run_config = {
         "optimizer": optimizer,
         "learning_rate": learning_rate,
@@ -383,6 +460,17 @@ def fit(
         "batch_size": batch_size,
         "seed": seed,
         "task": task,
+        # The distillation target defines the optimisation trajectory
+        # as much as the optimizer does — a resume must match it.
+        **(
+            {
+                "distill_from_hash": teacher_hash,
+                "distill_temperature": distill_temperature,
+                "distill_alpha": distill_alpha,
+            }
+            if teacher is not None
+            else {}
+        ),
     }
 
     start_step = 0
@@ -399,7 +487,9 @@ def fit(
 
     step_fn = make_train_step(
         model.apply, tx, weight_decay=weight_decay,
-        debug_checks=debug_checks, task=task,
+        debug_checks=debug_checks, task=task, teacher=teacher,
+        distill_temperature=distill_temperature,
+        distill_alpha=distill_alpha,
     )
 
     def eval_fn(p):

@@ -82,3 +82,14 @@ def mesh_2x4():
     from mlapi_tpu.parallel import create_mesh
 
     return create_mesh((2, 4))
+
+
+@pytest.fixture(scope="session")
+def mesh_1x4():
+    """A (data=1, model=4) mesh — pure TP, the generative-serving
+    decode layout (batch stays whole; params split over `model`)."""
+    import jax as _jax
+
+    from mlapi_tpu.parallel import create_mesh
+
+    return create_mesh((1, 4), devices=_jax.devices()[:4])

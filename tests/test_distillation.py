@@ -1,0 +1,132 @@
+"""Knowledge distillation (r03 VERDICT "Next" #5): the train step can
+learn against a teacher checkpoint's softened logits — the mechanism
+that turns an independently-trained speculative-decoding draft into
+one that matches its target's distribution."""
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.checkpoint import save_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.train import fit
+
+CFG = dict(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=2,
+    max_positions=64,
+    compute_dtype="float32",
+)
+D_CFG = dict(CFG, hidden_size=16, num_layers=1)
+
+
+class _LmSplits:
+    """Tiny in-memory LM dataset (x == y, next-token objective)."""
+
+    def __init__(self, n=256, L=24, seed=0):
+        rng = np.random.default_rng(seed)
+        # A learnable-but-stochastic pattern: arithmetic sequences mod
+        # vocab with 20% random corruption, so the teacher's learned
+        # distribution is soft (distillation has something to transfer
+        # beyond the hard labels).
+        starts = rng.integers(1, 40, size=(n, 1))
+        x = ((starts + np.arange(L)) % 60 + 1).astype(np.int32)
+        noise = rng.integers(1, 61, size=x.shape).astype(np.int32)
+        x = np.where(rng.random(x.shape) < 0.2, noise, x)
+        self.x_train = x
+        self.y_train = self.x_train
+        self.x_test = self.x_train[:32]
+        self.y_test = self.x_test
+        self.feature_names = ()
+        self.vocab = None
+        self.source = "synthetic"
+        self.extras = {"task": "lm"}
+
+
+@pytest.fixture(scope="module")
+def teacher_checkpoint(tmp_path_factory):
+    model = get_model("gpt_lm", **CFG)
+    r = fit(model, _LmSplits(), steps=120, batch_size=64,
+            learning_rate=3e-3, optimizer="adamw")
+    ck = tmp_path_factory.mktemp("teacher") / "ck"
+    save_checkpoint(
+        ck, r.params, step=120,
+        config={"model": "gpt_lm", "model_kwargs": CFG},
+    )
+    return ck
+
+
+def _mean_kl(teacher, tp, student, sp, x):
+    tl = np.asarray(jax.nn.log_softmax(teacher.apply(tp, x)))
+    sl = np.asarray(jax.nn.log_softmax(student.apply(sp, x)))
+    return float(np.mean(np.sum(np.exp(tl) * (tl - sl), axis=-1)))
+
+
+def test_distilled_student_matches_teacher_better(teacher_checkpoint):
+    from mlapi_tpu.checkpoint import load_checkpoint
+
+    splits = _LmSplits()
+    student = get_model("gpt_lm", **D_CFG)
+    ind = fit(student, splits, steps=120, batch_size=64,
+              learning_rate=3e-3, optimizer="adamw")
+    # alpha=0, T=1: the objective IS the measured KL-to-teacher, so
+    # the comparison below tests the mechanism, not a tuning choice.
+    dist = fit(student, splits, steps=240, batch_size=64,
+               learning_rate=3e-3, optimizer="adamw",
+               distill_from=str(teacher_checkpoint),
+               distill_temperature=1.0, distill_alpha=0.0)
+    teacher = get_model("gpt_lm", **CFG)
+    tp, _ = load_checkpoint(teacher_checkpoint)
+    x = splits.x_test
+    kl_ind = _mean_kl(teacher, tp, student, ind.params, x)
+    kl_dist = _mean_kl(teacher, tp, student, dist.params, x)
+    # The distillation objective IS KL-to-teacher: the distilled
+    # student must be measurably closer than the hard-label one.
+    assert kl_dist < 0.8 * kl_ind, (kl_dist, kl_ind)
+    assert np.isfinite(dist.final_loss)
+
+
+def test_distill_resume_config_guard(teacher_checkpoint, tmp_path):
+    """A distilled run's train-state records the teacher; resuming the
+    same run works, and the recorded config carries the distillation
+    fields (the trajectory-defining hyperparameters)."""
+    import json
+
+    splits = _LmSplits()
+    student = get_model("gpt_lm", **D_CFG)
+    ckdir = tmp_path / "state"
+    fit(student, splits, steps=40, batch_size=64, learning_rate=3e-3,
+        optimizer="adamw", distill_from=str(teacher_checkpoint),
+        checkpoint_dir=str(ckdir), save_every=20, async_save=False)
+    steps = sorted(ckdir.glob("step_*/MANIFEST.json"))
+    assert steps
+    cfg = json.loads(steps[-1].read_text())["config"]
+    assert "distill_from_hash" in cfg
+    assert cfg["distill_temperature"] == 2.0
+    # Resume past the saved step with the same distillation setup.
+    r = fit(student, splits, steps=60, batch_size=64,
+            learning_rate=3e-3, optimizer="adamw",
+            distill_from=str(teacher_checkpoint),
+            checkpoint_dir=str(ckdir), save_every=20, async_save=False)
+    assert r.steps == 60
+
+
+def test_distill_cli_flag(tmp_path, monkeypatch):
+    """--distill-from plumbs through the train CLI (teacher and
+    student must share a vocab, so train a 3-step docs-gpt teacher)."""
+    from mlapi_tpu.train.__main__ import main
+
+    teacher_out = tmp_path / "teacher"
+    out = tmp_path / "draft"
+    monkeypatch.setenv("MLAPI_TPU_PLATFORM", "cpu")
+    main(["--preset", "docs-gpt", "--steps", "3",
+          "--out", str(teacher_out)])
+    main([
+        "--preset", "docs-gpt-draft-distilled",
+        "--steps", "3",
+        "--distill-from", str(teacher_out),
+        "--out", str(out),
+    ])
+    assert (out / "MANIFEST.json").exists()

@@ -165,14 +165,59 @@ def test_from_checkpoint_quantized_tabular_routes_correctly(tmp_path):
     assert labels[0].startswith("Iris-")
 
 
-def test_quantized_mesh_serving_refused(gpt_checkpoint):
-    mesh = jax.sharding.Mesh(
-        np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model")
+def test_quantized_mesh_serving(gpt_checkpoint, mesh_1x4):
+    """--quantize int8 composes with --mesh-shape (r03 VERDICT missing
+    #4): q leaves carry the float TP layout, per-channel scales ride
+    the channel axis, and the streams are byte-identical to the
+    single-chip quantized engine."""
+    eng = InferenceEngine.from_checkpoint(
+        gpt_checkpoint, quantize="int8", mesh=mesh_1x4
     )
-    with pytest.raises(NotImplementedError, match="mesh"):
-        InferenceEngine.from_checkpoint(
-            gpt_checkpoint, quantize="int8", mesh=mesh
-        )
+    # Only leaves >= MIN_QUANT_SIZE quantize; at this tiny config that
+    # is the embedding table. Its q carries the float vocab-sharded
+    # spec; its per-channel scale (hidden axis, unsharded here) is
+    # replicated.
+    wte = eng.params["wte"]
+    assert set(wte) == {"q", "scale"}
+    assert "model" in tuple(wte["q"].sharding.spec), wte["q"].sharding
+    assert all(s is None for s in tuple(wte["scale"].sharding.spec))
+    local = InferenceEngine.from_checkpoint(gpt_checkpoint, quantize="int8")
+    a = eng.generate_text("hello world", max_new_tokens=8)
+    b = local.generate_text("hello world", max_new_tokens=8)
+    assert a["token_ids"] == b["token_ids"]
+
+
+def test_place_params_shards_channel_scale(mesh_1x4):
+    """A column-sharded quantized kernel: q takes the float spec and
+    the per-channel scale shards the SAME channel axis, so the
+    dequantized product keeps the float TP layout."""
+    from jax.sharding import PartitionSpec as P
+
+    from mlapi_tpu.ops.quant import quantize_tree
+    from mlapi_tpu.parallel.mesh import place_params
+
+    tree = {"kernel": np.ones((64, 128), np.float32)}
+    qt = quantize_tree(tree, min_size=1)
+    placed = place_params(qt, mesh_1x4, {"kernel": P(None, "model")})
+    k = placed["kernel"]
+    assert tuple(k["q"].sharding.spec) == (None, "model")
+    assert tuple(k["scale"].sharding.spec) == (None, "model")
+    # Row-sharded: the channel axis is unsharded -> scale replicated.
+    placed = place_params(qt, mesh_1x4, {"kernel": P("model", None)})
+    k = placed["kernel"]
+    assert tuple(k["q"].sharding.spec) == ("model", None)
+    assert all(s is None for s in tuple(k["scale"].sharding.spec))
+
+
+def test_quantized_mesh_refused_without_layout(mesh_1x4, tmp_path):
+    """A model with no declared TP layout still refuses loudly."""
+    from mlapi_tpu.models.quantized import QuantizedModel
+
+    class NoLayout:
+        pass
+
+    with pytest.raises(NotImplementedError, match="param"):
+        QuantizedModel(NoLayout()).param_shardings()
 
 
 def test_bad_quantize_value_rejected(gpt_checkpoint):
