@@ -243,6 +243,30 @@ register_preset(
     )
 )
 
+# Config-5 real-data proxy: BERT text classification on 100% real
+# local prose (repo docs windows labeled by source file — see
+# datasets/docs_clf.py). Same task shape as SST-2, every byte real;
+# the residual gap (pretrained weights + GLUE labels) is what
+# --from-hf closes when a local HF checkpoint exists.
+register_preset(
+    TrainConfig(
+        name="docsclf-bert",
+        model="bert_classifier",
+        model_kwargs={
+            "vocab_size": 260, "hidden_size": 64, "num_layers": 2,
+            "num_heads": 4, "intermediate_size": 128,
+            "max_positions": 128, "num_classes": 4,
+        },
+        dataset="docs_clf",
+        dataset_kwargs={"seq_len": 128},
+        steps=300,
+        batch_size=64,
+        optimizer="adamw",
+        learning_rate=1e-3,
+        eval_every=100,
+    )
+)
+
 # Decoder-family LM presets: next-token training on the repo's own
 # documentation (datasets/textlm.py — real English prose, zero-egress),
 # producing checkpoints that serve via /generate. These demonstrate the

@@ -58,6 +58,13 @@ def get_dataset(name: str, **kwargs) -> SupervisedSplits:
     return _LOADERS.get(name)(**kwargs)
 
 
+def get_dataset_loader(name: str):
+    """The registered loader CALLABLE (callers introspect its
+    signature — e.g. the train CLI only injects a ``tokenizer``
+    kwarg into loaders that declare one)."""
+    return _LOADERS.get(name)
+
+
 def dataset_registered(name: str) -> bool:
     return name in _LOADERS
 
@@ -80,3 +87,4 @@ from mlapi_tpu.datasets.criteo import load_criteo  # noqa: E402,F401  (self-regi
 from mlapi_tpu.datasets.digits import load_digits  # noqa: E402,F401  (self-registers)
 from mlapi_tpu.datasets.sst2 import load_sst2  # noqa: E402,F401  (self-registers)
 from mlapi_tpu.datasets.textlm import load_docs_text  # noqa: E402,F401  (self-registers)
+from mlapi_tpu.datasets.docs_clf import load_docs_clf  # noqa: E402,F401  (self-registers)

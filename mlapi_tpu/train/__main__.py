@@ -34,6 +34,7 @@ def run(
     debug_checks: bool = False,
     lora_rank: int = 0,
     init_from: str | None = None,
+    from_hf: str | None = None,
 ) -> dict:
     import jax
 
@@ -45,7 +46,46 @@ def run(
 
     initialize_from_env()  # multi-host no-op on a single host
 
-    splits = get_dataset(cfg.dataset, **cfg.dataset_kwargs)
+    if from_hf and init_from:
+        raise ValueError(
+            "--from-hf and --init-from both seed the initial weights; "
+            "pass exactly one"
+        )
+    dataset_kwargs = dict(cfg.dataset_kwargs)
+    if from_hf:
+        # Config-5 readiness: the tokenizer must be the HF dir's OWN
+        # WordPiece vocab, or fine-tuned embeddings see the wrong ids.
+        # Only datasets whose loader takes a ``tokenizer`` kwarg (the
+        # text-classification ones, e.g. sst2) can honour it.
+        import inspect
+        from pathlib import Path
+
+        from mlapi_tpu.datasets import get_dataset_loader
+
+        vocab_file = Path(from_hf) / "vocab.txt"
+        takes_tokenizer = "tokenizer" in inspect.signature(
+            get_dataset_loader(cfg.dataset)
+        ).parameters
+        if vocab_file.exists() and takes_tokenizer:
+            from mlapi_tpu.text.tokenizer import WordPieceTokenizer
+
+            dataset_kwargs["tokenizer"] = (
+                WordPieceTokenizer.from_vocab_file(vocab_file)
+            )
+            _log.info("tokenizing with %s", vocab_file)
+        elif vocab_file.exists():
+            _log.warning(
+                "dataset %r does not accept a tokenizer; %s is "
+                "ignored and ids may not match the pretrained "
+                "embeddings", cfg.dataset, vocab_file,
+            )
+        else:
+            _log.warning(
+                "%s has no vocab.txt; falling back to the default "
+                "tokenizer — ids may not match the pretrained "
+                "embeddings", from_hf,
+            )
+    splits = get_dataset(cfg.dataset, **dataset_kwargs)
     if splits.source == "synthetic":
         _log.warning(
             "dataset %r is a synthetic stand-in (real files not present); "
@@ -54,6 +94,23 @@ def run(
         )
     model = get_model(cfg.model, **cfg.model_kwargs)
     init_params = None
+    if from_hf:
+        # Fine-tune from a LOCAL HuggingFace torch checkpoint
+        # (zero-egress: local_files_only — this is the path that runs
+        # real config 5 the moment bert-base-uncased weights land on
+        # disk). Conversion is params_from_hf_torch, logit-parity-
+        # tested against the torch reference in tests/test_bert.py.
+        from transformers import BertForSequenceClassification
+
+        from mlapi_tpu.models.bert import params_from_hf_torch
+
+        tm = BertForSequenceClassification.from_pretrained(
+            from_hf, local_files_only=True,
+            num_labels=len(splits.vocab.labels) or 2,
+        )
+        init_params = params_from_hf_torch(tm, model)
+        del tm
+        _log.info("initialised from HF torch checkpoint %s", from_hf)
     if init_from:
         # Fine-tune from an existing checkpoint (the model config must
         # match — the tree-signature check inside load_checkpoint
@@ -240,6 +297,15 @@ def main(argv=None) -> None:
              "(full fine-tune, or the frozen base for --lora-rank)",
     )
     parser.add_argument(
+        "--from-hf", default=None,
+        help="fine-tune from a LOCAL HuggingFace torch BERT "
+             "checkpoint dir (config.json + weights [+ vocab.txt, "
+             "used for tokenization]); zero-egress — the dir must "
+             "already be on disk. This is the real-config-5 path: "
+             "--preset sst2-bert --from-hf <bert-base-uncased dir> "
+             "with real SST-2 TSVs in $MLAPI_TPU_DATA_DIR/sst2/",
+    )
+    parser.add_argument(
         "--distill-from", default=None,
         help="knowledge distillation: train against this checkpoint's "
              "softened logits (teacher forward runs inside the jitted "
@@ -292,6 +358,7 @@ def main(argv=None) -> None:
         debug_checks=args.debug_checks,
         lora_rank=args.lora_rank,
         init_from=args.init_from,
+        from_hf=args.from_hf,
     )
     print(json.dumps(summary))
 

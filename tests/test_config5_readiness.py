@@ -1,0 +1,116 @@
+"""Config-5 readiness (VERDICT r03 "Next" #9): the --from-hf path that
+fine-tunes a LOCAL HuggingFace BERT checkpoint (tested here with tiny
+synthetic stand-ins — the real bert-base run needs only the weights on
+disk), and the docs_clf real-data classification proxy."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mlapi_tpu.datasets import get_dataset
+
+
+def _tiny_hf_checkpoint(path):
+    from transformers import BertConfig, BertForSequenceClassification
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, num_labels=2,
+    )
+    m = BertForSequenceClassification(cfg)
+    m.save_pretrained(path)
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += [f"tok{i}" for i in range(64 - len(vocab))]
+    (path / "vocab.txt").write_text("\n".join(vocab))
+    return m
+
+
+TINY_KW = dict(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+    intermediate_size=64, max_positions=64, num_classes=2,
+)
+
+
+def test_from_hf_cli_initialises_from_torch_weights(tmp_path, monkeypatch):
+    """--from-hf: tokenize with the dir's vocab.txt, convert the torch
+    weights, fine-tune, save. With a near-zero LR the saved embedding
+    must still BE the HF embedding — proof the init was used."""
+    import yaml
+
+    from mlapi_tpu.checkpoint import load_checkpoint
+    from mlapi_tpu.train.__main__ import main
+
+    monkeypatch.setenv("MLAPI_TPU_PLATFORM", "cpu")
+    hf_dir = tmp_path / "hf"
+    hf_dir.mkdir()
+    tm = _tiny_hf_checkpoint(hf_dir)
+
+    cfg = {
+        "name": "tiny-hf-sst2",
+        "model": "bert_classifier",
+        "model_kwargs": TINY_KW,
+        "dataset": "sst2",
+        "dataset_kwargs": {"max_len": 32, "n_train": 64, "n_test": 16},
+        "steps": 2,
+        "batch_size": 16,
+        "optimizer": "adamw",
+        "learning_rate": 1e-9,
+    }
+    ycfg = tmp_path / "cfg.yaml"
+    ycfg.write_text(yaml.safe_dump(cfg))
+    out = tmp_path / "ck"
+    main(["--config", str(ycfg), "--from-hf", str(hf_dir),
+          "--out", str(out)])
+    assert (out / "MANIFEST.json").exists()
+
+    params, meta = load_checkpoint(out)
+    want = tm.state_dict()["bert.embeddings.word_embeddings.weight"]
+    np.testing.assert_allclose(
+        np.asarray(params["embeddings"]["word"]),
+        want.detach().numpy(), atol=1e-4,
+    )
+    # The checkpoint records the HF dir's WordPiece tokenizer, so
+    # serving encodes exactly as training did.
+    assert meta.config["tokenizer"]["kind"] == "wordpiece"
+
+
+def test_docs_clf_is_real_and_learnable():
+    """The config-5 local proxy: real repo prose, real labels, and a
+    tiny BERT must beat chance decisively on the held-out tail."""
+    import jax
+
+    from mlapi_tpu.models import get_model
+    from mlapi_tpu.train import fit
+
+    splits = get_dataset("docs_clf", seq_len=128)
+    assert splits.source == "real"
+    n_classes = len(splits.vocab.labels)
+    assert n_classes >= 2
+    assert set(np.unique(splits.y_test)) == set(range(n_classes))
+    # Stratified random split of NON-overlapping windows: no byte
+    # appears in both splits.
+    tr = {w.tobytes() for w in splits.x_train}
+    assert not any(w.tobytes() in tr for w in splits.x_test)
+
+    model = get_model(
+        "bert_classifier", vocab_size=260, hidden_size=64, num_layers=2,
+        num_heads=4, intermediate_size=128, max_positions=128,
+        num_classes=n_classes,
+    )
+    r = fit(model, splits, steps=200, batch_size=64,
+            learning_rate=1e-3, optimizer="adamw")
+    chance = max(
+        np.mean(splits.y_test == c) for c in range(n_classes)
+    )
+    assert r.test_accuracy > chance + 0.1, (
+        r.test_accuracy, float(chance)
+    )
+
+
+def test_docsclf_bert_preset_registered():
+    from mlapi_tpu.config import get_preset
+
+    cfg = get_preset("docsclf-bert")
+    assert cfg.dataset == "docs_clf"
