@@ -800,7 +800,7 @@ def speculative_generate_batched(
 # (buckets x tiers x greedy/sampled — up to ~24 entries on a wide
 # config): an evicted entry would rebuild its jax.jit wrapper with an
 # EMPTY compile cache, and strict mode would then stall a request on
-# a remote recompile for a shape ``_warmed_fused`` claims is warm.
+# a remote recompile for a shape the fused warm set claims is warm.
 @functools.lru_cache(maxsize=64)
 def fused_spec_fn(target, draft, p: int, n: int, k: int,
                   sampled: bool = False):

@@ -1,0 +1,71 @@
+"""Chained device dispatch with lazy token drains.
+
+``decode_chunk_fn`` RETURNS the feedback token as a device array, so
+consecutive chunks need no host round trip between them: the decode
+loop dispatches ahead and drains token readbacks lazily. Through a
+high-RTT attach (the tunneled chip: ~68 ms per synced readback, while
+argument uploads pipeline for free) this turns a request's serial cost
+from one RTT PER CHUNK into one readback at the end.
+
+:class:`DispatchChain` owns the in-flight chunk queue and the
+device-resident feedback token (``tok_dev``); the per-request delivery
+bookkeeping stays with the caller as the ``deliver`` callback, because
+it mutates the batch's host mirrors. Anything that mutates batch state
+— admission, compaction, the spec phase — must :meth:`invalidate`
+first (drain fully and drop the device chain: the host mirrors are the
+source of truth again). Split out of ``engine._run_batch`` (r04
+VERDICT "Next" #7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DispatchChain:
+    def __init__(self, deliver):
+        # deliver(toks_host [B, size], size, live_indices): push the
+        # drained chunk to its requests and update the host mirrors.
+        self._deliver = deliver
+        self._inflight: list = []  # (toks_dev [B, size], size, live)
+        self.tok_dev = None        # device-resident feedback token
+
+    def __len__(self) -> int:
+        return len(self._inflight)
+
+    def push(self, toks_dev, size: int, live: list) -> None:
+        """Queue one dispatched chunk's device output for a later
+        drain. ``live`` are the request indices it covers."""
+        self._inflight.append((toks_dev, size, live))
+
+    def pending_live(self):
+        """Request indices covered by any in-flight chunk."""
+        for _, _, plive in self._inflight:
+            yield from plive
+
+    def drain(self, count: int | None = None) -> None:
+        """Read back the oldest ``count`` chunks (all by default) and
+        deliver them in dispatch order."""
+        take = self._inflight[:] if count is None else self._inflight[:count]
+        if not take:
+            return
+        del self._inflight[: len(take)]
+        for toks_dev, _, _ in take:
+            # Start every host copy before blocking on the first: one
+            # overlapped transfer window instead of a serial RTT per
+            # chunk. (A device-side concat + single readback was
+            # measured too: it lands in the same noise band on the
+            # tunneled attach, so the simpler form stays.)
+            try:
+                toks_dev.copy_to_host_async()
+            except AttributeError:
+                pass
+        for toks_dev, got, plive in take:
+            self._deliver(np.asarray(toks_dev), got, plive)
+
+    def invalidate(self) -> None:
+        """Batch state is about to change under the chain: deliver
+        everything in flight and drop the device-resident feedback
+        token — the next dispatch re-uploads from the host mirrors."""
+        self.drain()
+        self.tok_dev = None

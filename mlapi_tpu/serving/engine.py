@@ -15,6 +15,15 @@ the matmul twice (``predict`` then ``predict_proba``,
 - Requests are padded to a small set of bucket sizes so arbitrary
   batch sizes never trigger recompilation (static shapes — XLA
   requirement, SURVEY §7 step 4).
+
+The GENERATIVE engine's subsystems live in sibling modules with the
+engine as their hub (r04 split): request/prefix-entry types in
+``requests.py``, the shared-prefix KV cache in ``prefix.py``, the
+host speculation phase in ``spec_phase.py``, the batch-1 fused fast
+path in ``fused_single.py``, and the chained-dispatch drain machinery
+in ``dispatch.py``. ``_run_batch`` here remains the batch LIFECYCLE —
+formation, continuous admission, growth/compaction, handoffs — the
+one place the pieces compose.
 """
 
 from __future__ import annotations
@@ -27,6 +36,15 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from mlapi_tpu.serving.dispatch import DispatchChain
+from mlapi_tpu.serving.fused_single import FusedSinglePath
+from mlapi_tpu.serving.prefix import PrefixCache
+
+# Request-side data types live in serving/requests.py; re-exported
+# because the engine API and the test suite name them from this module.
+from mlapi_tpu.serving.requests import GenRequest, _PrefixEntry, _SyncSink
+from mlapi_tpu.serving.spec_phase import SpecPhase
 
 from mlapi_tpu.utils.logging import get_logger
 from mlapi_tpu.utils.vocab import LabelVocab
@@ -351,103 +369,6 @@ class TextClassificationEngine(InferenceEngine):
         return ids
 
 
-class GenRequest:
-    """One in-flight generation request: its encoded prompt plus an
-    asyncio queue the decode loop feeds with token chunks (and a
-    ``None`` sentinel when done)."""
-
-    __slots__ = (
-        "row", "used", "n_new", "temperature", "seed", "queue", "loop",
-        "cancelled", "top_k", "top_p", "stream",
-        "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
-        "prompt_tokens",
-    )
-
-    def __init__(self, row, used, n_new, temperature, seed, loop,
-                 top_k=0, top_p=1.0, prefix=None, stream=False):
-        self.row = row            # [bucketed] int32 ids, left-padded
-        self.used = used          # real prompt tokens in the row
-        self.n_new = n_new
-        self.temperature = temperature
-        self.seed = seed
-        self.loop = loop
-        self.top_k = top_k        # 0 disables
-        self.top_p = top_p        # 1.0 disables
-        # Incremental consumer (NDJSON stream or a stop-sequence
-        # watcher): the decode loop keeps at most one chunk in
-        # flight so tokens land promptly; non-incremental requests
-        # let the loop chain every chunk and sync once (the
-        # dispatch-bound single-stream win through a high-RTT
-        # attach).
-        self.stream = stream
-        # Shared-prefix KV entry (engine._prefix_entry); only
-        # same-prefix requests batch together.
-        if prefix is not None:
-            self.prefix_fp = prefix.fp
-            self.prefix_kv = prefix.kv
-            self.prefix_len = prefix.bucket
-            self.prefix_lo = prefix.lo
-            # Tokens that actually conditioned the output = prefix
-            # real tokens + suffix real tokens (`used` stays the
-            # suffix-row count — it drives the pad mask).
-            self.prompt_tokens = prefix.used + used
-        else:
-            self.prefix_fp = None
-            self.prefix_kv = None
-            self.prefix_len = 0
-            self.prefix_lo = 0
-            self.prompt_tokens = used
-        self.queue: asyncio.Queue = asyncio.Queue()
-        self.cancelled = False    # set when the consumer disconnects
-
-    def push(self, item) -> None:
-        """Thread-safe enqueue from the decode thread."""
-        self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
-
-    def cancel(self) -> None:
-        """Consumer is gone: tell the decode loop to stop spending
-        device time on this row (a plain bool — read cross-thread,
-        worst case one extra chunk decodes)."""
-        self.cancelled = True
-
-
-class _PrefixEntry:
-    """One cached shared-prompt prefix: its device-resident KV (a
-    ``[1, bucket]``-shaped cache pytree), the bucket it was padded to,
-    its own left-pad ``lo``, and the real token count."""
-
-    __slots__ = ("fp", "kv", "bucket", "lo", "used")
-
-    def __init__(self, fp, kv, bucket, lo, used):
-        self.fp = fp
-        self.kv = kv
-        self.bucket = bucket
-        self.lo = lo
-        self.used = used
-
-
-class _SyncSink:
-    """Adapter so the synchronous ``generate_text`` path reuses
-    ``_run_batch`` verbatim: collects token chunks into a list instead
-    of an asyncio queue."""
-
-    def __init__(self, req: "GenRequest", out_ids: list):
-        self.row, self.used, self.n_new = req.row, req.used, req.n_new
-        self.temperature, self.seed = req.temperature, req.seed
-        self.top_k, self.top_p = req.top_k, req.top_p
-        self.prefix_fp, self.prefix_kv = req.prefix_fp, req.prefix_kv
-        self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
-        self.stream = req.stream
-        self._out = out_ids
-        self.error: Exception | None = None
-        self.cancelled = False
-
-    def push(self, item) -> None:
-        if isinstance(item, Exception):
-            self.error = item
-        elif item is not None:
-            self._out.extend(item["token_ids"])
-
 
 @functools.cache
 def _dispatch_rtt_ms(samples: int = 3) -> float:
@@ -655,19 +576,10 @@ class TextGenerationEngine:
         self._warmed_scatter: set = set()
         self._warmed_growth: set = set()
         self._admit_eager_override: bool | None = None
-        # Shared-prefix KV cache: text → _PrefixEntry, LRU-bounded
-        # (each entry holds a [1, prefix_bucket] KV pytree on device).
-        import collections
-
-        self._prefixes: collections.OrderedDict = collections.OrderedDict()
-        self.max_prefixes = 8
-        # Guards the LRU against concurrent _encode calls (submit runs
-        # encoding in executor threads): without it, N first requests
-        # naming the same prefix would each pay the cold prefill.
-        # ``_px_building`` holds per-key in-flight build events so
-        # cold builds never block hits on OTHER prefixes.
-        self._pxlock = threading.Lock()
-        self._px_building: dict = {}
+        # Shared-prefix KV caching: ALL prefix state (entry LRU, build
+        # events, widened-KV cache, hit/miss counters) lives in the
+        # PrefixCache module; the engine only routes calls to it.
+        self.prefix = PrefixCache(self)
         # Stats (read by /metrics and the coalescing test).
         self.requests = 0
         self.batch_calls = 0
@@ -677,33 +589,22 @@ class TextGenerationEngine:
         self.compactions = 0
         self.admitted = 0
         self.growths = 0
-        self.prefix_hits = 0
-        self.prefix_misses = 0
-        self.prefix_fallbacks = 0
         self.prefill_chunks = 0
         self.spec_rounds = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.fused_calls = 0
         self.fused_spec_calls = 0
-        self._warmed_spec: set = set()
-        # (bucket, tier, "plain"|"spec") fused single-stream programs
-        # proven compiled — strict mode takes the fast path only for
-        # these (an unwarmed fused shape falls back to the chunked
-        # programs rather than stalling on a remote compile).
-        self._warmed_fused: set = set()
+        # Host-loop speculation phase: rounds + warmed-shape state
+        # live in serving/spec_phase.py.
+        self.spec = SpecPhase(self)
+        # Batch-1 fused fast path: eligibility, dispatch, and warmed
+        # state live in serving/fused_single.py.
+        self.fused = FusedSinglePath(self)
         # Batch-resize (compaction) shapes proven compiled — in
         # strict non-eager mode a resize outside this set is skipped
         # (decode stays at full width) rather than compiled mid-batch.
         self._warmed_shrink: set = set()
-        # Cross-batch prefix sharing: right-aligned [1, P] widenings
-        # of registered prefix KVs (keyed (fp, P), LRU-bounded) and
-        # the region widths P whose stacked program grid is warmed
-        # (strict mode groups cross-prefix only within this set).
-        self._wide_prefix_cache: collections.OrderedDict = (
-            collections.OrderedDict()
-        )
-        self._prefix_mix_warmed: set = set()
 
     @property
     def queue_depth(self) -> int:
@@ -751,186 +652,18 @@ class TextGenerationEngine:
             tier *= 2
         return min(self.model.max_positions, bucket + tier)
 
-    def _prefix_entry(self, text: str) -> "_PrefixEntry":
-        """Return (computing on first use, LRU-cached after) the KV
-        cache of a shared prompt prefix. The forward pass over the
-        prefix runs ONCE; every request naming the same prefix reuses
-        its keys/values straight from device memory — the
-        time-to-first-token win prefix caching exists for. The first
-        request with a new prefix pays the prefill (and possibly XLA
-        compiles for its shapes) on its own latency. Concurrent first
-        requests for the SAME prefix share one build (per-key event);
-        hits on other prefixes never wait behind a build — the lock
-        guards only the dict, not the device work."""
-        while True:
-            with self._pxlock:
-                entry = self._prefixes.get(text)
-                if entry is not None:
-                    self._prefixes.move_to_end(text)
-                    self.prefix_hits += 1
-                    return entry
-                ev = self._px_building.get(text)
-                if ev is None:
-                    import threading
+    # -- prefix-cache counters (state lives in serving/prefix.py) ---------
+    @property
+    def prefix_hits(self) -> int:
+        return self.prefix.hits
 
-                    ev = threading.Event()
-                    self._px_building[text] = ev
-                    break
-            # Someone else is building this prefix: wait, then re-check
-            # (their failure leaves the entry absent — we retry as the
-            # builder and surface the same error to this caller).
-            ev.wait(timeout=600.0)
-        try:
-            entry = self._build_prefix_entry(text)
-            with self._pxlock:
-                self._prefixes[text] = entry
-                self.prefix_misses += 1
-                while len(self._prefixes) > self.max_prefixes:
-                    self._prefixes.popitem(last=False)  # evict LRU
-            return entry
-        finally:
-            with self._pxlock:
-                self._px_building.pop(text, None)
-            ev.set()
+    @property
+    def prefix_misses(self) -> int:
+        return self.prefix.misses
 
-    def _build_prefix_entry(self, text: str) -> "_PrefixEntry":
-        """Tokenize, validate, prefill, and (strict mode) warm one
-        prefix — device work, run OUTSIDE the registry lock."""
-        from mlapi_tpu.models.gpt import prefill_fn
-
-        ids = self.tokenizer.token_ids(text)
-        if not ids:
-            raise ValueError("prefix tokenizes to nothing")
-        # The prefix must leave room for at least the smallest suffix
-        # bucket plus one generated token.
-        cap = self.model.max_positions - self.prompt_buckets[0] - 1
-        if len(ids) > cap:
-            raise ValueError(
-                f"prefix is {len(ids)} tokens; at most {cap} fit "
-                f"the model window (max_positions="
-                f"{self.model.max_positions})"
-            )
-        bucket = min(max(self._bucket(len(ids)), len(ids)), cap)
-        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        row[0, -len(ids):] = ids
-        lo = bucket - len(ids)
-        _, kv = prefill_fn(self.model, bucket)(
-            self.params, jnp.asarray(row),
-            jnp.asarray(self._key_data(0)[None]),
-            jnp.asarray(np.zeros((1,), np.float32)),
-            jnp.asarray(np.asarray([lo], np.int32)),
-            jnp.asarray(np.zeros((1,), np.int32)),
-            jnp.asarray(np.ones((1,), np.float32)),
-        )
-        entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
-        if self._strict_admit:
-            self._warm_prefix_shapes(entry)
-        return entry
-
-    def _warm_prefix_shapes(self, entry: "_PrefixEntry") -> None:
-        """Registration-time warm of the prefix-batch programs: on a
-        tunnel attach (strict mode) the first BATCH using a new prefix
-        must not stall the device stream on an XLA compile, so the
-        (suffix bucket × small batch) grid at the default cache tier
-        compiles as part of building the entry — the registration
-        request already owns that latency."""
-        from mlapi_tpu.models.gpt import prefix_prefill_fn
-
-        batches = [1]
-        while batches[-1] < self.max_batch:
-            batches.append(batches[-1] * 2)
-        from mlapi_tpu.models.gpt import decode_chunk_fn
-
-        p = entry.bucket
-        for sb in self.prompt_buckets:
-            if p + sb + 1 > self.model.max_positions:
-                continue  # no room for such suffixes behind this prefix
-            total = self._cache_len(
-                p + sb, self.default_max_new_tokens
-            )
-            for bsz in batches:
-                suffix = np.full(
-                    (bsz, sb), self.tokenizer.pad_id, np.int32
-                )
-                hole = jnp.asarray(np.full((bsz,), sb - 1, np.int32))
-                keys = jnp.asarray(
-                    np.stack([self._key_data(0)] * bsz)
-                )
-                zt = jnp.asarray(np.zeros((bsz,), np.float32))
-                zk = jnp.asarray(np.zeros((bsz,), np.int32))
-                op = jnp.asarray(np.ones((bsz,), np.float32))
-                _, cache = prefix_prefill_fn(self.model, sb, total)(
-                    self.params, entry.kv, jnp.asarray(suffix),
-                    hole, jnp.int32(entry.lo), keys, zt, zk, op,
-                )
-                # Cross-prefix (stacked) variants: per-row KV stack +
-                # lo vector, and the vector-lo decode-chunk program —
-                # these are keyed on SHAPES only, so warming them once
-                # per region width covers every combination of
-                # registered prefixes whose group max is this bucket.
-                # bsz == 1 is a mixed batch compacted to one row: the
-                # scalar-path cache with the vector-lo decode.
-                lo_vec = jnp.asarray(np.full((bsz,), entry.lo, np.int32))
-                if bsz > 1:
-                    kv_stack = jax.tree.map(
-                        lambda a: jnp.broadcast_to(
-                            a, (bsz,) + a.shape[1:]
-                        ),
-                        entry.kv,
-                    )
-                    _, cache = prefix_prefill_fn(self.model, sb, total)(
-                        self.params, kv_stack, jnp.asarray(suffix),
-                        hole, lo_vec, keys, zt, zk, op,
-                    )
-                decode_chunk_fn(self.model, self.chunk)(
-                    self.params, cache,
-                    jnp.asarray(np.zeros((bsz,), np.int32)),
-                    jnp.int32(p + sb), hole, zt, keys,
-                    jnp.asarray(np.ones((bsz,), np.int32)), zk, op,
-                    jnp.int32(p), lo_vec,
-                )
-        self._prefix_mix_warmed.add(p)
-
-    def _widen_prefix_kv(self, kv, own_len: int, p_len: int):
-        """``[1, own_len]`` prefix-KV pytree → ``[1, p_len]``,
-        right-aligned (real content ends at the common region end)."""
-        if own_len == p_len:
-            return kv
-        off = p_len - own_len
-        return jax.tree.map(
-            lambda a: jax.lax.dynamic_update_slice(
-                jnp.zeros((1, p_len) + a.shape[2:], a.dtype), a,
-                (0, off) + (0,) * (a.ndim - 2),
-            ),
-            kv,
-        )
-
-    def _stacked_prefix_kv(self, reqs, p_len: int, b_pad: int):
-        """Per-row ``[b_pad, p_len]`` prefix-KV stack for a
-        cross-prefix batch: each live row's own prefix right-aligned
-        to the common region end (cached per (fp, p_len) — the widen
-        runs once per prefix per width, not once per batch); dummy
-        rows are zeros, fully masked by ``lo == p_len``."""
-        rows = []
-        for r in reqs:
-            key = (r.prefix_fp, p_len)
-            wide = self._wide_prefix_cache.get(key)
-            if wide is None:
-                wide = self._widen_prefix_kv(
-                    r.prefix_kv, r.prefix_len, p_len
-                )
-                self._wide_prefix_cache[key] = wide
-                while len(self._wide_prefix_cache) > 2 * self.max_prefixes:
-                    self._wide_prefix_cache.popitem(last=False)
-            else:
-                self._wide_prefix_cache.move_to_end(key)
-            rows.append(wide)
-        if b_pad > len(reqs):
-            zero = jax.tree.map(jnp.zeros_like, rows[0])
-            rows.extend([zero] * (b_pad - len(reqs)))
-        return jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *rows
-        )
+    @property
+    def prefix_fallbacks(self) -> int:
+        return self.prefix.fallbacks
 
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
@@ -945,7 +678,7 @@ class TextGenerationEngine:
                 # placeholder behind the prefix — serve the prefix
                 # alone through the plain path instead (identical
                 # output by the pinned equivalence).
-                self.prefix_fallbacks += 1
+                self.prefix.fallbacks += 1
                 text = prefix + text
                 raw = None  # re-tokenize the concatenation below
             else:
@@ -953,7 +686,7 @@ class TextGenerationEngine:
                 # the cached prefix KV (extend_core), so the KV path
                 # wins for every nonempty prefix — no length
                 # heuristic needed.
-                entry = self._prefix_entry(prefix)
+                entry = self.prefix.entry(prefix)
         p_len = entry.bucket if entry else 0
         limit = self.model.max_positions - n_new - p_len
         if limit <= 0:
@@ -1006,123 +739,6 @@ class TextGenerationEngine:
     def _key_data(seed: int) -> np.ndarray:
         return np.asarray(jax.random.key_data(jax.random.key(seed)))
 
-    def _fused_tiers(self) -> list:
-        """The fused-program output-tier ladder, ascending: powers of
-        two (of ``chunk``) from the DEFAULT budget's tier up to the
-        ``fused_max_new`` cap's. The floor is the default tier because
-        ``n_actual`` is traced — the default-tier program already
-        serves every smaller budget, so smaller tiers would only
-        multiply compiles. ONE definition shared by the request path
-        (``_fused_single_run``) and the warm grid (``_warm_fused``):
-        strict mode silently falls back to chunked on a warm-set miss,
-        so the two must be tier-identical by construction."""
-        t = self.chunk
-        while t < self.default_max_new_tokens:
-            t *= 2
-        tiers = [t]
-        while t < self.fused_max_new:
-            t *= 2
-            tiers.append(t)
-        return tiers
-
-    def _fused_single_run(self, r, admit: bool) -> bool:
-        """Batch-1 fast path: run ``r``'s WHOLE generation as one XLA
-        program (``generate_tier_fn``, or ``fused_spec_fn`` with the
-        draft) — one dispatch + one readback, the single-stream RTT
-        floor through a tunneled attach. Returns ``False`` to fall
-        through to the chunked path: streaming consumers, prefix rows,
-        long (chunked-prefill) prompts, budgets past ``fused_max_new``,
-        unwarmed shapes in strict mode, and batches with staged
-        joiners all decode chunked exactly as before. The emitted
-        stream is byte-identical to the chunked path (same pads, same
-        per-token PRNG stream indices; greedy speculation is
-        argmax-exact), so which path served a request is invisible in
-        the response.
-
-        One fused run is one uninterruptible device program — a
-        request arriving mid-run waits for it (bounded by
-        ``fused_max_new``), the price of removing per-chunk
-        dispatches. Mirrors the host spec phase's yield discipline at
-        ENTRY instead: staged admission candidates suppress the fast
-        path entirely.
-        """
-        if admit:
-            with self._alock:
-                if self._admit or self._deferred:
-                    return False
-        bucket = len(r.row)
-        if bucket > self.prompt_buckets[-1]:
-            return False  # chunked-prefill territory
-        n_new = r.n_new
-        if n_new > self.fused_max_new:
-            return False
-        tier = next(t for t in self._fused_tiers() if t >= n_new)
-        greedy = (
-            r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
-        )
-        spec = self.draft_model is not None and (
-            greedy or (self.spec_sample and r.temperature > 0.0)
-        )
-        k = max(1, min(self.spec_k, tier))
-        if spec and (
-            bucket + tier + k + 1 > self.model.max_positions
-            or bucket + tier + k + 1 > self.draft_model.max_positions
-        ):
-            spec = False
-        if not spec and bucket + tier > self.model.max_positions:
-            return False
-        # Greedy and sampled speculation are DIFFERENT compiled
-        # programs (``sampled`` is static in ``fused_spec_fn``) —
-        # strict warm-gating must distinguish them.
-        kind = (
-            "plain" if not spec
-            else ("spec_sampled" if r.temperature > 0.0 else "spec")
-        )
-        if (
-            self._strict_admit
-            and (bucket, tier, kind) not in self._warmed_fused
-        ):
-            return False
-
-        from mlapi_tpu.models.gpt import generate_tier_fn
-
-        row = jnp.asarray(np.asarray(r.row)[None])
-        kd = jnp.asarray(self._key_data(r.seed)[None])
-        temps = jnp.asarray(np.asarray([r.temperature], np.float32))
-        topk = jnp.asarray(np.asarray([r.top_k], np.int32))
-        topp = jnp.asarray(np.asarray([r.top_p], np.float32))
-        n_pad = jnp.asarray(np.asarray([bucket - r.used], np.int32))
-        if spec:
-            from mlapi_tpu.ops.speculative import fused_spec_fn
-
-            packed = np.asarray(
-                fused_spec_fn(
-                    self.model, self.draft_model, bucket, tier, k,
-                    r.temperature > 0.0,
-                )(
-                    self.params, self.draft_params, row, kd, temps,
-                    topk, topp, n_pad, jnp.int32(n_new),
-                )
-            )
-            ids = packed[:n_new]
-            self.spec_rounds += int(packed[tier])
-            self.spec_accepted += int(packed[tier + 1])
-            self.spec_drafted += int(packed[tier + 2])
-            self.fused_spec_calls += 1
-        else:
-            ids = np.asarray(
-                generate_tier_fn(self.model, tier)(
-                    self.params, row, kd, temps, n_pad, topk, topp,
-                    jnp.int32(n_new),
-                )
-            )[:n_new]
-            self.fused_calls += 1
-        self._warmed_fused.add((bucket, tier, kind))
-        if not r.cancelled:
-            r.push({"token_ids": ids.tolist()})
-            r.push(None)
-        return True
-
     def _run_batch(self, reqs: list, admit: bool = False,
                    fused_ok: bool = True) -> None:
         """Decode one coalesced batch, streaming chunks to each
@@ -1160,7 +776,7 @@ class TextGenerationEngine:
                 fused_ok and self.fused_single and len(reqs) == 1
                 and reqs[0].prefix_len == 0 and not reqs[0].stream
                 and not reqs[0].cancelled
-                and self._fused_single_run(reqs[0], admit)
+                and self.fused.try_run(reqs[0], admit)
             ):
                 return
             bucket = max(len(r.row) for r in reqs)
@@ -1221,7 +837,7 @@ class TextGenerationEngine:
                     jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo)
                 )
                 kv_arg = (
-                    self._stacked_prefix_kv(reqs, p_len, b_pad)
+                    self.prefix.stacked(reqs, p_len, b_pad)
                     if mixed_prefix else reqs[0].prefix_kv
                 )
                 first, cache = prefix_prefill_fn(
@@ -1305,7 +921,7 @@ class TextGenerationEngine:
                 and (
                     not self._strict_admit
                     or (bucket, total, b_pad, "batched")
-                    in self._warmed_spec
+                    in self.spec.warmed
                 )
             )
             # step[row]: the row's NEXT sampling-stream index — its own
@@ -1392,7 +1008,7 @@ class TextGenerationEngine:
                 nonlocal cache, pos
                 if spec_hist is None or done[0] or reqs[0].cancelled:
                     return
-                cache, pos = self._spec_phase(
+                cache, pos = self.spec.run_solo(
                     reqs[0], cache, pos, total, bucket, tok, step,
                     produced, n_pad, keys, spec_hist, temps, topk, topp,
                 )
@@ -1404,7 +1020,7 @@ class TextGenerationEngine:
             try_spec()
 
             if spec_batched and not all(done):
-                cache, pos = self._spec_phase_batched(
+                cache, pos = self.spec.run_batched(
                     reqs, cache, pos, total, bucket, prompt, tok,
                     step, produced, done, n_pad, keys, b_pad,
                 )
@@ -1426,48 +1042,25 @@ class TextGenerationEngine:
             # mutates batch state — admission, compaction, the spec
             # phase — drains fully first and drops the device chain
             # (the host mirrors are the source of truth again).
-            inflight: list = []  # (toks_dev [B,size], size, live-idx)
-            tok_dev = None       # device-resident feedback token
-
-            def drain(count: int | None = None) -> None:
+            def deliver(toks_host, got, plive):
                 nonlocal tok
-                take = inflight[:] if count is None else inflight[:count]
-                if not take:
-                    return
-                del inflight[: len(take)]
-                for toks_dev, _, _ in take:
-                    # Start every host copy before blocking on the
-                    # first: one overlapped transfer window instead
-                    # of a serial RTT per chunk. (A device-side
-                    # concat + single readback was measured too: it
-                    # lands in the same noise band on the tunneled
-                    # attach, so the simpler form stays.)
-                    try:
-                        toks_dev.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                for toks_dev, got, plive in take:
-                    toks_host = np.asarray(toks_dev)
-                    tok = toks_host[:, -1].copy()
-                    for i in plive:
-                        r = reqs[i]
-                        if r.cancelled:
-                            continue
-                        want = r.n_new - produced[i]
-                        if want > 0:
-                            chunk_ids = toks_host[rows[i], : min(want, got)]
-                            r.push({"token_ids": chunk_ids.tolist()})
-                            if spec_hist is not None and i == 0:
-                                spec_hist.extend(chunk_ids.tolist())
-                            produced[i] += got
-                            if want <= got:
-                                r.push(None)
-                                done[i] = True
+                tok = toks_host[:, -1].copy()
+                for i in plive:
+                    r = reqs[i]
+                    if r.cancelled:
+                        continue
+                    want = r.n_new - produced[i]
+                    if want > 0:
+                        chunk_ids = toks_host[rows[i], : min(want, got)]
+                        r.push({"token_ids": chunk_ids.tolist()})
+                        if spec_hist is not None and i == 0:
+                            spec_hist.extend(chunk_ids.tolist())
+                        produced[i] += got
+                        if want <= got:
+                            r.push(None)
+                            done[i] = True
 
-            def invalidate_chain() -> None:
-                nonlocal tok_dev
-                drain()
-                tok_dev = None
+            chain = DispatchChain(deliver)
 
             def sdone(i: int) -> bool:
                 """done[] as of the DISPATCH frontier: a row whose
@@ -1480,10 +1073,10 @@ class TextGenerationEngine:
                 # width-1 chunk: delivered by the first drain, chained
                 # into chunk 1 on device.
                 all_rows = list(range(b))
-                inflight.append((first_chunk, 1, all_rows))
+                chain.push(first_chunk, 1, all_rows)
                 for i in all_rows:
                     sched[i] += 1
-                tok_dev = first
+                chain.tok_dev = first
 
             while True:
                 pending_n = 0
@@ -1577,7 +1170,7 @@ class TextGenerationEngine:
                         # defer above never pay this — a camping
                         # incompatible candidate must not degrade the
                         # batch to synced per-chunk readbacks.
-                        invalidate_chain()
+                        chain.invalidate()
                         # Leave the staging list BEFORE the device
                         # work, so a mid-admission failure (outer
                         # except delivers the error to every member
@@ -1651,7 +1244,7 @@ class TextGenerationEngine:
                     # Every remaining consumer disconnected, finished,
                     # or is fully covered by in-flight chunks: deliver
                     # what's pending and stop scheduling device time.
-                    drain()
+                    chain.drain()
                     if not all(done):
                         self.cancelled_batches += 1
                     break
@@ -1670,7 +1263,7 @@ class TextGenerationEngine:
                     and reqs[0].n_new - sched[0] > 1
                     and pos + 1 + self.spec_k + 1 <= total
                 ):
-                    invalidate_chain()
+                    chain.invalidate()
                     try_spec()
                     if done[0]:
                         continue
@@ -1682,7 +1275,7 @@ class TextGenerationEngine:
                 # end and corrupted the tail positions).
                 size = min(self.chunk, total - pos)
                 if size <= 0:
-                    drain()
+                    chain.drain()
                     break  # cache exhausted — safety net below
                 want_b = 1
                 while want_b < len(live):
@@ -1705,7 +1298,7 @@ class TextGenerationEngine:
                     or (b_cur, want_b, total) in self._warmed_shrink
                 )
                 if want_b < b_cur and not pending_n and resize_ok:
-                    invalidate_chain()
+                    chain.invalidate()
                     sel = [rows[i] for i in live]
                     sel += [sel[0]] * (want_b - len(sel))
                     sel = np.asarray(sel, np.int32)
@@ -1720,7 +1313,8 @@ class TextGenerationEngine:
                 self.chunk_calls += 1
                 toks, cache, last_tok = decode_chunk_fn(self.model, size)(
                     self.params, cache,
-                    tok_dev if tok_dev is not None else jnp.asarray(tok),
+                    chain.tok_dev if chain.tok_dev is not None
+                    else jnp.asarray(tok),
                     jnp.int32(pos),
                     jnp.asarray(n_pad), jnp.asarray(temps),
                     jnp.asarray(keys), jnp.asarray(step),
@@ -1728,32 +1322,30 @@ class TextGenerationEngine:
                     jnp.int32(p_len),
                     jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo),
                 )
-                inflight.append((toks, size, live))
+                chain.push(toks, size, live)
                 for i in live:
                     sched[i] += size
                 step = step + np.int32(size)
                 pos += size
-                tok_dev = last_tok
+                chain.tok_dev = last_tok
                 if any(
-                    reqs[i].stream
-                    for _, _, plive in inflight
-                    for i in plive
+                    reqs[i].stream for i in chain.pending_live()
                 ):
                     # A chunk covering an incremental consumer may
                     # wait behind at most ONE newer chunk — including
                     # a stream row's FINAL chunk after it left `live`
                     # (its terminator must not ride the chain until
                     # the co-batched requests finish).
-                    if len(inflight) > 1:
-                        drain(len(inflight) - 1)
-                elif len(inflight) >= 4:
+                    if len(chain) > 1:
+                        chain.drain(len(chain) - 1)
+                elif len(chain) >= 4:
                     # Bounded run-ahead: one overlapped readback
                     # window per 4 chunks keeps ~the full RTT win
                     # while cancellation and mid-batch admission get
                     # a real sync point every few chunks instead of
                     # after the whole generation.
-                    drain()
-            drain()
+                    chain.drain()
+            chain.drain()
             # Safety net: every waiter MUST get a terminator. The
             # collector/admission only group window-compatible
             # requests, so this fires only if that invariant is ever
@@ -1778,306 +1370,6 @@ class TextGenerationEngine:
                 except Exception:  # a dead loop must not mask others
                     pass
 
-    def _spec_phase(self, r, cache, pos, total, bucket, tok, step,
-                    produced, n_pad, keys, history, temps, topk, topp):
-        """Run speculative rounds for a single request against the
-        engine's live target cache; returns ``(cache, pos)`` for
-        the normal decode loop to resume from. Mutates the host
-        mirrors (``tok``, ``step``, ``produced``) in place — the
-        handoff contract with ``_run_batch``. Library twins:
-        ``ops/speculative.speculative_generate`` (greedy rows —
-        byte-exact stream) and ``.speculative_sample`` (sampled rows
-        under ``spec_sample=True`` — exact target distribution); this
-        variant adds the engine's per-row pad mask, streaming pushes,
-        admission handoff, and RE-ENGAGEMENT: ``history`` (the row's
-        emitted tokens so far) replays into a fresh draft cache
-        through already-compiled chunk programs, so a stream whose
-        transient joiners departed speculates again for its tail.
-
-        Each round is TWO device dispatches (scan-propose + verify)
-        regardless of k — through the tunneled attach this, not the
-        acceptance rate, is what sets the wall-clock win."""
-        from mlapi_tpu.models.gpt import (
-            decode_chunk_fn, extend_chunk_fn, prefill_fn,
-        )
-        from mlapi_tpu.ops.speculative import (
-            propose_fn, sample_verify_fn, verify_fn,
-        )
-
-        k = self.spec_k
-        # The draft prefill/replay are EXPENSIVE compiles: strict mode
-        # requires them pre-warmed regardless of attach RTT (same rule
-        # as the admission joiner prefill).
-        if self._strict_admit and (bucket, total) not in self._warmed_spec:
-            return cache, pos
-        # Cheap disqualifiers BEFORE any device work: nothing to
-        # speculate, no block room, or joiners already waiting.
-        if r.n_new - produced[0] <= 1 or pos + 1 + k + 1 > total:
-            return cache, pos
-        if self._spec_should_yield():
-            return cache, pos
-
-        npj = jnp.asarray(n_pad)
-        zt = jnp.zeros((1,), jnp.float32)
-        z0 = jnp.zeros((1,), jnp.int32)
-        o1 = jnp.ones((1,), jnp.float32)
-        keys_j = jnp.asarray(keys)
-
-        # Draft prefill over the SAME padded prompt row (its KV layout
-        # mirrors the target's, pads masked identically) ...
-        row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        row[0, bucket - len(r.row):] = r.row
-        _, d_cache = prefill_fn(self.draft_model, total)(
-            self.draft_params, jnp.asarray(row), keys_j, zt, npj, z0, o1,
-        )
-        # ... then replay the already-emitted tokens (all but the
-        # unconsumed last, which seeds the first round) in
-        # fixed-width chunks plus single-step remainder — every
-        # program already compiled for this (bucket, total).
-        replay = history[:-1]
-        d_replay_upto = bucket
-        ri = 0
-        while len(replay) - ri >= self.chunk:
-            blk = np.asarray([replay[ri:ri + self.chunk]], np.int32)
-            d_cache, _ = extend_chunk_fn(
-                self.draft_model, self.chunk, total
-            )(
-                self.draft_params, d_cache, jnp.asarray(blk),
-                jnp.int32(d_replay_upto), npj,
-            )
-            d_replay_upto += self.chunk
-            ri += self.chunk
-        self._warmed_spec.add((bucket, total))
-
-        def dstep(dcache, token, at):
-            toks, dcache, _ = decode_chunk_fn(self.draft_model, 1)(
-                self.draft_params, dcache,
-                jnp.asarray(np.asarray([token], np.int32)),
-                jnp.int32(at), npj, zt, keys_j, jnp.int32(0), z0, o1,
-                jnp.int32(0), jnp.int32(0),
-            )
-            return int(np.asarray(toks)[0, 0]), dcache
-
-        while ri < len(replay):  # sub-chunk replay remainder
-            _, d_cache = dstep(d_cache, replay[ri], d_replay_upto)
-            d_replay_upto += 1
-            ri += 1
-
-        sampled = bool(temps[0] > 0.0)
-        temps_j = jnp.asarray(temps)
-        topk_j = jnp.asarray(topk)
-        topp_j = jnp.asarray(topp)
-        d_upto = t_upto = pos
-        d_pend = [int(tok[0])]
-        while not r.cancelled and produced[0] < r.n_new:
-            if self._spec_should_yield():
-                break  # joiners waiting: normal loop admits them
-            budget = r.n_new - produced[0]
-            if budget <= 1 or t_upto + 1 + k + 1 > total:
-                break
-            # Draft phase: ONE scanned dispatch consumes the pending
-            # accepted tokens and chains all k proposals. Greedy rows
-            # (temp 0) argmax inside the same program; sampled rows
-            # draw from the draft's warped distribution at the
-            # DRAFT-tagged per-token streams.
-            step0 = int(produced[0])
-            d_cache, props, q_probs = propose_fn(
-                self.draft_model, len(d_pend), k, sampled
-            )(
-                self.draft_params, d_cache,
-                jnp.asarray(np.asarray(d_pend, np.int32)),
-                jnp.int32(d_upto), npj, keys_j, temps_j, topk_j,
-                topp_j, jnp.int32(step0),
-            )
-            d_upto += len(d_pend) + k - 1
-            usable = min(k, budget - 1)
-            if sampled:
-                cache, packed = sample_verify_fn(self.model, k + 1)(
-                    self.params, cache, jnp.int32(int(tok[0])), props,
-                    jnp.int32(t_upto), npj, q_probs, keys_j, temps_j,
-                    topk_j, topp_j, jnp.int32(step0),
-                    jnp.int32(usable),
-                )
-                packed = np.asarray(packed)
-                m = int(packed[k + 1])
-                emitted = packed[: m + 1].tolist()
-                kth = int(packed[k - 1])  # props[k-1] when m == k
-            else:
-                proposals = np.asarray(props).tolist()
-                cache, expect = verify_fn(self.model, k + 1)(
-                    self.params, cache,
-                    jnp.asarray(
-                        np.asarray([[int(tok[0]), *proposals]], np.int32)
-                    ),
-                    jnp.int32(t_upto), npj,
-                )
-                expect = np.asarray(expect)[0]
-                m = 0
-                while m < usable and proposals[m] == int(expect[m]):
-                    m += 1
-                emitted = [*proposals[:m], int(expect[m])]
-                kth = proposals[-1]
-            r.push({"token_ids": emitted})
-            history.extend(emitted)  # keeps replay state current
-            produced[0] += m + 1
-            step[0] = produced[0]
-            t_upto += m + 1
-            tok[0] = emitted[-1]
-            self.spec_rounds += 1
-            self.spec_drafted += usable
-            self.spec_accepted += m
-            if m == k:
-                d_pend = [kth, emitted[-1]]
-            else:
-                d_upto = t_upto
-                d_pend = [emitted[-1]]
-        return cache, t_upto
-
-    def _spec_should_yield(self) -> bool:
-        """Admission candidates end a speculative phase at the next
-        round boundary — the handoff seam (tests patch this to force
-        a deterministic mid-phase handoff; in production a joiner can
-        land during the phase's first compiles, in which case
-        yielding before round one is the correct behavior)."""
-        with self._alock:
-            return bool(self._admit)
-
-    def _spec_phase_batched(self, reqs, cache, pos, total, bucket,
-                            prompt, tok, step, produced, done, n_pad,
-                            keys, b_cur):
-        """Speculative rounds for a WHOLE freshly-formed greedy batch:
-        every row drafts k proposals and verifies them in one block
-        per round, advancing by its OWN acceptance length (the
-        rank-polymorphic per-row position layout). Rows that finish
-        (or cancel) freeze and ride as dummies — their writes land
-        beyond their valid bound, masked until the batch ends.
-
-        Handoff: the phase exits at a round boundary when admission
-        candidates arrive (or every row is done) and REALIGNS the
-        cache — each row rolls right by ``max(t_upto) - t_upto_b``
-        with ``n_pad`` bumped by the same amount, which keeps every
-        effective position identical (wpe indices and stored rotary
-        phases key on effective position) — so the scalar-``pos``
-        chunk loop resumes exactly as if the batch had always been
-        synchronized. Engages only at batch FORMATION; after a
-        handoff the batch stays on the chunk loop (library twin with
-        the full algebra: ``ops.speculative.speculative_generate_batched``).
-        """
-        from mlapi_tpu.models.gpt import prefill_fn, realign_fn
-        from mlapi_tpu.ops.speculative import (
-            propose_batched_fn, verify_fn,
-        )
-
-        k = self.spec_k
-        key = (bucket, total, b_cur, "batched")
-        if self._strict_admit and key not in self._warmed_spec:
-            return cache, pos
-
-        if self._spec_should_yield():
-            return cache, pos  # joiners already staged: skip the
-            # whole-batch draft prefill, not just round one
-        zb = jnp.zeros((b_cur,), jnp.int32)
-        zt = jnp.zeros((b_cur,), jnp.float32)
-        ob = jnp.ones((b_cur,), jnp.float32)
-        npj = jnp.asarray(n_pad)
-        keys_j = jnp.asarray(keys)
-        _, d_cache = prefill_fn(self.draft_model, total)(
-            self.draft_params, jnp.asarray(prompt), keys_j, zt, npj,
-            zb, ob,
-        )
-        self._warmed_spec.add(key)
-
-        b = len(reqs)
-        t_upto = np.full((b_cur,), pos, np.int64)
-        d_upto = np.full((b_cur,), pos, np.int64)
-        d_pend = [[int(tok[i])] for i in range(b_cur)]
-
-        while True:
-            if self._spec_should_yield():
-                break  # joiners waiting: realign and hand off
-            active = [
-                i for i in range(b)
-                if not done[i] and not reqs[i].cancelled
-                and reqs[i].n_new - produced[i] >= 1
-            ]
-            if not active:
-                break
-            # Desync-headroom invariant: after ANY round, the realign
-            # frontier (max position, growing by <= k+1) plus the
-            # laggiest row's remaining budget (shrinking by >= 1)
-            # must still fit the cache — otherwise a lopsided round
-            # could strand a slow row past the window and the chunk
-            # loop would truncate it. Stop speculating one round
-            # early instead; the synchronized chunk loop finishes
-            # within the formation guarantee.
-            rem = max(reqs[i].n_new - produced[i] for i in active)
-            if int(t_upto.max()) + k + 1 + rem - 1 > total:
-                break
-            pend_buf = np.zeros((b_cur, 2), np.int32)
-            n_in = np.ones((b_cur,), np.int32)
-            for i in range(b_cur):
-                pend = d_pend[i]
-                n_in[i] = len(pend)
-                pend_buf[i, : len(pend)] = pend
-            d_cache, props, _ = propose_batched_fn(self.draft_model, k)(
-                self.draft_params, d_cache, jnp.asarray(pend_buf),
-                jnp.asarray(n_in),
-                jnp.asarray(d_upto.astype(np.int32)), npj, keys_j,
-                zt, zb, ob, zb,
-            )
-            props = np.asarray(props)
-            d_upto += n_in + k - 1
-
-            block = np.concatenate(
-                [np.asarray(tok[:b_cur], np.int32)[:, None], props],
-                axis=1,
-            )
-            cache, expect = verify_fn(self.model, k + 1)(
-                self.params, cache, jnp.asarray(block),
-                jnp.asarray(t_upto.astype(np.int32)), npj,
-            )
-            expect = np.asarray(expect)
-            self.spec_rounds += 1
-            for i in active:
-                r = reqs[i]
-                budget = r.n_new - produced[i]
-                usable = min(k, budget - 1)
-                m = 0
-                while m < usable and props[i, m] == int(expect[i, m]):
-                    m += 1
-                bonus = int(expect[i, m])
-                emitted = [int(t) for t in props[i, :m]] + [bonus]
-                r.push({"token_ids": emitted})
-                produced[i] += m + 1
-                step[i] = produced[i]
-                t_upto[i] += m + 1
-                tok[i] = bonus
-                self.spec_drafted += usable
-                self.spec_accepted += m
-                if m == k:
-                    d_pend[i] = [int(props[i, -1]), bonus]
-                else:
-                    d_upto[i] = t_upto[i]
-                    d_pend[i] = [bonus]
-                if produced[i] >= r.n_new:
-                    r.push(None)
-                    done[i] = True
-            for i in range(b_cur):
-                if i >= b or done[i] or (
-                    i < b and reqs[i].cancelled
-                ):
-                    # Frozen/dummy rows: keep their state pinned so
-                    # the realign delta stays correct.
-                    d_upto[i] = t_upto[i]
-                    d_pend[i] = d_pend[i][-1:]
-
-        top = int(t_upto.max())
-        if int(t_upto.min()) < top:
-            delta = (top - t_upto).astype(np.int32)
-            cache = realign_fn()(cache, jnp.asarray(delta))
-            n_pad += delta  # in place: the chunk loop's mirror
-        return cache, top
-
     # -- asyncio batcher ---------------------------------------------------
     async def start(self) -> None:
         if self._task is None:
@@ -2099,6 +1391,16 @@ class TextGenerationEngine:
                 req = self._queue.get_nowait()
                 req.push(RuntimeError("generation engine stopped"))
 
+
+    def _spec_should_yield(self) -> bool:
+        """Admission candidates end a speculative phase at the next
+        round boundary — the handoff seam (tests patch this to force
+        a deterministic mid-phase handoff; in production a joiner can
+        land during the phase's first compiles, in which case
+        yielding before round one is the correct behavior)."""
+        with self._alock:
+            return bool(self._admit)
+
     def _compatible(self, group: list, r) -> bool:
         """Can ``r`` join ``group`` without clamping anyone? The batch
         decodes to ``max(n_new)`` from a ``max(bucket)``-wide prompt;
@@ -2113,7 +1415,7 @@ class TextGenerationEngine:
         Prefix and plain requests never mix (a plain row would pay the
         whole region in dead cache slots). In strict (tunnel) mode a
         cross-prefix group needs its stacked program shapes pre-warmed
-        (``_prefix_mix_warmed``, populated at entry registration);
+        (``prefix.mix_warmed``, populated at entry registration);
         unwarmed combinations fall back to same-prefix grouping."""
         if (r.prefix_fp is None) != (group[0].prefix_fp is None):
             return False
@@ -2124,7 +1426,7 @@ class TextGenerationEngine:
             if (
                 mixed
                 and self._strict_admit
-                and p_len not in self._prefix_mix_warmed
+                and p_len not in self.prefix.mix_warmed
             ):
                 return False
         bucket = max(len(r.row), *(len(g.row) for g in group))
@@ -2417,11 +1719,11 @@ class TextGenerationEngine:
                     raise sinks[0].error
                 shapes += 1
         if self.fused_single:
-            shapes += self._warm_fused(full)
+            shapes += self.fused.warm(full)
         if full:
             shapes += self._warm_admission(batches)
             if self.draft_model is not None:
-                shapes += self._warm_spec()
+                shapes += self.spec.warm()
             # From here on, a joiner is only admitted into a RUNNING
             # batch when its admission program is already compiled —
             # an unwarmed shape waits for the next batch instead of
@@ -2432,194 +1734,6 @@ class TextGenerationEngine:
             "chunk=%d",
             shapes, self.chunk,
         )
-
-    def _warm_fused(self, full: bool) -> int:
-        """Compile the batch-1 fused-generation grid off the request
-        path: per prompt bucket, the whole-generation program at the
-        default-``max_new_tokens`` tier and at the ``fused_max_new``
-        tier (one program serves every budget in a tier — ``n_actual``
-        is traced), plus the fused speculation program when a draft is
-        attached. Executed with ``n_actual=1`` so the warm run costs
-        one prefill + one loop iteration, not a full generation.
-        Populates ``_warmed_fused``, which strict mode requires."""
-        from mlapi_tpu.models.gpt import generate_tier_fn
-
-        tiers = self._fused_tiers()
-        buckets = self.prompt_buckets if full else self.prompt_buckets[:1]
-        kd = jnp.asarray(self._key_data(0)[None])
-        z1f = jnp.zeros((1,), jnp.float32)
-        z1i = jnp.zeros((1,), jnp.int32)
-        o1f = jnp.ones((1,), jnp.float32)
-        shapes = 0
-        for bucket in buckets:
-            row = jnp.asarray(
-                np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            )
-            n_pad = jnp.asarray(np.asarray([bucket - 1], np.int32))
-            for tier in sorted(tiers):
-                if bucket + tier <= self.model.max_positions:
-                    generate_tier_fn(self.model, tier)(
-                        self.params, row, kd, z1f, n_pad, z1i, o1f,
-                        jnp.int32(1),
-                    )
-                    self._warmed_fused.add((bucket, tier, "plain"))
-                    shapes += 1
-                if self.draft_model is None:
-                    continue
-                k = max(1, min(self.spec_k, tier))
-                if (
-                    bucket + tier + k + 1 <= self.model.max_positions
-                    and bucket + tier + k + 1
-                    <= self.draft_model.max_positions
-                ):
-                    from mlapi_tpu.ops.speculative import fused_spec_fn
-
-                    # Greedy speculation serves every engine; the
-                    # sampled variant is a SECOND program, warmed
-                    # only when --spec-sample can route to it.
-                    variants = [(False, "spec")]
-                    if self.spec_sample:
-                        variants.append((True, "spec_sampled"))
-                    for sampled, kind in variants:
-                        fused_spec_fn(
-                            self.model, self.draft_model, bucket,
-                            tier, k, sampled,
-                        )(
-                            self.params, self.draft_params, row, kd,
-                            z1f, z1i, o1f, n_pad, jnp.int32(1),
-                        )
-                        self._warmed_fused.add((bucket, tier, kind))
-                        shapes += 1
-        return shapes
-
-    def _warm_spec(self) -> int:
-        """Compile the speculative-phase programs (draft prefill, the
-        scanned propose for both pending widths, the verify block —
-        greedy argmax and, under ``spec_sample``, the sampled
-        acceptance-rejection variant — and the replay-remainder step)
-        for every prompt bucket at the default cache tier, off the
-        request path."""
-        from mlapi_tpu.models.gpt import (
-            decode_chunk_fn, extend_chunk_fn, prefill_fn,
-        )
-        from mlapi_tpu.ops.speculative import (
-            propose_fn, sample_verify_fn, verify_fn,
-        )
-
-        shapes = 0
-        zt = jnp.zeros((1,), jnp.float32)
-        z0 = jnp.zeros((1,), jnp.int32)
-        o1 = jnp.ones((1,), jnp.float32)
-        key1 = jnp.asarray(self._key_data(0)[None])
-        k = self.spec_k
-        for bucket in self.prompt_buckets:
-            total = self._cache_len(bucket, self.default_max_new_tokens)
-            if bucket + 1 + k + 1 > total:
-                continue
-            row = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            npj = jnp.asarray(np.asarray([bucket - 1], np.int32))
-            _, d_cache = prefill_fn(self.draft_model, total)(
-                self.draft_params, jnp.asarray(row), key1, zt, npj,
-                z0, o1,
-            )
-            # Rounds start from 1 pending token (partial acceptance)
-            # or 2 (a fully-accepted round's unfed k-th proposal);
-            # sampled speculation compiles its own propose variant.
-            variants = (False, True) if self.spec_sample else (False,)
-            for n_in in (1, 2):
-                for sampled in variants:
-                    d_cache, _, _ = propose_fn(
-                        self.draft_model, n_in, k, sampled
-                    )(
-                        self.draft_params, d_cache,
-                        jnp.asarray(np.zeros((n_in,), np.int32)),
-                        jnp.int32(bucket), npj, key1,
-                        o1 if sampled else zt, z0, o1,
-                        jnp.int32(0),
-                    )
-            _, d_cache, _ = decode_chunk_fn(self.draft_model, 1)(
-                self.draft_params, d_cache, jnp.asarray(
-                    np.zeros((1,), np.int32)
-                ),
-                jnp.int32(bucket), npj, zt, key1, jnp.int32(0), z0, o1,
-                jnp.int32(0), jnp.int32(0),
-            )
-            block = np.zeros((1, k + 1), np.int32)
-            verify_fn(self.model, k + 1)(
-                self.params, self.model.init_cache(1, total),
-                jnp.asarray(block), jnp.int32(bucket), npj,
-            )
-            if self.spec_sample:
-                sample_verify_fn(self.model, k + 1)(
-                    self.params, self.model.init_cache(1, total),
-                    jnp.int32(0),
-                    jnp.asarray(np.zeros((k,), np.int32)),
-                    jnp.int32(bucket), npj,
-                    jnp.full((k, self.model.vocab_size),
-                             1.0 / self.model.vocab_size, np.float32),
-                    key1, o1, z0, o1, jnp.int32(0), jnp.int32(k),
-                )
-            if bucket + self.chunk <= total:
-                # Re-engagement replays history in chunk-wide blocks.
-                extend_chunk_fn(self.draft_model, self.chunk, total)(
-                    self.draft_params, d_cache,
-                    jnp.asarray(
-                        np.zeros((1, self.chunk), np.int32)
-                    ),
-                    jnp.int32(bucket), npj,
-                )
-            self._warmed_spec.add((bucket, total))
-            shapes += 1
-            # Batched-speculation grid: the whole-batch draft
-            # prefill, the per-row propose scan, the vector-position
-            # verify retrace, and the realign roll, per batch size.
-            from mlapi_tpu.models.gpt import realign_fn
-            from mlapi_tpu.ops.speculative import propose_batched_fn
-
-            # No batch of size 2 can ever form when max_batch < 2 —
-            # skip the whole batched grid rather than paying its
-            # draft-prefill/propose/verify/realign compiles at startup.
-            bsz = 2
-            while self.max_batch > 1 and bsz <= max(
-                2, 1 << (self.max_batch - 1).bit_length()
-            ):
-                bt = total  # the enclosing loop's tier
-                rows_b = np.full(
-                    (bsz, bucket), self.tokenizer.pad_id, np.int32
-                )
-                np_b = jnp.asarray(
-                    np.full((bsz,), bucket - 1, np.int32)
-                )
-                keys_b = jnp.asarray(
-                    np.stack([self._key_data(0)] * bsz)
-                )
-                ztb = jnp.zeros((bsz,), jnp.float32)
-                zbb = jnp.zeros((bsz,), jnp.int32)
-                obb = jnp.ones((bsz,), jnp.float32)
-                _, dcb = prefill_fn(self.draft_model, bt)(
-                    self.draft_params, jnp.asarray(rows_b), keys_b,
-                    ztb, np_b, zbb, obb,
-                )
-                propose_batched_fn(self.draft_model, k)(
-                    self.draft_params, dcb,
-                    jnp.asarray(np.zeros((bsz, 2), np.int32)),
-                    jnp.asarray(np.ones((bsz,), np.int32)),
-                    jnp.asarray(np.full((bsz,), bucket, np.int32)),
-                    np_b, keys_b, ztb, zbb, obb, zbb,
-                )
-                verify_fn(self.model, k + 1)(
-                    self.params, self.model.init_cache(bsz, bt),
-                    jnp.asarray(np.zeros((bsz, k + 1), np.int32)),
-                    jnp.asarray(np.full((bsz,), bucket, np.int32)),
-                    np_b,
-                )
-                realign_fn()(
-                    self.model.init_cache(bsz, bt), zbb,
-                )
-                self._warmed_spec.add((bucket, bt, bsz, "batched"))
-                shapes += 1
-                bsz *= 2
-        return shapes
 
     def _warm_admission(self, batches: list) -> int:
         """Compile the continuous-batching admission programs off the

@@ -1,0 +1,228 @@
+"""Shared-prefix KV caching for generative serving.
+
+One :class:`PrefixCache` per :class:`TextGenerationEngine`: it owns
+the LRU of prefilled prefix KVs, the per-key build events (concurrent
+first requests for the SAME prefix share one build; hits on other
+prefixes never wait), the cross-batch widened-KV cache, and the
+hit/miss/fallback counters ``/metrics`` exports. Device work (prefill,
+widen, warm grids) runs through the engine's model/params — the cache
+holds a back-reference for those, but every piece of PREFIX STATE
+lives here. Split out of ``engine.py`` (r04 VERDICT "Next" #7).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlapi_tpu.serving.requests import _PrefixEntry
+
+
+class PrefixCache:
+    def __init__(self, engine, max_entries: int = 8):
+        self.eng = engine
+        self.max_entries = max_entries
+        # text -> _PrefixEntry, LRU-bounded (each entry holds a
+        # [1, prefix_bucket] KV pytree on device).
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        # Guards the LRU against concurrent _encode calls (submit runs
+        # encoding in executor threads): without it, N first requests
+        # naming the same prefix would each pay the cold prefill.
+        # ``_building`` holds per-key in-flight build events so cold
+        # builds never block hits on OTHER prefixes.
+        self._lock = threading.Lock()
+        self._building: dict = {}
+        # Cross-batch prefix sharing: right-aligned [1, P] widenings
+        # of registered prefix KVs (keyed (fp, P), LRU-bounded) and
+        # the region widths P whose stacked program grid is warmed
+        # (strict mode groups cross-prefix only within this set).
+        self._wide: collections.OrderedDict = collections.OrderedDict()
+        self.mix_warmed: set = set()
+        # Stats (read by /metrics via the engine's properties).
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entry(self, text: str) -> _PrefixEntry:
+        """Return (computing on first use, LRU-cached after) the KV
+        cache of a shared prompt prefix. The forward pass over the
+        prefix runs ONCE; every request naming the same prefix reuses
+        its keys/values straight from device memory — the
+        time-to-first-token win prefix caching exists for. The first
+        request with a new prefix pays the prefill (and possibly XLA
+        compiles for its shapes) on its own latency. Concurrent first
+        requests for the SAME prefix share one build (per-key event);
+        hits on other prefixes never wait behind a build — the lock
+        guards only the dict, not the device work."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(text)
+                if entry is not None:
+                    self._entries.move_to_end(text)
+                    self.hits += 1
+                    return entry
+                ev = self._building.get(text)
+                if ev is None:
+                    ev = threading.Event()
+                    self._building[text] = ev
+                    break
+            # Someone else is building this prefix: wait, then re-check
+            # (their failure leaves the entry absent — we retry as the
+            # builder and surface the same error to this caller).
+            ev.wait(timeout=600.0)
+        try:
+            entry = self._build(text)
+            with self._lock:
+                self._entries[text] = entry
+                self.misses += 1
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)  # evict LRU
+            return entry
+        finally:
+            with self._lock:
+                self._building.pop(text, None)
+            ev.set()
+
+    def _build(self, text: str) -> _PrefixEntry:
+        """Tokenize, validate, prefill, and (strict mode) warm one
+        prefix — device work, run OUTSIDE the registry lock."""
+        from mlapi_tpu.models.gpt import prefill_fn
+
+        eng = self.eng
+        ids = eng.tokenizer.token_ids(text)
+        if not ids:
+            raise ValueError("prefix tokenizes to nothing")
+        # The prefix must leave room for at least the smallest suffix
+        # bucket plus one generated token.
+        cap = eng.model.max_positions - eng.prompt_buckets[0] - 1
+        if len(ids) > cap:
+            raise ValueError(
+                f"prefix is {len(ids)} tokens; at most {cap} fit "
+                f"the model window (max_positions="
+                f"{eng.model.max_positions})"
+            )
+        bucket = min(max(eng._bucket(len(ids)), len(ids)), cap)
+        row = np.full((1, bucket), eng.tokenizer.pad_id, np.int32)
+        row[0, -len(ids):] = ids
+        lo = bucket - len(ids)
+        _, kv = prefill_fn(eng.model, bucket)(
+            eng.params, jnp.asarray(row),
+            jnp.asarray(eng._key_data(0)[None]),
+            jnp.asarray(np.zeros((1,), np.float32)),
+            jnp.asarray(np.asarray([lo], np.int32)),
+            jnp.asarray(np.zeros((1,), np.int32)),
+            jnp.asarray(np.ones((1,), np.float32)),
+        )
+        entry = _PrefixEntry(text, kv, bucket, lo, len(ids))
+        if eng._strict_admit:
+            self.warm_shapes(entry)
+        return entry
+
+    def warm_shapes(self, entry: _PrefixEntry) -> None:
+        """Registration-time warm of the prefix-batch programs: on a
+        tunnel attach (strict mode) the first BATCH using a new prefix
+        must not stall the device stream on an XLA compile, so the
+        (suffix bucket × small batch) grid at the default cache tier
+        compiles as part of building the entry — the registration
+        request already owns that latency."""
+        from mlapi_tpu.models.gpt import decode_chunk_fn, prefix_prefill_fn
+
+        eng = self.eng
+        batches = [1]
+        while batches[-1] < eng.max_batch:
+            batches.append(batches[-1] * 2)
+
+        p = entry.bucket
+        for sb in eng.prompt_buckets:
+            if p + sb + 1 > eng.model.max_positions:
+                continue  # no room for such suffixes behind this prefix
+            total = eng._cache_len(p + sb, eng.default_max_new_tokens)
+            for bsz in batches:
+                suffix = np.full(
+                    (bsz, sb), eng.tokenizer.pad_id, np.int32
+                )
+                hole = jnp.asarray(np.full((bsz,), sb - 1, np.int32))
+                keys = jnp.asarray(
+                    np.stack([eng._key_data(0)] * bsz)
+                )
+                zt = jnp.asarray(np.zeros((bsz,), np.float32))
+                zk = jnp.asarray(np.zeros((bsz,), np.int32))
+                op = jnp.asarray(np.ones((bsz,), np.float32))
+                _, cache = prefix_prefill_fn(eng.model, sb, total)(
+                    eng.params, entry.kv, jnp.asarray(suffix),
+                    hole, jnp.int32(entry.lo), keys, zt, zk, op,
+                )
+                # Cross-prefix (stacked) variants: per-row KV stack +
+                # lo vector, and the vector-lo decode-chunk program —
+                # these are keyed on SHAPES only, so warming them once
+                # per region width covers every combination of
+                # registered prefixes whose group max is this bucket.
+                # bsz == 1 is a mixed batch compacted to one row: the
+                # scalar-path cache with the vector-lo decode.
+                lo_vec = jnp.asarray(np.full((bsz,), entry.lo, np.int32))
+                if bsz > 1:
+                    kv_stack = jax.tree.map(
+                        lambda a: jnp.broadcast_to(
+                            a, (bsz,) + a.shape[1:]
+                        ),
+                        entry.kv,
+                    )
+                    _, cache = prefix_prefill_fn(eng.model, sb, total)(
+                        eng.params, kv_stack, jnp.asarray(suffix),
+                        hole, lo_vec, keys, zt, zk, op,
+                    )
+                decode_chunk_fn(eng.model, eng.chunk)(
+                    eng.params, cache,
+                    jnp.asarray(np.zeros((bsz,), np.int32)),
+                    jnp.int32(p + sb), hole, zt, keys,
+                    jnp.asarray(np.ones((bsz,), np.int32)), zk, op,
+                    jnp.int32(p), lo_vec,
+                )
+        self.mix_warmed.add(p)
+
+    @staticmethod
+    def widen(kv, own_len: int, p_len: int):
+        """``[1, own_len]`` prefix-KV pytree → ``[1, p_len]``,
+        right-aligned (real content ends at the common region end)."""
+        if own_len == p_len:
+            return kv
+        off = p_len - own_len
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_update_slice(
+                jnp.zeros((1, p_len) + a.shape[2:], a.dtype), a,
+                (0, off) + (0,) * (a.ndim - 2),
+            ),
+            kv,
+        )
+
+    def stacked(self, reqs, p_len: int, b_pad: int):
+        """Per-row ``[b_pad, p_len]`` prefix-KV stack for a
+        cross-prefix batch: each live row's own prefix right-aligned
+        to the common region end (cached per (fp, p_len) — the widen
+        runs once per prefix per width, not once per batch); dummy
+        rows are zeros, fully masked by ``lo == p_len``."""
+        rows = []
+        for r in reqs:
+            key = (r.prefix_fp, p_len)
+            wide = self._wide.get(key)
+            if wide is None:
+                wide = self.widen(r.prefix_kv, r.prefix_len, p_len)
+                self._wide[key] = wide
+                while len(self._wide) > 2 * self.max_entries:
+                    self._wide.popitem(last=False)
+            else:
+                self._wide.move_to_end(key)
+            rows.append(wide)
+        if b_pad > len(reqs):
+            zero = jax.tree.map(jnp.zeros_like, rows[0])
+            rows.extend([zero] * (b_pad - len(reqs)))
+        return jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *rows
+        )

@@ -1,0 +1,113 @@
+"""Request-side data types for generative serving.
+
+These are the handoff objects between the async front (ASGI handlers,
+the collector) and the decode thread: one :class:`GenRequest` per
+in-flight generation, :class:`_SyncSink` adapting the synchronous
+``generate_text`` path onto the same batch machinery, and
+:class:`_PrefixEntry` describing one cached shared-prompt prefix.
+Split out of ``engine.py`` (r04) so the batch lifecycle, the prefix
+cache, and the speculation phase can live in modules of their own —
+they all speak in these types.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class GenRequest:
+    """One in-flight generation request: its encoded prompt plus an
+    asyncio queue the decode loop feeds with token chunks (and a
+    ``None`` sentinel when done)."""
+
+    __slots__ = (
+        "row", "used", "n_new", "temperature", "seed", "queue", "loop",
+        "cancelled", "top_k", "top_p", "stream",
+        "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
+        "prompt_tokens",
+    )
+
+    def __init__(self, row, used, n_new, temperature, seed, loop,
+                 top_k=0, top_p=1.0, prefix=None, stream=False):
+        self.row = row            # [bucketed] int32 ids, left-padded
+        self.used = used          # real prompt tokens in the row
+        self.n_new = n_new
+        self.temperature = temperature
+        self.seed = seed
+        self.loop = loop
+        self.top_k = top_k        # 0 disables
+        self.top_p = top_p        # 1.0 disables
+        # Incremental consumer (NDJSON stream or a stop-sequence
+        # watcher): the decode loop keeps at most one chunk in
+        # flight so tokens land promptly; non-incremental requests
+        # let the loop chain every chunk and sync once (the
+        # dispatch-bound single-stream win through a high-RTT
+        # attach).
+        self.stream = stream
+        # Shared-prefix KV entry (the engine's prefix cache); only
+        # same-prefix requests batch together.
+        if prefix is not None:
+            self.prefix_fp = prefix.fp
+            self.prefix_kv = prefix.kv
+            self.prefix_len = prefix.bucket
+            self.prefix_lo = prefix.lo
+            # Tokens that actually conditioned the output = prefix
+            # real tokens + suffix real tokens (`used` stays the
+            # suffix-row count — it drives the pad mask).
+            self.prompt_tokens = prefix.used + used
+        else:
+            self.prefix_fp = None
+            self.prefix_kv = None
+            self.prefix_len = 0
+            self.prefix_lo = 0
+            self.prompt_tokens = used
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.cancelled = False    # set when the consumer disconnects
+
+    def push(self, item) -> None:
+        """Thread-safe enqueue from the decode thread."""
+        self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
+
+    def cancel(self) -> None:
+        """Consumer is gone: tell the decode loop to stop spending
+        device time on this row (a plain bool — read cross-thread,
+        worst case one extra chunk decodes)."""
+        self.cancelled = True
+
+
+class _PrefixEntry:
+    """One cached shared-prompt prefix: its device-resident KV (a
+    ``[1, bucket]``-shaped cache pytree), the bucket it was padded to,
+    its own left-pad ``lo``, and the real token count."""
+
+    __slots__ = ("fp", "kv", "bucket", "lo", "used")
+
+    def __init__(self, fp, kv, bucket, lo, used):
+        self.fp = fp
+        self.kv = kv
+        self.bucket = bucket
+        self.lo = lo
+        self.used = used
+
+
+class _SyncSink:
+    """Adapter so the synchronous ``generate_text`` path reuses
+    ``_run_batch`` verbatim: collects token chunks into a list instead
+    of an asyncio queue."""
+
+    def __init__(self, req: "GenRequest", out_ids: list):
+        self.row, self.used, self.n_new = req.row, req.used, req.n_new
+        self.temperature, self.seed = req.temperature, req.seed
+        self.top_k, self.top_p = req.top_k, req.top_p
+        self.prefix_fp, self.prefix_kv = req.prefix_fp, req.prefix_kv
+        self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
+        self.stream = req.stream
+        self._out = out_ids
+        self.error: Exception | None = None
+        self.cancelled = False
+
+    def push(self, item) -> None:
+        if isinstance(item, Exception):
+            self.error = item
+        elif item is not None:
+            self._out.extend(item["token_ids"])

@@ -134,8 +134,8 @@ def test_warmup_populates_fused_grid(pair):
     eng = _engine(pair, draft=True)
     eng.warmup(full=False)
     # Minimal warmup covers the smallest bucket at both fused tiers.
-    assert any(k[2] == "plain" for k in eng._warmed_fused)
-    assert any(k[2] == "spec" for k in eng._warmed_fused)
+    assert any(k[2] == "plain" for k in eng.fused.warmed)
+    assert any(k[2] == "spec" for k in eng.fused.warmed)
     eng._strict_admit = True
     eng.generate_text("ab", max_new_tokens=8)
     assert eng.fused_spec_calls == 1

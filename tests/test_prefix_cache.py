@@ -1,4 +1,4 @@
-"""Shared-prefix KV caching (`engine._prefix_entry`,
+"""Shared-prefix KV caching (`serving.prefix.PrefixCache`,
 `models/gpt.py::prefix_prefill_fn`, the `prefix` field of /generate):
 a prompt prefix named by many requests is prefilled ONCE, its KV
 scattered into each batch's cache, and only the per-request suffix is
@@ -149,8 +149,8 @@ async def test_different_prefixes_share_a_batch_exactly():
     ref2 = eng.generate_text("b" * 16 + "ij", max_new_tokens=4)
     # Register both prefixes first so the co-batch window isn't
     # racing the entries' first-use prefill.
-    eng._prefix_entry("a" * 16)
-    eng._prefix_entry("b" * 16)
+    eng.prefix.entry("a" * 16)
+    eng.prefix.entry("b" * 16)
     await eng.start()
     try:
         g1 = await eng.submit("ij", max_new_tokens=4, prefix="a" * 16)
@@ -184,11 +184,11 @@ async def test_prefix_request_defers_from_plain_running_batch():
 
 def test_prefix_lru_eviction():
     eng = _engine()
-    eng.max_prefixes = 2
+    eng.prefix.max_entries = 2
     for p in ("a" * 16, "b" * 16, "c" * 16):
         eng.generate_text("ij", max_new_tokens=2, prefix=p)
-    assert len(eng._prefixes) == 2
-    assert "a" * 16 not in eng._prefixes  # LRU went first
+    assert len(eng.prefix) == 2
+    assert "a" * 16 not in eng.prefix._entries  # LRU went first
     eng.generate_text("ij", max_new_tokens=2, prefix="c" * 16)
     assert eng.prefix_hits == 1
 

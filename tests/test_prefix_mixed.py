@@ -1,7 +1,7 @@
 """Cross-batch prefix regions: requests naming DIFFERENT prefixes
 share one decode batch. Each row's prefix KV is right-aligned to the
 group's common region end ``p_len = max(prefix_len)`` and masked by a
-per-row ``lo`` vector (`engine._stacked_prefix_kv`,
+per-row ``lo`` vector (`serving.prefix.PrefixCache.stacked`,
 `models/gpt.py` mask helpers' vector ``prefix_lo``).
 
 The pin is the same equivalence the single-prefix tests hold: every
@@ -73,7 +73,7 @@ async def _run_pair(eng, specs):
     entries are registered up front — the co-batch window must not
     race the first-use prefix prefill."""
     for prefix, _, _ in specs:
-        eng._prefix_entry(prefix)
+        eng.prefix.entry(prefix)
     await eng.start()
     try:
         gens = []
@@ -143,7 +143,7 @@ async def test_three_prefixes_batch_and_seeded_sampling():
     )
     ref_c = eng.generate_text(P_C + "mn", max_new_tokens=8)
     for p in (P_A, P_B, P_C):
-        eng._prefix_entry(p)
+        eng.prefix.entry(p)
     await eng.start()
     try:
         g_a = await eng.submit("ij", max_new_tokens=8, prefix=P_A)
