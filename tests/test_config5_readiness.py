@@ -99,11 +99,12 @@ def test_docs_clf_is_real_and_learnable():
         num_heads=4, intermediate_size=128, max_positions=128,
         num_classes=n_classes,
     )
-    r = fit(model, splits, steps=200, batch_size=64,
-            learning_rate=1e-3, optimizer="adamw")
+    r = fit(model, splits, steps=100, batch_size=64,
+            learning_rate=2e-3, optimizer="adamw")
     chance = max(
         np.mean(splits.y_test == c) for c in range(n_classes)
     )
+    # Measured margin at this recipe: ~0.19 over chance.
     assert r.test_accuracy > chance + 0.1, (
         r.test_accuracy, float(chance)
     )

@@ -39,7 +39,7 @@ def _train_char_repeater(model):
     """Teach the LM to continue 'ababab...' patterns (byte-level)."""
     tok = ByteTokenizer()
     pattern = np.asarray(tok.token_ids("ab" * 24), np.int32)  # 48 ids
-    seqs = np.tile(pattern, (256, 1))
+    seqs = np.tile(pattern, (64, 1))
     x, y = seqs[:, :-1], seqs[:, 1:]
     params = model.init(jax.random.key(0))
     tx = optax.adam(3e-3)

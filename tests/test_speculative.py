@@ -32,7 +32,7 @@ def _greedy_ref(model, params, prompt, n):
 def _train_repeater(model, seed=0):
     tok = ByteTokenizer()
     pattern = np.asarray(tok.token_ids("abcab" * 12), np.int32)
-    seqs = np.tile(pattern, (128, 1))
+    seqs = np.tile(pattern, (32, 1))
     x, y = seqs[:, :-1], seqs[:, 1:]
     params = model.init(jax.random.key(seed))
     tx = optax.adam(3e-3)
@@ -50,7 +50,7 @@ def _train_repeater(model, seed=0):
         updates, opt = tx.update(g, opt, params)
         return optax.apply_updates(params, updates), opt, loss
 
-    for _ in range(120):
+    for _ in range(90):
         params, opt, _ = step(params, opt)
     return params
 
