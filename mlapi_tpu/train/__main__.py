@@ -257,6 +257,11 @@ def main(argv=None) -> None:
         help="override the preset's batch size in --bench mode (MFU "
              "sweeps: run once per batch size)",
     )
+    parser.add_argument(
+        "--bench-attn", choices=["full", "flash", "ring"], default=None,
+        help="override the preset's attention_impl in --bench mode "
+             "(flash-vs-full MFU controls)",
+    )
     parser.add_argument("--out", help="checkpoint output dir")
     parser.add_argument(
         "--steps", type=int, default=None, help="override config steps"
@@ -325,6 +330,17 @@ def main(argv=None) -> None:
         else:
             targets = [p for p in DEFAULT_BENCH_PRESETS if p in preset_names()]
         for t in targets:
+            if args.bench_attn is not None:
+                import dataclasses
+
+                from mlapi_tpu.config import get_preset
+
+                cfg_t = get_preset(t) if isinstance(t, str) else t
+                t = dataclasses.replace(
+                    cfg_t,
+                    model_kwargs={**cfg_t.model_kwargs,
+                                  "attention_impl": args.bench_attn},
+                )
             row = bench_train(
                 t, bench_steps=args.bench_steps,
                 batch_size=args.bench_batch,
