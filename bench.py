@@ -346,7 +346,9 @@ def bench_generate() -> None:
     from mlapi_tpu.serving.loadgen import build_request, run_load
 
     workdir = tempfile.mkdtemp(prefix="mlapi_tpu_bench_gen_")
-    startup_timeout = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "240"))
+    # Full generative warmup compiles the fused solo+batched grids on
+    # top of the chunked ones — the 1-core CPU box needs the headroom.
+    startup_timeout = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480"))
     probe, note_extra, server_env = _choose_backend()
     try:
         ck = _write_demo_gpt_checkpoint(workdir, server_env)

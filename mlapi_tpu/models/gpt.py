@@ -716,21 +716,25 @@ def _generate_fn(model, max_new_tokens: int):
 
 @functools.lru_cache(maxsize=64)
 def generate_tier_fn(model, tier: int):
-    """The whole single-row generation as ONE XLA program — prefill +
-    a ``lax.while_loop`` of cached decode steps writing into a
-    ``[tier]`` output buffer — with the actual budget ``n_actual <=
-    tier`` TRACED. One compile per (model, prompt bucket, tier)
-    serves every request budget in the tier, and through a high-RTT
-    attach (the tunneled chip pays ~one RTT per dispatch, chained or
-    not) a generation costs ONE dispatch + ONE readback instead of
-    one per chunk — the serving engine's batch-1 fast path.
+    """A whole generation — any batch size — as ONE XLA program:
+    prefill + a ``lax.while_loop`` of cached decode steps writing into
+    a ``[B, tier]`` output buffer, with per-row budgets ``n_actual <=
+    tier`` TRACED (the loop runs to the row maximum; a finished row's
+    later writes land beyond its budget and are sliced off by the
+    caller). One compile per (model, batch, prompt bucket, tier)
+    serves every budget combination in the tier, and through a
+    high-RTT attach (the tunneled chip pays ~one RTT per dispatch,
+    chained or not) the whole BATCH costs ONE dispatch + ONE readback
+    instead of one per chunk — the serving engine's fused fast path,
+    solo and batched.
 
-    ``(params, prompt_ids [1, P], key_data [1, ...], temps [1],
-    n_pad [1], top_k [1], top_p [1], n_actual scalar)`` →
-    ``tokens [tier]`` (first ``n_actual`` valid). The emitted stream
-    is byte-identical to the chunked engine path: same left-padded
-    prefill, same per-token ``_pick_token`` stream indices (first
-    token at 0, then 1, 2, ...).
+    ``(params, prompt_ids [B, P], key_data [B, ...], temps [B],
+    n_pad [B], top_k [B], top_p [B], n_actual [B] or scalar)`` →
+    ``tokens [B, tier]`` (row ``b``'s first ``n_actual[b]`` valid).
+    Every row's stream is byte-identical to the chunked engine path
+    AND to its own solo run: same left-padded prefill, same per-row
+    PRNG streams at per-token ``_pick_token`` indices (first token at
+    0, then 1, 2, ...) — a row's tokens do not depend on its batch.
     """
 
     def _run(params, prompt_ids, key_data, temps, n_pad, top_k, top_p,
@@ -740,10 +744,12 @@ def generate_tier_fn(model, tier: int):
             model, params, prompt_ids, n_pad, p + tier
         )
         first = _pick_token(temps, logits, key_data, 0, top_k, top_p)
-        out = jnp.zeros((tier,), jnp.int32).at[0].set(first[0])
+        b = first.shape[0]
+        out = jnp.zeros((b, tier), jnp.int32).at[:, 0].set(first)
+        n_max = jnp.max(jnp.asarray(n_actual))
 
         def cond(s):
-            return s[3] < n_actual
+            return s[3] < n_max
 
         def body(s):
             cache, tok, pos, i, out = s
@@ -751,7 +757,7 @@ def generate_tier_fn(model, tier: int):
                 params, cache, tok[:, None], pos, n_pad
             )
             nxt = _pick_token(temps, logits, key_data, i, top_k, top_p)
-            out = out.at[i].set(nxt[0])
+            out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
             return (cache, nxt, pos + 1, i + 1, out)
 
         s = (cache, first, jnp.int32(p), jnp.int32(1), out)

@@ -606,6 +606,9 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.fused_spec_calls"] = (
                 engine.fused_spec_calls
             )
+            snap["counters"]["generate.fused_batch_calls"] = (
+                engine.fused_batch_calls
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
         return snap

@@ -452,6 +452,7 @@ class TextGenerationEngine:
         spec_sample: bool = False,
         fused_single: bool = True,
         fused_max_new: int | None = None,
+        fused_batch: bool | str = "auto",
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -517,6 +518,17 @@ class TextGenerationEngine:
             if fused_max_new is not None
             else max(64, default_max_new_tokens)
         )
+        # Batched fused policy: "auto" = engage only on a high-RTT
+        # attach, where one dispatch per batch beats per-chunk round
+        # trips; continuous batching wins on local attaches (measured
+        # — see FusedSinglePath.try_run_batch). Validated here so the
+        # run gate and the warm grid can never disagree on the value.
+        if fused_batch not in (True, False, "auto"):
+            raise ValueError(
+                f"fused_batch must be True, False, or 'auto'; got "
+                f"{fused_batch!r}"
+            )
+        self.fused_batch = fused_batch
         self.model = model
         self.tokenizer = tokenizer
         self.mesh = mesh
@@ -595,6 +607,7 @@ class TextGenerationEngine:
         self.spec_accepted = 0
         self.fused_calls = 0
         self.fused_spec_calls = 0
+        self.fused_batch_calls = 0
         # Host-loop speculation phase: rounds + warmed-shape state
         # live in serving/spec_phase.py.
         self.spec = SpecPhase(self)
@@ -739,6 +752,31 @@ class TextGenerationEngine:
     def _key_data(seed: int) -> np.ndarray:
         return np.asarray(jax.random.key_data(jax.random.key(seed)))
 
+    def _pack_rows(self, reqs, bucket: int, b_pad: int):
+        """Pack the per-row host mirrors for a batch: left-padded
+        prompt rows plus the pad/sampling vectors, dummy rows (pad to
+        ``b_pad``) fully masked. ONE definition shared by the chunked
+        batch formation and the fused-batched fast path — the two
+        paths' byte-identity contract rests on packing rows the same
+        way. Returns ``(prompt, n_pad, temps, topk, topp, keys)``."""
+        b = len(reqs)
+        prompt = np.full((b_pad, bucket), self.tokenizer.pad_id, np.int32)
+        n_pad = np.full((b_pad,), max(bucket - 1, 0), np.int32)
+        temps = np.zeros((b_pad,), np.float32)
+        topk = np.zeros((b_pad,), np.int32)
+        topp = np.ones((b_pad,), np.float32)
+        for i, r in enumerate(reqs):
+            prompt[i, bucket - len(r.row):] = r.row
+            n_pad[i] = bucket - r.used
+            temps[i] = r.temperature
+            topk[i] = r.top_k
+            topp[i] = r.top_p
+        keys = np.stack(
+            [self._key_data(r.seed) for r in reqs]
+            + [self._key_data(0)] * (b_pad - b)
+        )
+        return prompt, n_pad, temps, topk, topp, keys
+
     def _run_batch(self, reqs: list, admit: bool = False,
                    fused_ok: bool = True) -> None:
         """Decode one coalesced batch, streaming chunks to each
@@ -772,13 +810,18 @@ class TextGenerationEngine:
 
         try:
             self.batch_calls += 1
-            if (
-                fused_ok and self.fused_single and len(reqs) == 1
-                and reqs[0].prefix_len == 0 and not reqs[0].stream
-                and not reqs[0].cancelled
-                and self.fused.try_run(reqs[0], admit)
-            ):
-                return
+            if fused_ok and self.fused_single:
+                if (
+                    len(reqs) == 1
+                    and reqs[0].prefix_len == 0 and not reqs[0].stream
+                    and not reqs[0].cancelled
+                    and self.fused.try_run(reqs[0], admit)
+                ):
+                    return
+                if len(reqs) > 1 and self.fused.try_run_batch(
+                    reqs, admit
+                ):
+                    return
             bucket = max(len(r.row) for r in reqs)
             n_new_max = max(r.n_new for r in reqs)
             # The prefix region spans [0, p_len) of every row's cache.
@@ -807,23 +850,12 @@ class TextGenerationEngine:
             while b_max < self.max_batch:
                 b_max *= 2
 
-            prompt = np.full((b_pad, bucket), self.tokenizer.pad_id, np.int32)
-            n_pad = np.full((b_pad,), max(bucket - 1, 0), np.int32)
-            temps = np.zeros((b_pad,), np.float32)
-            topk = np.zeros((b_pad,), np.int32)
-            topp = np.ones((b_pad,), np.float32)
+            prompt, n_pad, temps, topk, topp, keys = self._pack_rows(
+                reqs, bucket, b_pad
+            )
             lo = np.full((b_pad,), p_len, np.int32)
             for i, r in enumerate(reqs):
-                prompt[i, bucket - len(r.row):] = r.row
-                n_pad[i] = bucket - r.used
-                temps[i] = r.temperature
-                topk[i] = r.top_k
-                topp[i] = r.top_p
                 lo[i] = p_len - r.prefix_len + r.prefix_lo
-            keys = np.stack(
-                [self._key_data(r.seed) for r in reqs]
-                + [self._key_data(0)] * (b_pad - b)
-            )
 
             if p_len:
                 # Shared-prefix batch: the prefix KV is scattered into

@@ -136,12 +136,93 @@ class FusedSinglePath:
                     eng.params, row, kd, temps, n_pad, topk, topp,
                     jnp.int32(n_new),
                 )
-            )[:n_new]
+            )[0, :n_new]
             eng.fused_calls += 1
         self.warmed.add((bucket, tier, kind))
         if not r.cancelled:
             r.push({"token_ids": ids.tolist()})
             r.push(None)
+        return True
+
+    def try_run_batch(self, reqs, admit: bool) -> bool:
+        """A whole FORMED batch as one XLA program: ``generate_tier_fn``
+        is batch-polymorphic (per-row traced budgets, per-row PRNG
+        streams), so a collector batch of plain non-streaming requests
+        costs ONE dispatch + ONE readback — through a high-RTT attach
+        that replaces (max_budget / chunk) chunk dispatches with one
+        round trip for all rows. Returns ``False`` to fall through to
+        continuous batching: streams, prefix rows, draft-attached
+        engines (batched SPECULATION's device-compute win takes
+        priority there), long prompts, over-cap budgets, staged
+        joiners, and unwarmed shapes in strict mode. Each row's stream
+        stays byte-identical to its solo run (per-row fold_in
+        streams), so which path served a batch is invisible.
+        """
+        eng = self.eng
+        # Attach-dependent policy, measured both ways: on a HIGH-RTT
+        # attach one dispatch per batch beats per-chunk round trips
+        # (the tunnel economics); on a LOW-RTT attach the atomic fused
+        # batch blocks continuous admission and LOSES to chunked
+        # continuous batching (CPU: 4,347 tok/s fused-batched vs
+        # ~5,8-7,2k chunked at c8, and HOLB short-latency 27 ms vs 7).
+        # ``fused_batch="auto"`` therefore engages only when the
+        # dispatch RTT is tunnel-like; True/False force it for tests
+        # and deployments that know better.
+        batched_on = eng.fused_batch is True or (
+            eng.fused_batch == "auto" and not eng._admit_eager
+        )
+        if not batched_on:
+            return False
+        if eng.draft_model is not None:
+            return False
+        if admit:
+            with eng._alock:
+                if eng._admit or eng._deferred:
+                    return False
+        if any(r.stream or r.cancelled or r.prefix_len for r in reqs):
+            return False
+        bucket = max(len(r.row) for r in reqs)
+        if bucket > eng.prompt_buckets[-1]:
+            return False
+        n_max = max(r.n_new for r in reqs)
+        if n_max > eng.fused_max_new:
+            return False
+        tier = next(t for t in self.tiers() if t >= n_max)
+        if bucket + tier > eng.model.max_positions:
+            return False
+        b = len(reqs)
+        b_pad = 1
+        while b_pad < b:
+            b_pad *= 2
+        kind = f"batched{b_pad}"
+        if (
+            eng._strict_admit
+            and (bucket, tier, kind) not in self.warmed
+        ):
+            return False
+
+        from mlapi_tpu.models.gpt import generate_tier_fn
+
+        prompt, n_pad, temps, topk, topp, keys = eng._pack_rows(
+            reqs, bucket, b_pad
+        )
+        n_vec = np.ones((b_pad,), np.int32)  # dummy rows: 1 token
+        for i, r in enumerate(reqs):
+            n_vec[i] = r.n_new
+        out = np.asarray(
+            generate_tier_fn(eng.model, tier)(
+                eng.params, jnp.asarray(prompt), jnp.asarray(keys),
+                jnp.asarray(temps), jnp.asarray(n_pad),
+                jnp.asarray(topk), jnp.asarray(topp),
+                jnp.asarray(n_vec),
+            )
+        )
+        self.warmed.add((bucket, tier, kind))
+        eng.fused_batch_calls += 1
+        for i, r in enumerate(reqs):
+            if not r.cancelled:
+                r.push({"token_ids": out[i, : r.n_new].tolist()})
+                r.push(None)
         return True
 
     def warm(self, full: bool) -> int:
@@ -162,6 +243,21 @@ class FusedSinglePath:
         z1f = jnp.zeros((1,), jnp.float32)
         z1i = jnp.zeros((1,), jnp.int32)
         o1f = jnp.ones((1,), jnp.float32)
+        # Batched-fused grid: power-of-two batch sizes at the DEFAULT
+        # tier only (whole-generation compiles are the most expensive
+        # programs in the warmup; larger tiers stay chunked in strict
+        # mode rather than doubling the grid). Only warmed where the
+        # batched path can actually engage — ``try_run_batch``'s
+        # attach policy — so a local attach doesn't pay the compiles.
+        batch_sizes = []
+        batched_on = eng.fused_batch is True or (
+            eng.fused_batch == "auto" and not eng._admit_eager
+        )
+        if full and batched_on and eng.max_batch > 1:
+            bsz = 2
+            while bsz <= 1 << (eng.max_batch - 1).bit_length():
+                batch_sizes.append(bsz)
+                bsz *= 2
         shapes = 0
         for bucket in buckets:
             row = jnp.asarray(
@@ -176,6 +272,29 @@ class FusedSinglePath:
                     )
                     self.warmed.add((bucket, tier, "plain"))
                     shapes += 1
+                    if tier == tiers[0]:
+                        for bsz in batch_sizes:
+                            generate_tier_fn(eng.model, tier)(
+                                eng.params,
+                                jnp.asarray(np.broadcast_to(
+                                    np.asarray(row),
+                                    (bsz, bucket),
+                                ).copy()),
+                                jnp.asarray(np.stack(
+                                    [eng._key_data(0)] * bsz
+                                )),
+                                jnp.zeros((bsz,), jnp.float32),
+                                jnp.asarray(np.full(
+                                    (bsz,), bucket - 1, np.int32
+                                )),
+                                jnp.zeros((bsz,), jnp.int32),
+                                jnp.ones((bsz,), jnp.float32),
+                                jnp.asarray(np.ones((bsz,), np.int32)),
+                            )
+                            self.warmed.add(
+                                (bucket, tier, f"batched{bsz}")
+                            )
+                            shapes += 1
                 if eng.draft_model is None:
                     continue
                 k = max(1, min(eng.spec_k, tier))

@@ -333,8 +333,6 @@ def main(argv=None) -> None:
             if args.bench_attn is not None:
                 import dataclasses
 
-                from mlapi_tpu.config import get_preset
-
                 cfg_t = get_preset(t) if isinstance(t, str) else t
                 t = dataclasses.replace(
                     cfg_t,

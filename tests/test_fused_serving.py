@@ -142,6 +142,76 @@ def test_warmup_populates_fused_grid(pair):
 
 
 @pytest.mark.anyio
+async def test_formed_batch_runs_fused_and_matches_solo(pair):
+    """A collector batch of plain non-stream requests runs as ONE
+    program (per-row traced budgets); every row byte-identical to its
+    solo run, mixed greedy/sampled/budgets included."""
+    eng = _engine(pair, fused_batch=True)  # "auto" declines on CPU
+    solo = _engine(pair)
+    loop = asyncio.get_running_loop()
+    specs = [
+        ("the quick brown fox", dict(n=12, temp=0.0, seed=0)),
+        ("jumps over", dict(n=20, temp=0.8, seed=3)),
+        ("the lazy dog", dict(n=5, temp=0.0, seed=0)),
+    ]
+    reqs = [
+        eng._encode(text, kw["n"], kw["temp"], kw["seed"], loop)
+        for text, kw in specs
+    ]
+    await loop.run_in_executor(None, lambda: eng._run_batch(reqs, True))
+    assert eng.fused_batch_calls == 1
+    assert eng.chunk_calls == 0
+    for (text, kw), r in zip(specs, reqs):
+        got = []
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got.extend(item["token_ids"])
+        ref = solo.generate_text(
+            text, max_new_tokens=kw["n"], temperature=kw["temp"],
+            seed=kw["seed"],
+        )
+        assert got == ref["token_ids"], text
+        assert len(got) == kw["n"]
+
+
+@pytest.mark.anyio
+async def test_batched_fused_skipped_with_draft_or_stream(pair):
+    """Draft engines keep batched SPECULATION; a stream row keeps the
+    whole batch chunked."""
+    loop = asyncio.get_running_loop()
+    spec_eng = _engine(pair, draft=True)
+    reqs = [
+        spec_eng._encode("abcab", 8, 0.0, 0, loop),
+        spec_eng._encode("xyz", 8, 0.0, 0, loop),
+    ]
+    await loop.run_in_executor(
+        None, lambda: spec_eng._run_batch(reqs, True)
+    )
+    assert spec_eng.fused_batch_calls == 0
+    for r in reqs:
+        while await r.queue.get() is not None:
+            pass
+
+    eng = _engine(pair)
+    reqs = [
+        eng._encode("abcab", 8, 0.0, 0, loop, stream=True),
+        eng._encode("xyz", 8, 0.0, 0, loop),
+    ]
+    await loop.run_in_executor(None, lambda: eng._run_batch(reqs, True))
+    assert eng.fused_batch_calls == 0
+    assert eng.chunk_calls > 0
+    for r in reqs:
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+
+
+@pytest.mark.anyio
 async def test_staged_joiners_suppress_fused_path(pair):
     """A collector batch (admit=True) with joiners already staged must
     NOT take the fused path — one uninterruptible fused program would

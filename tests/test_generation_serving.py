@@ -289,6 +289,10 @@ def test_mixed_length_batch_compacts_and_matches(gpt_checkpoint):
     from mlapi_tpu.serving.engine import _SyncSink
 
     engine = InferenceEngine.from_checkpoint(gpt_checkpoint)
+    # Compaction belongs to the CHUNKED path — the fused-batched fast
+    # path would (correctly) serve this batch in one program with no
+    # compaction to observe.
+    engine.fused_single = False
     singles = [
         engine.generate_text("abab", max_new_tokens=n, temperature=t, seed=s)
         for n, t, s in ((4, 0.0, 0), (4, 0.7, 1), (4, 0.0, 2), (40, 0.7, 3))
