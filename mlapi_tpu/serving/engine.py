@@ -160,10 +160,10 @@ class InferenceEngine:
         else:
             feature_names = ()
 
-        abstract = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            model.init(jax.random.key(0)),
-        )
+        # eval_shape: abstract tree only — a full random init of a
+        # large model would allocate (and page) every parameter just
+        # to read shapes.
+        abstract = jax.eval_shape(lambda: model.init(jax.random.key(0)))
         params, meta = load_checkpoint(path, abstract)
 
         # Engine dispatch keys off the INNER model: the quantized
@@ -649,6 +649,17 @@ class TextGenerationEngine:
     def _bucket(self, n: int) -> int:
         i = bisect.bisect_left(self.prompt_buckets, n)
         return self.prompt_buckets[min(i, len(self.prompt_buckets) - 1)]
+
+    @property
+    def default_tier(self) -> int:
+        """The power-of-two (of ``chunk``) tier covering the default
+        token budget — the floor every warm grid and the fused ladder
+        share (ONE definition; four copies of this loop had to agree
+        before it existed)."""
+        tier = self.chunk
+        while tier < self.default_max_new_tokens:
+            tier *= 2
+        return tier
 
     def _cache_len(self, bucket: int, n_new: int) -> int:
         """Static KV-cache length for a batch, quantized so the
@@ -1720,9 +1731,7 @@ class TextGenerationEngine:
                 continue
             # Largest n_new that still lands in the default cache tier
             # (so warm programs are byte-identical to default traffic).
-            tier = self.chunk
-            while tier < self.default_max_new_tokens:
-                tier *= 2
+            tier = self.default_tier
             for bsz in batches:
                 # Row 0 runs two chunks, the rest finish after chunk
                 # one: chunk 1 executes the FULL-width decode program,
@@ -1779,9 +1788,7 @@ class TextGenerationEngine:
         attach) and defer otherwise."""
         from mlapi_tpu.models.gpt import admit_scatter_fn, prefill_fn
 
-        tier = self.chunk
-        while tier < self.default_max_new_tokens:
-            tier *= 2
+        tier = self.default_tier
         shapes = 0
         minis = {}
         for bj in self.prompt_buckets:

@@ -36,9 +36,7 @@ class FusedSinglePath:
         strict mode silently falls back to chunked on a warm-set miss,
         so the two must be tier-identical by construction."""
         eng = self.eng
-        t = eng.chunk
-        while t < eng.default_max_new_tokens:
-            t *= 2
+        t = eng.default_tier
         tiers = [t]
         while t < eng.fused_max_new:
             t *= 2
