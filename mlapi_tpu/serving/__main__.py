@@ -157,6 +157,8 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--draft-checkpoint", args.draft_checkpoint]
     if getattr(args, "spec_sample", False):
         cmd += ["--spec-sample"]
+    if getattr(args, "fused_batch", "auto") != "auto":
+        cmd += ["--fused-batch", args.fused_batch]
     # systemd/docker stop the supervisor with SIGTERM; without a
     # handler the finally below never runs and the workers are
     # orphaned still bound to the port (SO_REUSEPORT would then let a
@@ -264,6 +266,13 @@ def main(argv=None) -> None:
              "byte-reproducible per seed (solo runs are)",
     )
     parser.add_argument(
+        "--fused-batch", choices=["auto", "on", "off"], default="auto",
+        help="fused BATCHED generation policy: 'auto' engages only on "
+             "a high-RTT attach (one dispatch per formed batch beats "
+             "per-chunk round trips there; continuous batching wins "
+             "locally — measured both ways), 'on'/'off' force it",
+    )
+    parser.add_argument(
         "--mesh-shape", default=None,
         help="serve sharded over a (data, model) device mesh, e.g. "
              "'1,4' or '2,4' — params follow the model's declared TP "
@@ -347,6 +356,9 @@ def main(argv=None) -> None:
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,
+        fused_batch={"auto": "auto", "on": True, "off": False}[
+            args.fused_batch
+        ],
     )
     app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
     server = Server(app, host=args.host, port=args.port,

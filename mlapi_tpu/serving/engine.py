@@ -129,6 +129,7 @@ class InferenceEngine:
         quantize: str | None = None,
         draft_checkpoint=None,
         spec_sample: bool = False,
+        fused_batch: bool | str = "auto",
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -225,6 +226,7 @@ class InferenceEngine:
                 mesh=mesh,
                 draft=draft,
                 spec_sample=spec_sample,
+                fused_batch=fused_batch,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"draft": str(draft_checkpoint)}

@@ -1,0 +1,128 @@
+"""Round-4 whole-system soak: every serving capability at once, with
+byte-exactness checks.
+
+Mixed traffic — plain greedy, sampled (seeded), prefix-cached, NDJSON
+streams, a fraction cancelled mid-stream — against ONE engine with the
+round's fast paths forced on (``fused_batch=True``, solo fused default)
+so the soak exercises fused solo, fused batched, chunked streams,
+continuous admission, and the prefix KV path in the same run. Every
+completed non-stream response and every completed stream's final ids
+must be byte-identical to a solo reference run of the same request.
+
+Run on CPU anywhere: ``python tools/soak_r04.py``; prints one JSON
+summary line. Exit 0 = zero mismatches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from mlapi_tpu.models import get_model
+    from mlapi_tpu.serving.engine import TextGenerationEngine
+    from mlapi_tpu.text import ByteTokenizer
+
+    cfg = dict(
+        vocab_size=260, hidden_size=48, num_layers=2, num_heads=4,
+        max_positions=192, compute_dtype="float32",
+    )
+    model = get_model("gpt_lm", **cfg)
+    params = model.init(jax.random.key(0))
+    eng = TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(), chunk=4,
+        max_batch=4, fused_batch=True,
+    )
+    ref = TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(), chunk=4,
+        fused_single=False,
+    )
+
+    prefixes = ["the quick brown fox. ", "pack my box with jugs. "]
+    rng = random.Random(11)
+    specs = []
+    for i in range(96):
+        kind = rng.choice(["plain", "plain", "sampled", "prefix", "stream"])
+        specs.append({
+            "kind": kind,
+            "text": rng.choice(["alpha bravo", "charlie delta",
+                                "echo foxtrot golf", "hotel india"]),
+            "n": rng.choice([4, 8, 12, 20]),
+            "temp": 0.8 if kind == "sampled" else 0.0,
+            "seed": i,
+            "prefix": rng.choice(prefixes) if kind == "prefix" else None,
+            "stream": kind == "stream",
+            "cancel": kind == "stream" and rng.random() < 0.15,
+        })
+
+    await eng.start()
+    mismatches = 0
+    cancelled = 0
+    try:
+        async def one(s):
+            nonlocal mismatches, cancelled
+            gen = await eng.submit(
+                s["text"], max_new_tokens=s["n"], temperature=s["temp"],
+                seed=s["seed"], prefix=s["prefix"], stream=s["stream"],
+            )
+            got: list[int] = []
+            n_items = 0
+            while True:
+                item = await gen.queue.get()
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                got.extend(item["token_ids"])
+                n_items += 1
+                if s["cancel"] and n_items == 1:
+                    gen.cancel()
+                    cancelled += 1
+                    return
+            want = ref.generate_text(
+                s["text"], max_new_tokens=s["n"], temperature=s["temp"],
+                seed=s["seed"], prefix=s["prefix"],
+            )["token_ids"]
+            if got != want:
+                mismatches += 1
+
+        # Staggered waves so batches form at every size and admission
+        # happens mid-flight.
+        tasks = []
+        for i, s in enumerate(specs):
+            tasks.append(asyncio.create_task(one(s)))
+            if i % 7 == 0:
+                await asyncio.sleep(0.05)
+        await asyncio.gather(*tasks)
+    finally:
+        await eng.stop()
+
+    summary = {
+        "requests": len(specs),
+        "cancelled_midstream": cancelled,
+        "mismatches": mismatches,
+        "batch_calls": eng.batch_calls,
+        "fused_calls": eng.fused_calls,
+        "fused_batch_calls": eng.fused_batch_calls,
+        "chunk_calls": eng.chunk_calls,
+        "admitted": eng.admitted,
+        "compactions": eng.compactions,
+        "prefix_hits": eng.prefix_hits,
+        "prefix_misses": eng.prefix_misses,
+    }
+    print(json.dumps(summary))
+    return 0 if mismatches == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
