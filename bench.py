@@ -714,7 +714,13 @@ eng = InferenceEngine.from_checkpoint({os.path.join(workdir, 'docs-gpt')!r})
 # Minimal warmup: this bench is strictly batch-1 single-stream, and
 # its own warm loop compiles the exact measured shapes off the clock.
 eng.warmup(full=False)
+# The engine's batch-1 default is the FUSED path (r04); measure the
+# chunked path explicitly by pinning it off, then the default.
+eng.fused_single = False
 chunked = bench(lambda p: eng.generate_text(p, max_new_tokens=N)["token_ids"])
+eng.fused_single = True
+engine_fused = bench(
+    lambda p: eng.generate_text(p, max_new_tokens=N)["token_ids"])
 refs = [eng.generate_text(p, max_new_tokens=N)["token_ids"] for p in P]
 
 tparams, tmeta = load_checkpoint({os.path.join(workdir, 'docs-gpt')!r})
@@ -740,6 +746,7 @@ for p, ref in zip(P, refs):
     assert got == ref, "fused spec diverged from engine greedy"
 print(json.dumps({{
     "chunked_tokens_per_s": chunked,
+    "engine_fused_tokens_per_s": engine_fused,
     "fused_plain_tokens_per_s": fused_plain,
     "fused_spec_tokens_per_s": fused_spec,
     "acceptance": round(acc[0] / max(1, acc[1]), 3),
