@@ -977,6 +977,185 @@ def fused_spec_fn(target, draft, p: int, n: int, k: int,
     return jax.jit(_run)
 
 
+@functools.lru_cache(maxsize=32)
+def fused_spec_batched_fn(target, draft, p: int, n: int, k: int,
+                          sampled: bool = False):
+    """The ENTIRE **batched** speculative generation as ONE XLA
+    program — the last cell of the fused matrix ({greedy, sampled} ×
+    {solo, batched} × {host-loop, fused}). Per-row cache positions
+    desynchronize immediately (row ``b`` advances ``m_b + 1`` slots a
+    round), which the rank-polymorphic decode/extend cores already
+    express: ``decode_step``/``extend_core`` take ``[B]`` position
+    vectors, cache writes vmap per row. Rows that exhaust their budget
+    FREEZE (``active`` mask pins their positions; their round writes
+    overwrite their own dead slots) until every row finishes, so the
+    loop trip count is the slowest row's. Through a high-RTT attach
+    this replaces the host batched loop's 2 dispatches per round
+    (~2·rounds·RTT per batch) with ONE dispatch + ONE packed readback.
+
+    Same compile-key/traced-argument discipline as
+    :func:`fused_spec_fn`: static ``(prompt_width, n_tier, k,
+    sampled)``; traced ``(n_pad [B], n_actual [B])``. Every row's
+    emitted stream is byte-identical to its SOLO fused run (greedy:
+    argmax-exact; sampled: per-row keys drive the same tagged
+    streams), which is what the tests pin.
+
+    Returns ``packed [B, n + 3]``: per-row tokens (first
+    ``n_actual[b]`` valid) then (rounds, accepted, drafted).
+    """
+    kw = k + 1
+    total = p + n + k + 1
+
+    def _run(t_params, d_params, prompt_ids, key_data, temps, topk,
+             topp, n_pad, n_actual):
+        from mlapi_tpu.models.gpt import _pick_token
+
+        b = prompt_ids.shape[0]
+        rows = jnp.arange(b)
+        keys = jax.vmap(jax.random.wrap_key_data)(key_data)
+        t_cache, t_logits = target.prefill_core(
+            t_params, prompt_ids, n_pad, total
+        )
+        d_cache, _ = draft.prefill_core(d_params, prompt_ids, n_pad, total)
+        if sampled:
+            t0 = _pick_token(temps, t_logits, key_data, 0, topk, topp)
+        else:
+            t0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        out = jnp.zeros((b, n + kw), jnp.int32).at[:, 0].set(t0)
+
+        def body(s):
+            (t_cache, d_cache, out, n_out, t_upto, d_upto, pend,
+             n_pend, rounds, accepted, drafted) = s
+            active = n_out < n_actual
+
+            def dstep(carry, i):
+                d_cache, tok = carry
+                logits, d_cache = draft.decode_step(
+                    d_params, d_cache, tok[:, None], d_upto + i, n_pad
+                )
+                if sampled:
+                    probs = _warped_probs(logits, temps, topk, topp)
+                    prop_i = jnp.maximum(i - (n_pend - 1), 0) + n_out
+                    nxt = jax.vmap(
+                        lambda kk, pi, pr: jax.random.categorical(
+                            jax.random.fold_in(
+                                jax.random.fold_in(kk, _DRAFT_TAG), pi
+                            ),
+                            jnp.log(pr),
+                        )
+                    )(keys, prop_i, probs).astype(jnp.int32)
+                else:
+                    probs = jnp.zeros((b, 0), jnp.float32)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                feed = jnp.where(
+                    i + 1 < n_pend,
+                    pend[rows, jnp.minimum(i + 1, 1)],
+                    nxt,
+                )
+                return (d_cache, feed), (nxt, probs)
+
+            (d_cache, _), (toks, qrows) = jax.lax.scan(
+                dstep, (d_cache, pend[:, 0]), jnp.arange(kw)
+            )
+            # Per-row proposal window: row b's k proposals start at
+            # its own pending offset (n_pend[b] - 1) in the scan.
+            props = jax.vmap(
+                lambda tb, o: jax.lax.dynamic_slice(tb, (o,), (k,))
+            )(toks.T, n_pend - 1)                        # [B, k]
+            d_upto_n = d_upto + jnp.where(active, n_pend + k - 1, 0)
+
+            head = pend[rows, n_pend - 1]
+            block = jnp.concatenate([head[:, None], props], axis=1)
+            t_cache, logits = target.extend_core(
+                t_params, t_cache, block, t_upto, n_pad,
+                jnp.int32(0), jnp.int32(0), all_logits=True,
+            )                                            # [B, kw, V]
+            usable = jnp.clip(
+                jnp.minimum(k, n_actual - n_out - 1), 0, k
+            )
+            if sampled:
+                q_probs = jax.vmap(
+                    lambda qb, o: jax.lax.dynamic_slice(
+                        qb, (o, 0), (k, qb.shape[-1])
+                    )
+                )(jnp.swapaxes(qrows, 0, 1), n_pend - 1)  # [B, k, V]
+                pr = jax.vmap(
+                    lambda lg, t, tk, tp: _warped_probs(
+                        lg, jnp.broadcast_to(t, (kw,)),
+                        jnp.broadcast_to(tk, (kw,)),
+                        jnp.broadcast_to(tp, (kw,)),
+                    )
+                )(logits, temps, topk, topp)              # [B, kw, V]
+                m, bonus = jax.vmap(_accept_and_draw)(
+                    keys, pr, q_probs, props, usable, n_out
+                )
+            else:
+                expect = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                acc = (props == expect[:, :k]) & (
+                    jnp.arange(k)[None, :] < usable[:, None]
+                )
+                m = jnp.argmin(
+                    jnp.concatenate(
+                        [acc, jnp.zeros((b, 1), bool)], axis=1
+                    ).astype(jnp.int32),
+                    axis=1,
+                )
+                bonus = expect[rows, m]
+            seg = jnp.where(
+                jnp.arange(kw)[None, :] < m[:, None],
+                jnp.concatenate(
+                    [props, jnp.zeros((b, 1), jnp.int32)], axis=1
+                ),
+                bonus[:, None],
+            )
+            out = jax.vmap(
+                lambda ob, sb, o: jax.lax.dynamic_update_slice(
+                    ob, sb, (o,)
+                )
+            )(out, seg, n_out)
+            adv = jnp.where(active, m + 1, 0)
+            t_upto_n = t_upto + adv
+            full = (m == k) & active
+            pend_n = jnp.where(
+                full[:, None],
+                jnp.stack([props[:, k - 1], bonus], axis=1),
+                jnp.stack([bonus, jnp.zeros((b,), jnp.int32)], axis=1),
+            )
+            n_pend_n = jnp.where(
+                active, jnp.where(full, 2, 1), n_pend
+            )
+            d_upto_n = jnp.where(full, d_upto_n, t_upto_n)
+            return (
+                t_cache, d_cache, out, n_out + adv, t_upto_n,
+                d_upto_n, pend_n, n_pend_n, rounds + 1,
+                accepted + jnp.where(active, m, 0),
+                drafted + jnp.where(active, usable, 0),
+            )
+
+        def cond(s):
+            return jnp.any(s[3] < n_actual)
+
+        init = (
+            t_cache, d_cache, out, jnp.ones((b,), jnp.int32),
+            jnp.full((b,), p, jnp.int32), jnp.full((b,), p, jnp.int32),
+            jnp.stack([t0, jnp.zeros((b,), jnp.int32)], axis=1),
+            jnp.ones((b,), jnp.int32), jnp.int32(0),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+        )
+        s = jax.lax.while_loop(cond, body, init)
+        return jnp.concatenate(
+            [
+                s[2][:, :n],
+                jnp.broadcast_to(s[8], (b,))[:, None],
+                s[9][:, None],
+                s[10][:, None],
+            ],
+            axis=1,
+        )
+
+    return jax.jit(_run)
+
+
 def _fused_run(target, t_params, draft, d_params, prompt_ids,
                max_new_tokens, k, sampled, key_data, temps, topk, topp):
     """Shared validation + dispatch + packed-stats unpack for both

@@ -43,6 +43,23 @@ class FusedSinglePath:
             tiers.append(t)
         return tiers
 
+    def _spec_headroom(self, bucket: int, tier: int):
+        """Fused speculation's window check, ONE definition for the
+        run paths and the warm grids (strict mode rejects any shape
+        the warm grid skipped, so eligibility must match exactly):
+        returns ``(fits, k)`` where ``k`` is the per-tier draft depth
+        and ``fits`` says ``bucket + tier + k + 1`` slots fit BOTH
+        model windows."""
+        eng = self.eng
+        k = max(1, min(eng.spec_k, tier))
+        need = bucket + tier + k + 1
+        fits = (
+            eng.draft_model is not None
+            and need <= eng.model.max_positions
+            and need <= eng.draft_model.max_positions
+        )
+        return fits, k
+
     def try_run(self, r, admit: bool) -> bool:
         """Batch-1 fast path: run ``r``'s WHOLE generation as one XLA
         program (``generate_tier_fn``, or ``fused_spec_fn`` with the
@@ -79,15 +96,10 @@ class FusedSinglePath:
         greedy = (
             r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
         )
-        spec = eng.draft_model is not None and (
+        fits, k = self._spec_headroom(bucket, tier)
+        spec = fits and (
             greedy or (eng.spec_sample and r.temperature > 0.0)
         )
-        k = max(1, min(eng.spec_k, tier))
-        if spec and (
-            bucket + tier + k + 1 > eng.model.max_positions
-            or bucket + tier + k + 1 > eng.draft_model.max_positions
-        ):
-            spec = False
         if not spec and bucket + tier > eng.model.max_positions:
             return False
         # Greedy and sampled speculation are DIFFERENT compiled
@@ -148,13 +160,16 @@ class FusedSinglePath:
         streams), so a collector batch of plain non-streaming requests
         costs ONE dispatch + ONE readback — through a high-RTT attach
         that replaces (max_budget / chunk) chunk dispatches with one
-        round trip for all rows. Returns ``False`` to fall through to
-        continuous batching: streams, prefix rows, draft-attached
-        engines (batched SPECULATION's device-compute win takes
-        priority there), long prompts, over-cap budgets, staged
-        joiners, and unwarmed shapes in strict mode. Each row's stream
-        stays byte-identical to its solo run (per-row fold_in
-        streams), so which path served a batch is invisible.
+        round trip for all rows. With a draft attached, an all-greedy
+        (or, under ``--spec-sample``, all-sampled) batch runs the
+        whole BATCHED SPECULATION as one program instead
+        (``fused_spec_batched_fn`` — vs the host batched phase's two
+        dispatches per round). Returns ``False`` to fall through to
+        continuous batching: streams, prefix rows, mixed
+        greedy/sampled draft batches, long prompts, over-cap budgets,
+        staged joiners, and unwarmed shapes in strict mode. Each
+        row's stream stays byte-identical to its solo run (per-row
+        fold_in streams), so which path served a batch is invisible.
         """
         eng = self.eng
         # Attach-dependent policy, measured both ways: on a HIGH-RTT
@@ -171,8 +186,6 @@ class FusedSinglePath:
         )
         if not batched_on:
             return False
-        if eng.draft_model is not None:
-            return False
         if admit:
             with eng._alock:
                 if eng._admit or eng._deferred:
@@ -186,20 +199,49 @@ class FusedSinglePath:
         if n_max > eng.fused_max_new:
             return False
         tier = next(t for t in self.tiers() if t >= n_max)
-        if bucket + tier > eng.model.max_positions:
+        # With a draft attached, the batch speculates as a whole —
+        # fused_spec_batched_fn, the last cell of the fused matrix —
+        # when every row is greedy (or, under --spec-sample, every
+        # row sampled; ``sampled`` is static in the program). Mixed
+        # batches and no-headroom windows fall through to the host
+        # phases.
+        spec = False
+        sampled = False
+        fits, k = self._spec_headroom(bucket, tier)
+        if eng.draft_model is not None:
+            all_greedy = all(
+                r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
+                for r in reqs
+            )
+            all_sampled = eng.spec_sample and all(
+                r.temperature > 0.0 for r in reqs
+            )
+            if fits and (all_greedy or all_sampled):
+                spec = True
+                sampled = all_sampled and not all_greedy
+            elif not (all_greedy or all_sampled):
+                # Mixed greedy/sampled: ``sampled`` is static per
+                # program — the host batched-spec / chunked paths
+                # serve it.
+                return False
+            # No spec headroom: degrade to the plain fused-batched
+            # program (same policy as the solo path) — one dispatch
+            # still beats the host loop through a tunnel.
+        if not spec and bucket + tier > eng.model.max_positions:
             return False
         b = len(reqs)
         b_pad = 1
         while b_pad < b:
             b_pad *= 2
-        kind = f"batched{b_pad}"
+        kind = (
+            f"spec_batched{'_s' if sampled else ''}{b_pad}"
+            if spec else f"batched{b_pad}"
+        )
         if (
             eng._strict_admit
             and (bucket, tier, kind) not in self.warmed
         ):
             return False
-
-        from mlapi_tpu.models.gpt import generate_tier_fn
 
         prompt, n_pad, temps, topk, topp, keys = eng._pack_rows(
             reqs, bucket, b_pad
@@ -207,14 +249,34 @@ class FusedSinglePath:
         n_vec = np.ones((b_pad,), np.int32)  # dummy rows: 1 token
         for i, r in enumerate(reqs):
             n_vec[i] = r.n_new
-        out = np.asarray(
-            generate_tier_fn(eng.model, tier)(
-                eng.params, jnp.asarray(prompt), jnp.asarray(keys),
-                jnp.asarray(temps), jnp.asarray(n_pad),
-                jnp.asarray(topk), jnp.asarray(topp),
-                jnp.asarray(n_vec),
+        if spec:
+            from mlapi_tpu.ops.speculative import fused_spec_batched_fn
+
+            packed = np.asarray(
+                fused_spec_batched_fn(
+                    eng.model, eng.draft_model, bucket, tier, k, sampled
+                )(
+                    eng.params, eng.draft_params, jnp.asarray(prompt),
+                    jnp.asarray(keys), jnp.asarray(temps),
+                    jnp.asarray(topk), jnp.asarray(topp),
+                    jnp.asarray(n_pad), jnp.asarray(n_vec),
+                )
             )
-        )
+            out = packed[:, :tier]
+            eng.spec_rounds += int(packed[0, tier])
+            eng.spec_accepted += int(packed[:b, tier + 1].sum())
+            eng.spec_drafted += int(packed[:b, tier + 2].sum())
+        else:
+            from mlapi_tpu.models.gpt import generate_tier_fn
+
+            out = np.asarray(
+                generate_tier_fn(eng.model, tier)(
+                    eng.params, jnp.asarray(prompt), jnp.asarray(keys),
+                    jnp.asarray(temps), jnp.asarray(n_pad),
+                    jnp.asarray(topk), jnp.asarray(topp),
+                    jnp.asarray(n_vec),
+                )
+            )
         self.warmed.add((bucket, tier, kind))
         eng.fused_batch_calls += 1
         for i, r in enumerate(reqs):
@@ -272,35 +334,59 @@ class FusedSinglePath:
                     shapes += 1
                     if tier == tiers[0]:
                         for bsz in batch_sizes:
+                            rows_b = jnp.asarray(np.broadcast_to(
+                                np.asarray(row), (bsz, bucket)
+                            ).copy())
+                            keys_b = jnp.asarray(np.stack(
+                                [eng._key_data(0)] * bsz
+                            ))
+                            zb_f = jnp.zeros((bsz,), jnp.float32)
+                            zb_i = jnp.zeros((bsz,), jnp.int32)
+                            ob_f = jnp.ones((bsz,), jnp.float32)
+                            npad_b = jnp.asarray(np.full(
+                                (bsz,), bucket - 1, np.int32
+                            ))
+                            ones_b = jnp.asarray(
+                                np.ones((bsz,), np.int32)
+                            )
                             generate_tier_fn(eng.model, tier)(
-                                eng.params,
-                                jnp.asarray(np.broadcast_to(
-                                    np.asarray(row),
-                                    (bsz, bucket),
-                                ).copy()),
-                                jnp.asarray(np.stack(
-                                    [eng._key_data(0)] * bsz
-                                )),
-                                jnp.zeros((bsz,), jnp.float32),
-                                jnp.asarray(np.full(
-                                    (bsz,), bucket - 1, np.int32
-                                )),
-                                jnp.zeros((bsz,), jnp.int32),
-                                jnp.ones((bsz,), jnp.float32),
-                                jnp.asarray(np.ones((bsz,), np.int32)),
+                                eng.params, rows_b, keys_b, zb_f,
+                                npad_b, zb_i, ob_f, ones_b,
                             )
                             self.warmed.add(
                                 (bucket, tier, f"batched{bsz}")
                             )
                             shapes += 1
+                            fits_b, k = self._spec_headroom(
+                                bucket, tier
+                            )
+                            if fits_b:
+                                from mlapi_tpu.ops.speculative import (
+                                    fused_spec_batched_fn,
+                                )
+
+                                variants = [(False, "")]
+                                if eng.spec_sample:
+                                    variants.append((True, "_s"))
+                                for smp, tag in variants:
+                                    fused_spec_batched_fn(
+                                        eng.model, eng.draft_model,
+                                        bucket, tier, k, smp,
+                                    )(
+                                        eng.params, eng.draft_params,
+                                        rows_b, keys_b,
+                                        ob_f if smp else zb_f,
+                                        zb_i, ob_f, npad_b, ones_b,
+                                    )
+                                    self.warmed.add((
+                                        bucket, tier,
+                                        f"spec_batched{tag}{bsz}",
+                                    ))
+                                    shapes += 1
                 if eng.draft_model is None:
                     continue
-                k = max(1, min(eng.spec_k, tier))
-                if (
-                    bucket + tier + k + 1 <= eng.model.max_positions
-                    and bucket + tier + k + 1
-                    <= eng.draft_model.max_positions
-                ):
+                fits, k = self._spec_headroom(bucket, tier)
+                if fits:
                     from mlapi_tpu.ops.speculative import fused_spec_fn
 
                     # Greedy speculation serves every engine; the

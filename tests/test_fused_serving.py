@@ -178,22 +178,55 @@ async def test_formed_batch_runs_fused_and_matches_solo(pair):
 
 
 @pytest.mark.anyio
-async def test_batched_fused_skipped_with_draft_or_stream(pair):
-    """Draft engines keep batched SPECULATION; a stream row keeps the
-    whole batch chunked."""
+async def test_batched_fused_spec_matches_plain_greedy(pair):
+    """The last cell of the fused matrix: an all-greedy batch on a
+    draft engine runs the whole BATCHED SPECULATION as one program,
+    every row byte-identical to plain greedy (argmax-exactness)."""
     loop = asyncio.get_running_loop()
-    spec_eng = _engine(pair, draft=True)
+    eng = _engine(pair, draft=True, fused_batch=True)
+    plain = _engine(pair, fused=False)
+    texts = ["the quick brown", "fox jumps", "over the lazy dog"]
+    budgets = [16, 6, 11]
+    reqs = [
+        eng._encode(t, n, 0.0, 0, loop)
+        for t, n in zip(texts, budgets)
+    ]
+    await loop.run_in_executor(None, lambda: eng._run_batch(reqs, True))
+    assert eng.fused_batch_calls == 1
+    assert eng.spec_rounds > 0 and eng.spec_drafted > 0
+    for t, n, r in zip(texts, budgets, reqs):
+        got = []
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got.extend(item["token_ids"])
+        ref = plain.generate_text(t, max_new_tokens=n)
+        assert got == ref["token_ids"], t
+
+
+@pytest.mark.anyio
+async def test_batched_fused_skipped_for_mixed_or_stream(pair):
+    """A mixed greedy/sampled batch on a draft engine falls through
+    (``sampled`` is static per program); a stream row keeps the whole
+    batch chunked."""
+    loop = asyncio.get_running_loop()
+    spec_eng = _engine(pair, draft=True, fused_batch=True)
     reqs = [
         spec_eng._encode("abcab", 8, 0.0, 0, loop),
-        spec_eng._encode("xyz", 8, 0.0, 0, loop),
+        spec_eng._encode("xyz", 8, 0.9, 3, loop),  # sampled row
     ]
     await loop.run_in_executor(
         None, lambda: spec_eng._run_batch(reqs, True)
     )
     assert spec_eng.fused_batch_calls == 0
     for r in reqs:
-        while await r.queue.get() is not None:
-            pass
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
 
     eng = _engine(pair)
     reqs = [
