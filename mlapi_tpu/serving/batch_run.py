@@ -1,0 +1,716 @@
+"""One continuous batch's whole lifecycle, as an object with seams.
+
+``TextGenerationEngine._run_batch`` used to hold this as a single
+~650-line method; the state it threaded through nested closures is now
+explicit attributes on :class:`BatchRun`, and each lifecycle stage is
+its own method:
+
+======================  ================================================
+``__init__``            formation: shape/bucket/prefix resolution, host
+                        mirror packing (``_pack_rows``), batch padding
+``_prefill``            the three prefill variants (shared-prefix,
+                        chunked long-prompt, plain) → ``(first, cache)``
+``_first_token``        sync-vs-chained first-token policy (speculation
+                        reads the host mirror; everyone else defers the
+                        readback onto the dispatch chain)
+``_spec_handoff``       solo / batched speculative phases, handing off
+                        to the chunk loop at any ``(cache, pos, tok)``
+``_admit_waiting``      mid-batch continuous admission (+ batch growth)
+``_maybe_shrink``       compaction along the warmed halving chain
+``_decode_chunk``       one chained chunk dispatch + drain policy
+``run``                 the loop: admission → liveness → spec
+                        re-engage → resize → chunk, then terminators
+======================  ================================================
+
+Invariants the stages share (and why the state is one object):
+
+* Host mirrors (``n_pad``/``temps``/``topk``/``topp``/``keys``/``tok``/
+  ``step``/``lo``) are the source of truth; the device holds ONLY the
+  KV cache. Every resize rebinds all mirrors together
+  (:meth:`_mirrors_take`) so a stage can never see a half-resized
+  batch.
+* ``rows[i]`` maps request *i* to its current device row across
+  resizes; ``produced``/``sched`` split delivered-vs-dispatched token
+  counts so the chained-dispatch frontier can run ahead of readbacks.
+* Anything that mutates batch state (admission, compaction, spec)
+  first ``chain.invalidate()``s — the host mirrors must be current
+  before they are rewritten.
+
+The engine's ``_run_batch`` is now a thin wrapper: the fused
+whole-generation fast paths (``fused_single.py``), then
+``BatchRun(engine, reqs, admit).run()``, with error delivery to every
+waiter kept at the wrapper level.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mlapi_tpu.serving.dispatch import DispatchChain
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.batch_run")
+
+
+class BatchRun:
+    """Decode one coalesced batch, streaming chunks to each request's
+    queue; a ``None`` sentinel marks completion (error delivery lives
+    in the engine wrapper, which owns the ``reqs`` list reference).
+
+    With ``admit=True`` (the collector's batches) this is a CONTINUOUS
+    batch: at every chunk boundary, waiting requests whose prompt
+    bucket and token budget fit the running cache are prefilled into a
+    free device row (bucket-keyed ``prefill_fn`` + ``admit_scatter_fn``)
+    and decode alongside the original members — a long generation no
+    longer head-of-line-blocks short arrivals. Admission never stalls
+    the batch on an EXPENSIVE compile: in strict mode the joiner's
+    prefill bucket must be pre-warmed, and the trivial scatter/growth
+    programs either compile on demand (low-RTT attach) or must be
+    warmed too (tunnel). The batch grows along the warmed power-of-two
+    chain only, and per-row sampling-stream indices keep every row's
+    output byte-identical to a solo run.
+
+    Device-resident state is the KV cache and nothing else: all
+    per-row vectors (pads, temps, keys, stream steps, last token) are
+    host mirrors re-uploaded with each chunk dispatch, which is what
+    makes admission/compaction/growth bookkeeping plain numpy instead
+    of extra device programs.
+    """
+
+    def __init__(self, eng, reqs: list, admit: bool) -> None:
+        self.eng = eng
+        self.reqs = reqs  # the engine's list object: admission appends
+        self.admit = admit
+
+        self.bucket = max(len(r.row) for r in reqs)
+        n_new_max = max(r.n_new for r in reqs)
+        # The prefix region spans [0, p_len) of every row's cache.
+        # Same-fp batches share ONE scattered KV (scalar lo);
+        # cross-prefix batches stack each row's own KV right-aligned
+        # to the common region end p_len, masked by a per-row lo
+        # vector (lo == p_len ⇒ empty region, the dummy-row case).
+        self.p_len = max((r.prefix_len for r in reqs), default=0)
+        self.p_lo = reqs[0].prefix_lo
+        self.mixed_prefix = bool(self.p_len) and any(
+            r.prefix_fp != reqs[0].prefix_fp
+            or r.prefix_len != self.p_len
+            for r in reqs
+        )
+        self.total = eng._cache_len(self.p_len + self.bucket, n_new_max)
+        self.n_new_max = min(
+            n_new_max, self.total - self.p_len - self.bucket
+        )
+        b = len(reqs)
+        # Pad the BATCH dimension to a power of two: programs are
+        # keyed on batch size, so without padding every distinct
+        # concurrency level compiles its own prefill+decode. Dummy
+        # rows are a 1-token pad prompt (masked out like any pad).
+        b_pad = 1
+        while b_pad < b:
+            b_pad *= 2
+        b_max = 1
+        while b_max < eng.max_batch:
+            b_max *= 2
+        self.b, self.b_pad, self.b_max = b, b_pad, b_max
+
+        (self.prompt, self.n_pad, self.temps, self.topk, self.topp,
+         self.keys) = eng._pack_rows(reqs, self.bucket, b_pad)
+        self.lo = np.full((b_pad,), self.p_len, np.int32)
+        for i, r in enumerate(reqs):
+            self.lo[i] = self.p_len - r.prefix_len + r.prefix_lo
+
+        first = self._prefill()
+        self.pos = self.p_len + self.bucket
+        # rows[i]: request i's current row in the (possibly resized)
+        # device batch. Rows are independent (per-row mask/positions/
+        # PRNG streams), so gathering live rows into a different-size
+        # warmed program changes nothing but cost.
+        self.rows: list = list(range(b))
+        self.b_cur = b_pad
+        self._first_token(first)
+        self.chain = DispatchChain(self._deliver)
+
+    # -- formation ----------------------------------------------------
+
+    def _prefill(self):
+        """Run the batch's prefill and set ``self.cache``; returns the
+        ``[B]`` device vector of first sampled tokens."""
+        eng, reqs = self.eng, self.reqs
+        bucket, total = self.bucket, self.total
+        from mlapi_tpu.models.gpt import prefill_fn, prefix_prefill_fn
+
+        if self.p_len:
+            # Shared-prefix batch: the prefix KV is scattered into
+            # every row and only the suffix block is computed — the
+            # prefix's forward work is paid once per prefix, not once
+            # per request. Cross-prefix batches pass the per-row
+            # right-aligned KV stack + lo vector; same-fp batches keep
+            # the broadcast [1, P] + scalar-lo program they always
+            # compiled.
+            lo_arg = (
+                jnp.asarray(self.lo) if self.mixed_prefix
+                else jnp.int32(self.p_lo)
+            )
+            kv_arg = (
+                eng.prefix.stacked(reqs, self.p_len, self.b_pad)
+                if self.mixed_prefix else reqs[0].prefix_kv
+            )
+            first, self.cache = prefix_prefill_fn(
+                eng.model, bucket, total
+            )(
+                eng.params, kv_arg, jnp.asarray(self.prompt),
+                jnp.asarray(self.n_pad), lo_arg,
+                jnp.asarray(self.keys), jnp.asarray(self.temps),
+                jnp.asarray(self.topk), jnp.asarray(self.topp),
+            )
+        elif (
+            bucket > eng.prompt_buckets[-1]
+            and bucket % eng.prompt_buckets[-1] == 0
+        ):
+            # Chunked prefill: the long prompt runs as fixed-width
+            # extend_core blocks at a TRACED offset — one compiled
+            # program per cache tier serves every prompt length,
+            # instead of a bespoke compile per exact length.
+            from mlapi_tpu.models.gpt import extend_chunk_fn, sample_fn
+
+            cp = eng.prompt_buckets[-1]
+            self.cache = eng.model.init_cache(self.b_pad, total)
+            n_pad_j = jnp.asarray(self.n_pad)
+            logits = None
+            for c0 in range(0, bucket, cp):
+                eng.prefill_chunks += 1
+                self.cache, logits = extend_chunk_fn(
+                    eng.model, cp, total
+                )(
+                    eng.params, self.cache,
+                    jnp.asarray(self.prompt[:, c0:c0 + cp]),
+                    jnp.int32(c0), n_pad_j,
+                )
+            first = sample_fn(eng.model)(
+                logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
+                jnp.asarray(self.topk), jnp.asarray(self.topp),
+            )
+        else:
+            first, self.cache = prefill_fn(eng.model, total)(
+                eng.params, jnp.asarray(self.prompt),
+                jnp.asarray(self.keys), jnp.asarray(self.temps),
+                jnp.asarray(self.n_pad), jnp.asarray(self.topk),
+                jnp.asarray(self.topp),
+            )
+        return first
+
+    def _first_token(self, first) -> None:
+        """Decide the first token's delivery: the speculative phase
+        reads/writes the host token mirror, so spec-eligible batches
+        sync the first token here as before; everyone else CHAINS it —
+        the prefill's sampled token stays on device as the first
+        chunk's feedback and is delivered by the first drain, saving
+        one readback round trip per request."""
+        eng, reqs, b = self.eng, self.reqs, self.b
+        temps, topk, topp = self.temps, self.topk, self.topp
+        self.spec_eligible = (
+            eng.draft_model is not None
+            and b == 1 and self.p_len == 0
+            and not reqs[0].cancelled
+            and (
+                (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
+                or (eng.spec_sample and temps[0] > 0.0)
+            )
+        )
+        # BATCHED speculation: a freshly-formed all-greedy batch
+        # speculates as a whole — per-row acceptance lengths
+        # desynchronize row positions (rank-polymorphic pos + vmapped
+        # cache writes), and the phase REALIGNS the cache (per-row
+        # roll, n_pad bump) before handing off to the scalar-pos chunk
+        # loop, so admission keeps working. Needs k+1 slots of cache
+        # headroom past every row's budget for the final round's
+        # verify block.
+        self.spec_batched = (
+            eng.draft_model is not None
+            and b > 1 and self.p_len == 0
+            and bool(
+                np.all(temps[:b] <= 0.0)
+                and np.all(topk[:b] == 0)
+                and np.all(topp[:b] >= 1.0)
+            )
+            and self.total >= (
+                self.bucket + self.n_new_max + eng.spec_k + 1
+            )
+            # In strict (tunnel) mode an unwarmed batched-spec shape
+            # would decline inside the phase anyway — decide at
+            # formation so such batches keep the chained (deferred)
+            # first token instead of paying a synchronous readback for
+            # nothing.
+            and (
+                not eng._strict_admit
+                or (self.bucket, self.total, self.b_pad, "batched")
+                in eng.spec.warmed
+            )
+        )
+        # step[row]: the row's NEXT sampling-stream index — its own
+        # produced-token count, NOT a batch-global counter, so a row
+        # admitted later still reproduces its solo stream.
+        self.step = np.ones((self.b_pad,), np.int32)
+        self.done = [False] * b
+        if self.spec_eligible or self.spec_batched:
+            # np.array (copy): the spec phase mutates tok[0] in place;
+            # np.asarray of a device array is read-only.
+            self.tok = np.array(first)
+            self.produced = [1] * b
+            for i, r in enumerate(self.reqs):
+                r.push({"token_ids": [int(self.tok[i])]})
+                if r.n_new <= 1:
+                    r.push(None)
+                    self.done[i] = True
+            self.first_chunk = None
+        else:
+            # set by first drain
+            self.tok = np.zeros((self.b_pad,), np.int32)
+            self.produced = [0] * b
+            self.first_chunk = first[:, None]  # [B, 1] device, deferred
+        # produced as of the DISPATCH frontier (tokens already
+        # scheduled on device but possibly not yet drained); the
+        # chained-dispatch loop schedules against this, while
+        # ``produced`` tracks what was delivered.
+        self.sched = list(self.produced)
+        self.spec_hist: list | None = None
+        if self.spec_eligible:
+            self.spec_hist = [int(self.tok[0])]
+        self._first = first  # device handle for the chain's feedback
+
+    # -- shared bookkeeping -------------------------------------------
+
+    def _mirrors_take(self, sel: np.ndarray) -> None:
+        """Rebind every host mirror through a row gather — ALL of them
+        together, so no stage can observe a half-resized batch."""
+        self.n_pad, self.temps, self.topk, self.topp = (
+            self.n_pad[sel], self.temps[sel], self.topk[sel],
+            self.topp[sel],
+        )
+        self.tok, self.step, self.lo = (
+            self.tok[sel], self.step[sel], self.lo[sel],
+        )
+        self.keys = self.keys[sel]
+
+    def _never_admissible(self, r) -> bool:
+        """Token budget exceeds the running cache's remaining room —
+        and ``pos`` only grows, so this can never change for THIS
+        batch. Such requests must leave the admission list
+        (→ ``_deferred``) rather than camp in it suppressing
+        compaction and queue draining."""
+        return self.pos + (r.n_new - 1) > self.total
+
+    def _admissible(self, r) -> bool:
+        """Can ``r`` join the RUNNING batch right now? Its prompt
+        bucket must fit below the current decode position (``pos``
+        grows, so a False here can flip True later) and its remaining
+        tokens inside the remaining cache (the final chunk may be
+        remainder-sized)."""
+        return len(r.row) <= self.pos and not self._never_admissible(r)
+
+    def _unstage(self, cand) -> None:
+        eng = self.eng
+        with eng._alock:
+            try:
+                eng._admit.remove(cand)
+            except ValueError:
+                pass
+
+    def _deliver(self, toks_host, got, plive):
+        self.tok = toks_host[:, -1].copy()
+        for i in plive:
+            r = self.reqs[i]
+            if r.cancelled:
+                continue
+            want = r.n_new - self.produced[i]
+            if want > 0:
+                chunk_ids = toks_host[self.rows[i], : min(want, got)]
+                r.push({"token_ids": chunk_ids.tolist()})
+                if self.spec_hist is not None and i == 0:
+                    self.spec_hist.extend(chunk_ids.tolist())
+                self.produced[i] += got
+                if want <= got:
+                    r.push(None)
+                    self.done[i] = True
+
+    def _sdone(self, i: int) -> bool:
+        """done[] as of the DISPATCH frontier: a row whose in-flight
+        chunks already cover its budget must not be scheduled more
+        device work."""
+        return self.done[i] or self.sched[i] >= self.reqs[i].n_new
+
+    # -- speculative phases -------------------------------------------
+
+    def _try_spec(self) -> None:
+        """Speculative decoding applies while this batch is one greedy
+        row: the draft proposes spec_k tokens per round and the target
+        verifies them in ONE block forward — fewer target weight
+        passes per emitted token. The spec phase hands off to the
+        normal chunk loop (which resumes from any (cache, pos, tok)
+        state) the moment an admission candidate arrives, and
+        RE-engages for the tail once transient joiners depart
+        (spec_hist tracks the row's emitted tokens for the draft-cache
+        replay)."""
+        if (
+            self.spec_hist is None or self.done[0]
+            or self.reqs[0].cancelled
+        ):
+            return
+        self.cache, self.pos = self.eng.spec.run_solo(
+            self.reqs[0], self.cache, self.pos, self.total, self.bucket,
+            self.tok, self.step, self.produced, self.n_pad, self.keys,
+            self.spec_hist, self.temps, self.topk, self.topp,
+        )
+        self.sched[0] = self.produced[0]
+        if self.produced[0] >= self.reqs[0].n_new:
+            self.reqs[0].push(None)
+            self.done[0] = True
+
+    def _spec_handoff(self) -> None:
+        """Run the formation-time speculative phase (solo or batched),
+        leaving ``(cache, pos, tok, produced)`` ready for the chunk
+        loop."""
+        self._try_spec()
+        if self.spec_batched and not all(self.done):
+            self.cache, self.pos = self.eng.spec.run_batched(
+                self.reqs, self.cache, self.pos, self.total,
+                self.bucket, self.prompt, self.tok, self.step,
+                self.produced, self.done, self.n_pad, self.keys,
+                self.b_pad,
+            )
+            self.sched[:] = self.produced
+
+    # -- continuous admission -----------------------------------------
+
+    def _admit_waiting(self) -> int:
+        """Admit staged joiners into free (or grown) device rows at a
+        chunk boundary; returns the number of candidates still staged
+        (the loop's compaction policy reads it)."""
+        eng, reqs = self.eng, self.reqs
+        from mlapi_tpu.models.gpt import admit_scatter_fn, prefill_fn
+        from mlapi_tpu.serving.engine import _compact_fn
+
+        with eng._alock:
+            candidates = list(eng._admit)
+        n_live = sum(
+            1 for i, r in enumerate(reqs)
+            if not self.done[i] and not r.cancelled
+        )
+        for cand in candidates:
+            if cand.cancelled:
+                self._unstage(cand)  # drop silently
+                continue
+            if self.p_len or cand.prefix_fp is not None:
+                # Prefix rows batch only at FORMATION time (incl.
+                # cross-prefix groups): mid-batch admission would need
+                # the running batch's region re-stacked and the
+                # joiner's lo spliced into the live mirrors — the
+                # admission scatter/regroup paths don't handle the
+                # prefix mirrors (yet). Defer to the collector's next
+                # batch.
+                self._unstage(cand)
+                with eng._alock:
+                    eng._deferred.append(cand)
+                continue
+            if self._never_admissible(cand):
+                # Hand back to the collector for the NEXT batch;
+                # leaving it staged would block compaction and
+                # backpressure for the whole run.
+                self._unstage(cand)
+                with eng._alock:
+                    eng._deferred.append(cand)
+                continue
+            if n_live + 1 > eng.max_batch:
+                break
+            if not self._admissible(cand):
+                continue
+            used_rows = {
+                self.rows[i] for i, r in enumerate(reqs)
+                if not self.done[i] and not r.cancelled
+            }
+            free = [
+                j for j in range(self.b_cur) if j not in used_rows
+            ]
+            grow = not free and self.b_cur < self.b_max
+            bkt = len(cand.row)
+            if eng._strict_admit:
+                # The EXPENSIVE compile (the joiner's prefill) is
+                # keyed on the prompt bucket alone and must be
+                # pre-warmed; the scatter/growth gathers are trivial
+                # compiles, allowed on demand when the dispatch RTT is
+                # low (local attach) and required-warm through a
+                # tunnel where even a trivial remote compile stalls
+                # the running batch. A shape miss cannot resolve
+                # during this batch (warmed sets only grow via
+                # admissions this mode forbids), so the joiner is
+                # handed back for the next batch rather than left
+                # camping in the staging list where it would block
+                # compaction and draining.
+                b_t = self.b_cur * 2 if grow else self.b_cur
+                blocked = bkt not in eng._warmed_joiner or (
+                    not eng._admit_eager
+                    and (
+                        (bkt, self.total, b_t)
+                        not in eng._warmed_scatter
+                        or (
+                            grow
+                            and (self.b_cur, self.b_cur * 2, self.total)
+                            not in eng._warmed_growth
+                        )
+                    )
+                )
+                if blocked:
+                    self._unstage(cand)
+                    with eng._alock:
+                        eng._deferred.append(cand)
+                    continue
+            if not free and not grow:
+                break
+            # Committed: the joiner will mutate the host mirrors and
+            # possibly the cache layout, so the dispatch chain ends
+            # here (draining also brings `done` current for the
+            # bookkeeping below). Candidates that merely unstage or
+            # defer above never pay this — a camping incompatible
+            # candidate must not degrade the batch to synced per-chunk
+            # readbacks.
+            self.chain.invalidate()
+            # Leave the staging list BEFORE the device work, so a
+            # mid-admission failure (the wrapper's except delivers the
+            # error to every member of ``reqs``) cannot also re-serve
+            # an already-admitted joiner from ``_admit``.
+            self._unstage(cand)
+            if grow:
+                # Batch growth: double along the warmed power-of-two
+                # chain; new rows are dummies until admitted into.
+                sel = np.concatenate(
+                    [np.arange(self.b_cur), np.zeros(self.b_cur)]
+                ).astype(np.int32)
+                self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
+                self._mirrors_take(sel)
+                self.n_pad[self.b_cur:] = self.pos  # mask dummies fully
+                self.temps[self.b_cur:] = 0.0
+                self.b_cur *= 2
+                free = list(range(self.b_cur // 2, self.b_cur))
+                eng._warmed_growth.add(
+                    (self.b_cur // 2, self.b_cur, self.total)
+                )
+                eng.growths += 1
+            row = free[0]
+            first1, mini = prefill_fn(eng.model, bkt)(
+                eng.params, jnp.asarray(cand.row[None]),
+                jnp.asarray(eng._key_data(cand.seed)[None]),
+                jnp.asarray(
+                    np.asarray([cand.temperature], np.float32)
+                ),
+                jnp.asarray(
+                    np.asarray([bkt - cand.used], np.int32)
+                ),
+                jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                jnp.asarray(
+                    np.asarray([cand.top_p], np.float32)
+                ),
+            )
+            self.cache = admit_scatter_fn()(
+                self.cache, mini, jnp.int32(row),
+                jnp.int32(self.pos - bkt),
+            )
+            eng._warmed_scatter.add((bkt, self.total, self.b_cur))
+            ftok = int(np.asarray(first1)[0])
+            self.n_pad[row] = self.pos - cand.used
+            self.temps[row] = cand.temperature
+            self.topk[row] = cand.top_k
+            self.topp[row] = cand.top_p
+            self.keys[row] = eng._key_data(cand.seed)
+            self.tok[row] = ftok
+            self.step[row] = 1
+            reqs.append(cand)
+            self.rows.append(row)
+            self.produced.append(1)
+            self.sched.append(1)
+            cand.push({"token_ids": [ftok]})
+            fin = cand.n_new <= 1
+            if fin:
+                cand.push(None)
+            self.done.append(fin)
+            if not fin:
+                n_live += 1
+            eng.admitted += 1
+        with eng._alock:
+            return len(eng._admit)
+
+    # -- resize -------------------------------------------------------
+
+    def _maybe_shrink(self, live: list, pending_n: int) -> None:
+        """Compact the device batch along the warmed halving chain
+        when enough rows finished; at most one halving per chunk keeps
+        the compaction shape set to the chain (8→4→2→1), which the
+        warmup grid compiles — an arbitrary (from, to) jump would
+        compile on the request path. Skip shrinking while joiners
+        wait: they would force a regrow."""
+        eng = self.eng
+        from mlapi_tpu.serving.engine import _compact_fn
+
+        want_b = 1
+        while want_b < len(live):
+            want_b *= 2
+        want_b = max(want_b, self.b_cur // 2)
+        # In strict non-eager mode (tunnel attach) a resize whose
+        # gather shape was never compiled would stall the batch on a
+        # remote compile — skip it and keep decoding at full width
+        # instead (correct, just less compact). Shapes prove
+        # themselves as warmup and low-RTT runs execute them.
+        resize_ok = (
+            not eng._strict_admit
+            or eng._admit_eager
+            or (self.b_cur, want_b, self.total) in eng._warmed_shrink
+        )
+        if want_b < self.b_cur and not pending_n and resize_ok:
+            self.chain.invalidate()
+            sel = [self.rows[i] for i in live]
+            sel += [sel[0]] * (want_b - len(sel))
+            sel = np.asarray(sel, np.int32)
+            self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
+            eng._warmed_shrink.add((self.b_cur, want_b, self.total))
+            self._mirrors_take(sel)
+            self.rows = [None] * len(self.reqs)
+            for row, i in enumerate(live):
+                self.rows[i] = row
+            self.b_cur = want_b
+            eng.compactions += 1
+
+    # -- chained chunk dispatch ---------------------------------------
+
+    def _decode_chunk(self, size: int, live: list) -> None:
+        """One decode chunk on the dispatch chain. decode_chunk_fn
+        RETURNS the feedback token as a device array (last_tok), so
+        consecutive chunks need no host round trip between them: the
+        loop dispatches ahead and drains token readbacks lazily.
+        Through a high-RTT attach (the tunneled chip: ~68 ms per
+        synced readback, while argument uploads pipeline for free)
+        this turns a request's serial cost from one RTT PER CHUNK into
+        one readback at the end. Policy: non-incremental batches chain
+        every chunk; a batch with any `stream` consumer keeps at most
+        one chunk in flight (tokens land promptly); speculative solo
+        batches stay synchronous (spec rounds read tokens by design).
+        Anything that mutates batch state — admission, compaction, the
+        spec phase — drains fully first and drops the device chain
+        (the host mirrors are the source of truth again)."""
+        eng = self.eng
+        from mlapi_tpu.models.gpt import decode_chunk_fn
+
+        eng.chunk_calls += 1
+        toks, self.cache, last_tok = decode_chunk_fn(eng.model, size)(
+            eng.params, self.cache,
+            self.chain.tok_dev if self.chain.tok_dev is not None
+            else jnp.asarray(self.tok),
+            jnp.int32(self.pos),
+            jnp.asarray(self.n_pad), jnp.asarray(self.temps),
+            jnp.asarray(self.keys), jnp.asarray(self.step),
+            jnp.asarray(self.topk), jnp.asarray(self.topp),
+            jnp.int32(self.p_len),
+            jnp.asarray(self.lo) if self.mixed_prefix
+            else jnp.int32(self.p_lo),
+        )
+        self.chain.push(toks, size, live)
+        for i in live:
+            self.sched[i] += size
+        self.step = self.step + np.int32(size)
+        self.pos += size
+        self.chain.tok_dev = last_tok
+        if any(
+            self.reqs[i].stream for i in self.chain.pending_live()
+        ):
+            # A chunk covering an incremental consumer may wait behind
+            # at most ONE newer chunk — including a stream row's FINAL
+            # chunk after it left `live` (its terminator must not ride
+            # the chain until the co-batched requests finish).
+            if len(self.chain) > 1:
+                self.chain.drain(len(self.chain) - 1)
+        elif len(self.chain) >= 4:
+            # Bounded run-ahead: one overlapped readback window per 4
+            # chunks keeps ~the full RTT win while cancellation and
+            # mid-batch admission get a real sync point every few
+            # chunks instead of after the whole generation.
+            self.chain.drain()
+
+    # -- the loop -----------------------------------------------------
+
+    def run(self) -> None:
+        eng, reqs, chain = self.eng, self.reqs, self.chain
+        self._spec_handoff()
+
+        if self.first_chunk is not None:
+            # The deferred first token rides the chain as a width-1
+            # chunk: delivered by the first drain, chained into
+            # chunk 1 on device.
+            all_rows = list(range(self.b))
+            chain.push(self.first_chunk, 1, all_rows)
+            for i in all_rows:
+                self.sched[i] += 1
+            chain.tok_dev = self._first
+
+        while True:
+            pending_n = 0
+            if self.admit and eng._admit:
+                pending_n = self._admit_waiting()
+            live = [
+                i for i, r in enumerate(reqs)
+                if not self._sdone(i) and not r.cancelled
+            ]
+            if not live:
+                # Every remaining consumer disconnected, finished, or
+                # is fully covered by in-flight chunks: deliver what's
+                # pending and stop scheduling device time.
+                chain.drain()
+                if not all(self.done):
+                    eng.cancelled_batches += 1
+                break
+            # Re-engage speculation once the batch is a single greedy
+            # row again (transient joiners departed): the spec phase
+            # replays the row's history into a fresh draft cache and
+            # resumes rounds for the tail. Its cheap disqualifiers
+            # make this retry free when speculation cannot currently
+            # help.
+            if (
+                self.spec_hist is not None and self.b_cur == 1
+                and live == [0] and not pending_n
+                # Cheap frontier-side disqualifiers first: breaking
+                # the dispatch chain (a full drain) is only worth it
+                # when the spec phase could actually run rounds.
+                and reqs[0].n_new - self.sched[0] > 1
+                and self.pos + 1 + eng.spec_k + 1 <= self.total
+            ):
+                chain.invalidate()
+                self._try_spec()
+                if self.done[0]:
+                    continue
+            # The final chunk may be remainder-sized: when
+            # max_positions clamps the cache tier, (total - bucket)
+            # need not be a chunk multiple, and a window-edge request
+            # is owed the partial chunk (the old whole-chunk stop
+            # silently ran past the cache end and corrupted the tail
+            # positions).
+            size = min(eng.chunk, self.total - self.pos)
+            if size <= 0:
+                chain.drain()
+                break  # cache exhausted — safety net below
+            self._maybe_shrink(live, pending_n)
+            self._decode_chunk(size, live)
+        chain.drain()
+        # Safety net: every waiter MUST get a terminator. The
+        # collector/admission only group window-compatible requests,
+        # so this fires only if that invariant is ever broken — a loud
+        # error beats a silently-truncated hang.
+        for i, r in enumerate(reqs):
+            if self.done[i] or r.cancelled:
+                continue
+            _log.error(
+                "request truncated at %d/%d tokens (batch window "
+                "exhausted) — collector grouping bug?",
+                self.produced[i], r.n_new,
+            )
+            r.push(RuntimeError(
+                f"generation truncated at {self.produced[i]}/"
+                f"{r.n_new} tokens (incompatible batch)"
+            ))

@@ -37,7 +37,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mlapi_tpu.serving.dispatch import DispatchChain
 from mlapi_tpu.serving.fused_single import FusedSinglePath
 from mlapi_tpu.serving.prefix import PrefixCache
 
@@ -530,6 +529,16 @@ class TextGenerationEngine:
                 f"fused_batch must be True, False, or 'auto'; got "
                 f"{fused_batch!r}"
             )
+        # fused_single=False pins the chunked path entirely (the
+        # batched fused programs ride the solo path's warm grid and
+        # dispatch machinery), so an explicit fused_batch=True would
+        # be silently inert — reject the contradiction here rather
+        # than at serve time.
+        if fused_batch is True and not self.fused_single:
+            raise ValueError(
+                "fused_batch=True requires fused_single=True; "
+                "fused_single=False disables every fused program"
+            )
         self.fused_batch = fused_batch
         self.model = model
         self.tokenizer = tokenizer
@@ -792,34 +801,21 @@ class TextGenerationEngine:
 
     def _run_batch(self, reqs: list, admit: bool = False,
                    fused_ok: bool = True) -> None:
-        """Decode one coalesced batch, streaming chunks to each
-        request's queue; a ``None`` sentinel marks completion, an
-        exception object marks failure.
+        """Serve one coalesced batch: the fused whole-generation fast
+        paths first (``serving/fused_single.py`` — a solo request or a
+        whole formed batch as ONE XLA program on a high-RTT attach),
+        then the continuous-batch lifecycle, which lives in
+        ``serving/batch_run.py`` as :class:`BatchRun` (formation +
+        prefill, speculative handoff, mid-batch admission, compaction,
+        chained chunk decode — see that module's seam table).
 
-        With ``admit=True`` (the collector's batches) this is a
-        CONTINUOUS batch: at every chunk boundary, waiting requests
-        whose prompt bucket and token budget fit the running cache are
-        prefilled into a free device row (bucket-keyed ``prefill_fn``
-        + ``admit_scatter_fn``) and decode alongside the original
-        members — a long generation no longer head-of-line-blocks
-        short arrivals. Admission never stalls the batch on an
-        EXPENSIVE compile: in strict mode the joiner's prefill bucket
-        must be pre-warmed, and the trivial scatter/growth programs
-        either compile on demand (low-RTT attach) or must be warmed
-        too (tunnel). The batch grows along the warmed power-of-two
-        chain only, and per-row sampling-stream indices keep every
-        row's output byte-identical to a solo run.
-
-        Device-resident state is the KV cache and nothing else: all
-        per-row vectors (pads, temps, keys, stream steps, last token)
-        are host mirrors re-uploaded with each chunk dispatch, which
-        is what makes admission/compaction/growth bookkeeping plain
-        numpy instead of extra device programs.
+        Error delivery stays HERE: admission appends joiners to
+        ``reqs`` in place, so a mid-batch failure is delivered to
+        every waiter, including requests admitted after formation.
+        Each gets the exception object; a ``None`` sentinel marks
+        normal completion (pushed by the lifecycle stages).
         """
-        from mlapi_tpu.models.gpt import (
-            admit_scatter_fn, decode_chunk_fn, prefill_fn,
-            prefix_prefill_fn,
-        )
+        from mlapi_tpu.serving.batch_run import BatchRun
 
         try:
             self.batch_calls += 1
@@ -835,578 +831,7 @@ class TextGenerationEngine:
                     reqs, admit
                 ):
                     return
-            bucket = max(len(r.row) for r in reqs)
-            n_new_max = max(r.n_new for r in reqs)
-            # The prefix region spans [0, p_len) of every row's cache.
-            # Same-fp batches share ONE scattered KV (scalar lo);
-            # cross-prefix batches stack each row's own KV
-            # right-aligned to the common region end p_len, masked by
-            # a per-row lo vector (lo == p_len ⇒ empty region, the
-            # dummy-row case).
-            p_len = max((r.prefix_len for r in reqs), default=0)
-            p_lo = reqs[0].prefix_lo
-            mixed_prefix = bool(p_len) and any(
-                r.prefix_fp != reqs[0].prefix_fp or r.prefix_len != p_len
-                for r in reqs
-            )
-            total = self._cache_len(p_len + bucket, n_new_max)
-            n_new_max = min(n_new_max, total - p_len - bucket)
-            b = len(reqs)
-            # Pad the BATCH dimension to a power of two: programs are
-            # keyed on batch size, so without padding every distinct
-            # concurrency level compiles its own prefill+decode. Dummy
-            # rows are a 1-token pad prompt (masked out like any pad).
-            b_pad = 1
-            while b_pad < b:
-                b_pad *= 2
-            b_max = 1
-            while b_max < self.max_batch:
-                b_max *= 2
-
-            prompt, n_pad, temps, topk, topp, keys = self._pack_rows(
-                reqs, bucket, b_pad
-            )
-            lo = np.full((b_pad,), p_len, np.int32)
-            for i, r in enumerate(reqs):
-                lo[i] = p_len - r.prefix_len + r.prefix_lo
-
-            if p_len:
-                # Shared-prefix batch: the prefix KV is scattered into
-                # every row and only the suffix block is computed —
-                # the prefix's forward work is paid once per prefix,
-                # not once per request. Cross-prefix batches pass the
-                # per-row right-aligned KV stack + lo vector; same-fp
-                # batches keep the broadcast [1, P] + scalar-lo
-                # program they always compiled.
-                lo_arg = (
-                    jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo)
-                )
-                kv_arg = (
-                    self.prefix.stacked(reqs, p_len, b_pad)
-                    if mixed_prefix else reqs[0].prefix_kv
-                )
-                first, cache = prefix_prefill_fn(
-                    self.model, bucket, total
-                )(
-                    self.params, kv_arg, jnp.asarray(prompt),
-                    jnp.asarray(n_pad), lo_arg,
-                    jnp.asarray(keys), jnp.asarray(temps),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                )
-            elif (
-                bucket > self.prompt_buckets[-1]
-                and bucket % self.prompt_buckets[-1] == 0
-            ):
-                # Chunked prefill: the long prompt runs as fixed-width
-                # extend_core blocks at a TRACED offset — one compiled
-                # program per cache tier serves every prompt length,
-                # instead of a bespoke compile per exact length.
-                from mlapi_tpu.models.gpt import extend_chunk_fn, sample_fn
-
-                cp = self.prompt_buckets[-1]
-                cache = self.model.init_cache(b_pad, total)
-                n_pad_j = jnp.asarray(n_pad)
-                logits = None
-                for c0 in range(0, bucket, cp):
-                    self.prefill_chunks += 1
-                    cache, logits = extend_chunk_fn(
-                        self.model, cp, total
-                    )(
-                        self.params, cache,
-                        jnp.asarray(prompt[:, c0:c0 + cp]),
-                        jnp.int32(c0), n_pad_j,
-                    )
-                first = sample_fn(self.model)(
-                    logits, jnp.asarray(keys), jnp.asarray(temps),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                )
-            else:
-                first, cache = prefill_fn(self.model, total)(
-                    self.params, jnp.asarray(prompt), jnp.asarray(keys),
-                    jnp.asarray(temps), jnp.asarray(n_pad),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                )
-            # The speculative phase reads/writes the host token
-            # mirror, so spec-eligible batches sync the first token
-            # here as before; everyone else CHAINS it — the prefill's
-            # sampled token stays on device as the first chunk's
-            # feedback and is delivered by the first drain, saving
-            # one readback round trip per request.
-            spec_eligible = (
-                self.draft_model is not None
-                and b == 1 and p_len == 0
-                and not reqs[0].cancelled
-                and (
-                    (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
-                    or (self.spec_sample and temps[0] > 0.0)
-                )
-            )
-            # BATCHED speculation: a freshly-formed all-greedy batch
-            # speculates as a whole — per-row acceptance lengths
-            # desynchronize row positions (rank-polymorphic pos +
-            # vmapped cache writes), and the phase REALIGNS the cache
-            # (per-row roll, n_pad bump) before handing off to the
-            # scalar-pos chunk loop, so admission keeps working.
-            # Needs k+1 slots of cache headroom past every row's
-            # budget for the final round's verify block.
-            spec_batched = (
-                self.draft_model is not None
-                and b > 1 and p_len == 0
-                and bool(
-                    np.all(temps[:b] <= 0.0)
-                    and np.all(topk[:b] == 0)
-                    and np.all(topp[:b] >= 1.0)
-                )
-                and total >= bucket + n_new_max + self.spec_k + 1
-                # In strict (tunnel) mode an unwarmed batched-spec
-                # shape would decline inside the phase anyway —
-                # decide at formation so such batches keep the
-                # chained (deferred) first token instead of paying a
-                # synchronous readback for nothing.
-                and (
-                    not self._strict_admit
-                    or (bucket, total, b_pad, "batched")
-                    in self.spec.warmed
-                )
-            )
-            # step[row]: the row's NEXT sampling-stream index — its own
-            # produced-token count, NOT a batch-global counter, so a
-            # row admitted later still reproduces its solo stream.
-            step = np.ones((b_pad,), np.int32)
-            done = [False] * b
-            if spec_eligible or spec_batched:
-                # np.array (copy): the spec phase mutates tok[0] in
-                # place; np.asarray of a device array is read-only.
-                tok = np.array(first)
-                produced = [1] * b
-                for i, r in enumerate(reqs):
-                    r.push({"token_ids": [int(tok[i])]})
-                    if r.n_new <= 1:
-                        r.push(None)
-                        done[i] = True
-                first_chunk = None
-            else:
-                tok = np.zeros((b_pad,), np.int32)  # set by first drain
-                produced = [0] * b
-                first_chunk = first[:, None]  # [B, 1] device, deferred
-
-            pos = p_len + bucket
-            # rows[i]: request i's current row in the (possibly
-            # resized) device batch. Rows are independent (per-row
-            # mask/positions/PRNG streams), so gathering live rows
-            # into a different-size warmed program changes nothing
-            # but cost.
-            rows: list = list(range(b))
-            b_cur = b_pad
-
-            def mirrors_take(sel: np.ndarray) -> None:
-                nonlocal n_pad, temps, topk, topp, keys, tok, step, lo
-                n_pad, temps, topk, topp, tok, step, lo = (
-                    n_pad[sel], temps[sel], topk[sel], topp[sel],
-                    tok[sel], step[sel], lo[sel],
-                )
-                keys = keys[sel]
-
-            def never_admissible(r) -> bool:
-                """Token budget exceeds the running cache's remaining
-                room — and ``pos`` only grows, so this can never
-                change for THIS batch. Such requests must leave the
-                admission list (→ ``_deferred``) rather than camp in
-                it suppressing compaction and queue draining."""
-                return pos + (r.n_new - 1) > total
-
-            def admissible(r) -> bool:
-                """Can ``r`` join the RUNNING batch right now? Its
-                prompt bucket must fit below the current decode
-                position (``pos`` grows, so a False here can flip
-                True later) and its remaining tokens inside the
-                remaining cache (the final chunk may be
-                remainder-sized)."""
-                return len(r.row) <= pos and not never_admissible(r)
-
-            def unstage(cand) -> None:
-                with self._alock:
-                    try:
-                        self._admit.remove(cand)
-                    except ValueError:
-                        pass
-
-            # Speculative decoding applies while this batch is one
-            # greedy row: the draft proposes spec_k tokens per round
-            # and the target verifies them in ONE block forward —
-            # fewer target weight passes per emitted token. The spec
-            # phase hands off to the normal chunk loop (which resumes
-            # from any (cache, pos, tok) state) the moment an
-            # admission candidate arrives, and RE-engages for the
-            # tail once transient joiners depart (spec_hist tracks
-            # the row's emitted tokens for the draft-cache replay).
-            # produced as of the DISPATCH frontier (tokens already
-            # scheduled on device but possibly not yet drained); the
-            # chained-dispatch loop below schedules against this,
-            # while `produced` tracks what was delivered.
-            sched = list(produced)
-            spec_hist: list | None = None
-            if spec_eligible:
-                spec_hist = [int(tok[0])]
-
-            def try_spec():
-                nonlocal cache, pos
-                if spec_hist is None or done[0] or reqs[0].cancelled:
-                    return
-                cache, pos = self.spec.run_solo(
-                    reqs[0], cache, pos, total, bucket, tok, step,
-                    produced, n_pad, keys, spec_hist, temps, topk, topp,
-                )
-                sched[0] = produced[0]
-                if produced[0] >= reqs[0].n_new:
-                    reqs[0].push(None)
-                    done[0] = True
-
-            try_spec()
-
-            if spec_batched and not all(done):
-                cache, pos = self.spec.run_batched(
-                    reqs, cache, pos, total, bucket, prompt, tok,
-                    step, produced, done, n_pad, keys, b_pad,
-                )
-                sched[:] = produced
-
-            # -- chained dispatch -----------------------------------
-            # decode_chunk_fn RETURNS the feedback token as a device
-            # array (last_tok), so consecutive chunks need no host
-            # round trip between them: the loop dispatches ahead and
-            # drains token readbacks lazily. Through a high-RTT
-            # attach (the tunneled chip: ~68 ms per synced readback,
-            # while argument uploads pipeline for free) this turns a
-            # request's serial cost from one RTT PER CHUNK into one
-            # readback at the end. Policy: non-incremental batches
-            # chain every chunk; a batch with any `stream` consumer
-            # keeps at most one chunk in flight (tokens land
-            # promptly); speculative solo batches stay synchronous
-            # (spec rounds read tokens by design). Anything that
-            # mutates batch state — admission, compaction, the spec
-            # phase — drains fully first and drops the device chain
-            # (the host mirrors are the source of truth again).
-            def deliver(toks_host, got, plive):
-                nonlocal tok
-                tok = toks_host[:, -1].copy()
-                for i in plive:
-                    r = reqs[i]
-                    if r.cancelled:
-                        continue
-                    want = r.n_new - produced[i]
-                    if want > 0:
-                        chunk_ids = toks_host[rows[i], : min(want, got)]
-                        r.push({"token_ids": chunk_ids.tolist()})
-                        if spec_hist is not None and i == 0:
-                            spec_hist.extend(chunk_ids.tolist())
-                        produced[i] += got
-                        if want <= got:
-                            r.push(None)
-                            done[i] = True
-
-            chain = DispatchChain(deliver)
-
-            def sdone(i: int) -> bool:
-                """done[] as of the DISPATCH frontier: a row whose
-                in-flight chunks already cover its budget must not be
-                scheduled more device work."""
-                return done[i] or sched[i] >= reqs[i].n_new
-
-            if first_chunk is not None:
-                # The deferred first token rides the chain as a
-                # width-1 chunk: delivered by the first drain, chained
-                # into chunk 1 on device.
-                all_rows = list(range(b))
-                chain.push(first_chunk, 1, all_rows)
-                for i in all_rows:
-                    sched[i] += 1
-                chain.tok_dev = first
-
-            while True:
-                pending_n = 0
-                if admit and self._admit:
-                    with self._alock:
-                        candidates = list(self._admit)
-                    n_live = sum(
-                        1 for i, r in enumerate(reqs)
-                        if not done[i] and not r.cancelled
-                    )
-                    for cand in candidates:
-                        if cand.cancelled:
-                            unstage(cand)  # drop silently
-                            continue
-                        if p_len or cand.prefix_fp is not None:
-                            # Prefix rows batch only at FORMATION time
-                            # (incl. cross-prefix groups): mid-batch
-                            # admission would need the running batch's
-                            # region re-stacked and the joiner's lo
-                            # spliced into the live mirrors — the
-                            # admission scatter/regroup paths don't
-                            # handle the prefix mirrors (yet). Defer
-                            # to the collector's next batch.
-                            unstage(cand)
-                            with self._alock:
-                                self._deferred.append(cand)
-                            continue
-                        if never_admissible(cand):
-                            # Hand back to the collector for the NEXT
-                            # batch; leaving it staged would block
-                            # compaction and backpressure for the
-                            # whole run.
-                            unstage(cand)
-                            with self._alock:
-                                self._deferred.append(cand)
-                            continue
-                        if n_live + 1 > self.max_batch:
-                            break
-                        if not admissible(cand):
-                            continue
-                        used_rows = {
-                            rows[i] for i, r in enumerate(reqs)
-                            if not done[i] and not r.cancelled
-                        }
-                        free = [
-                            j for j in range(b_cur) if j not in used_rows
-                        ]
-                        grow = not free and b_cur < b_max
-                        bkt = len(cand.row)
-                        if self._strict_admit:
-                            # The EXPENSIVE compile (the joiner's
-                            # prefill) is keyed on the prompt bucket
-                            # alone and must be pre-warmed; the
-                            # scatter/growth gathers are trivial
-                            # compiles, allowed on demand when the
-                            # dispatch RTT is low (local attach) and
-                            # required-warm through a tunnel where
-                            # even a trivial remote compile stalls
-                            # the running batch. A shape miss cannot
-                            # resolve during this batch (warmed sets
-                            # only grow via admissions this mode
-                            # forbids), so the joiner is handed back
-                            # for the next batch rather than left
-                            # camping in the staging list where it
-                            # would block compaction and draining.
-                            b_t = b_cur * 2 if grow else b_cur
-                            blocked = bkt not in self._warmed_joiner or (
-                                not self._admit_eager
-                                and (
-                                    (bkt, total, b_t)
-                                    not in self._warmed_scatter
-                                    or (
-                                        grow
-                                        and (b_cur, b_cur * 2, total)
-                                        not in self._warmed_growth
-                                    )
-                                )
-                            )
-                            if blocked:
-                                unstage(cand)
-                                with self._alock:
-                                    self._deferred.append(cand)
-                                continue
-                        if not free and not grow:
-                            break
-                        # Committed: the joiner will mutate the host
-                        # mirrors and possibly the cache layout, so
-                        # the dispatch chain ends here (draining also
-                        # brings `done` current for the bookkeeping
-                        # below). Candidates that merely unstage or
-                        # defer above never pay this — a camping
-                        # incompatible candidate must not degrade the
-                        # batch to synced per-chunk readbacks.
-                        chain.invalidate()
-                        # Leave the staging list BEFORE the device
-                        # work, so a mid-admission failure (outer
-                        # except delivers the error to every member
-                        # of ``reqs``) cannot also re-serve an
-                        # already-admitted joiner from ``_admit``.
-                        unstage(cand)
-                        if grow:
-                            # Batch growth: double along the warmed
-                            # power-of-two chain; new rows are dummies
-                            # until admitted into.
-                            sel = np.concatenate(
-                                [np.arange(b_cur), np.zeros(b_cur)]
-                            ).astype(np.int32)
-                            cache = _compact_fn()(cache, jnp.asarray(sel))
-                            mirrors_take(sel)
-                            n_pad[b_cur:] = pos  # mask dummy rows fully
-                            temps[b_cur:] = 0.0
-                            b_cur *= 2
-                            free = list(range(b_cur // 2, b_cur))
-                            self._warmed_growth.add(
-                                (b_cur // 2, b_cur, total)
-                            )
-                            self.growths += 1
-                        row = free[0]
-                        first1, mini = prefill_fn(self.model, bkt)(
-                            self.params, jnp.asarray(cand.row[None]),
-                            jnp.asarray(self._key_data(cand.seed)[None]),
-                            jnp.asarray(
-                                np.asarray([cand.temperature], np.float32)
-                            ),
-                            jnp.asarray(
-                                np.asarray([bkt - cand.used], np.int32)
-                            ),
-                            jnp.asarray(np.asarray([cand.top_k], np.int32)),
-                            jnp.asarray(
-                                np.asarray([cand.top_p], np.float32)
-                            ),
-                        )
-                        cache = admit_scatter_fn()(
-                            cache, mini, jnp.int32(row),
-                            jnp.int32(pos - bkt),
-                        )
-                        self._warmed_scatter.add((bkt, total, b_cur))
-                        ftok = int(np.asarray(first1)[0])
-                        n_pad[row] = pos - cand.used
-                        temps[row] = cand.temperature
-                        topk[row] = cand.top_k
-                        topp[row] = cand.top_p
-                        keys[row] = self._key_data(cand.seed)
-                        tok[row] = ftok
-                        step[row] = 1
-                        reqs.append(cand)
-                        rows.append(row)
-                        produced.append(1)
-                        sched.append(1)
-                        cand.push({"token_ids": [ftok]})
-                        fin = cand.n_new <= 1
-                        if fin:
-                            cand.push(None)
-                        done.append(fin)
-                        if not fin:
-                            n_live += 1
-                        self.admitted += 1
-                    with self._alock:
-                        pending_n = len(self._admit)
-                live = [
-                    i for i, r in enumerate(reqs)
-                    if not sdone(i) and not r.cancelled
-                ]
-                if not live:
-                    # Every remaining consumer disconnected, finished,
-                    # or is fully covered by in-flight chunks: deliver
-                    # what's pending and stop scheduling device time.
-                    chain.drain()
-                    if not all(done):
-                        self.cancelled_batches += 1
-                    break
-                # Re-engage speculation once the batch is a single
-                # greedy row again (transient joiners departed): the
-                # spec phase replays the row's history into a fresh
-                # draft cache and resumes rounds for the tail. Its
-                # cheap disqualifiers make this retry free when
-                # speculation cannot currently help.
-                if (
-                    spec_hist is not None and b_cur == 1
-                    and live == [0] and not pending_n
-                    # Cheap frontier-side disqualifiers first: breaking
-                    # the dispatch chain (a full drain) is only worth it
-                    # when the spec phase could actually run rounds.
-                    and reqs[0].n_new - sched[0] > 1
-                    and pos + 1 + self.spec_k + 1 <= total
-                ):
-                    chain.invalidate()
-                    try_spec()
-                    if done[0]:
-                        continue
-                # The final chunk may be remainder-sized: when
-                # max_positions clamps the cache tier, (total -
-                # bucket) need not be a chunk multiple, and a
-                # window-edge request is owed the partial chunk (the
-                # old whole-chunk stop silently ran past the cache
-                # end and corrupted the tail positions).
-                size = min(self.chunk, total - pos)
-                if size <= 0:
-                    chain.drain()
-                    break  # cache exhausted — safety net below
-                want_b = 1
-                while want_b < len(live):
-                    want_b *= 2
-                # At most one halving per chunk: keeps the compaction
-                # shape set to the halving chain (8→4→2→1), which the
-                # warmup grid compiles — an arbitrary (from, to) jump
-                # would compile on the request path. Skip shrinking
-                # while joiners wait: they would force a regrow.
-                want_b = max(want_b, b_cur // 2)
-                # In strict non-eager mode (tunnel attach) a resize
-                # whose gather shape was never compiled would stall
-                # the batch on a remote compile — skip it and keep
-                # decoding at full width instead (correct, just less
-                # compact). Shapes prove themselves as warmup and
-                # low-RTT runs execute them.
-                resize_ok = (
-                    not self._strict_admit
-                    or self._admit_eager
-                    or (b_cur, want_b, total) in self._warmed_shrink
-                )
-                if want_b < b_cur and not pending_n and resize_ok:
-                    chain.invalidate()
-                    sel = [rows[i] for i in live]
-                    sel += [sel[0]] * (want_b - len(sel))
-                    sel = np.asarray(sel, np.int32)
-                    cache = _compact_fn()(cache, jnp.asarray(sel))
-                    self._warmed_shrink.add((b_cur, want_b, total))
-                    mirrors_take(sel)
-                    rows = [None] * len(reqs)
-                    for row, i in enumerate(live):
-                        rows[i] = row
-                    b_cur = want_b
-                    self.compactions += 1
-                self.chunk_calls += 1
-                toks, cache, last_tok = decode_chunk_fn(self.model, size)(
-                    self.params, cache,
-                    chain.tok_dev if chain.tok_dev is not None
-                    else jnp.asarray(tok),
-                    jnp.int32(pos),
-                    jnp.asarray(n_pad), jnp.asarray(temps),
-                    jnp.asarray(keys), jnp.asarray(step),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                    jnp.int32(p_len),
-                    jnp.asarray(lo) if mixed_prefix else jnp.int32(p_lo),
-                )
-                chain.push(toks, size, live)
-                for i in live:
-                    sched[i] += size
-                step = step + np.int32(size)
-                pos += size
-                chain.tok_dev = last_tok
-                if any(
-                    reqs[i].stream for i in chain.pending_live()
-                ):
-                    # A chunk covering an incremental consumer may
-                    # wait behind at most ONE newer chunk — including
-                    # a stream row's FINAL chunk after it left `live`
-                    # (its terminator must not ride the chain until
-                    # the co-batched requests finish).
-                    if len(chain) > 1:
-                        chain.drain(len(chain) - 1)
-                elif len(chain) >= 4:
-                    # Bounded run-ahead: one overlapped readback
-                    # window per 4 chunks keeps ~the full RTT win
-                    # while cancellation and mid-batch admission get
-                    # a real sync point every few chunks instead of
-                    # after the whole generation.
-                    chain.drain()
-            chain.drain()
-            # Safety net: every waiter MUST get a terminator. The
-            # collector/admission only group window-compatible
-            # requests, so this fires only if that invariant is ever
-            # broken — a loud error beats a silently-truncated hang.
-            for i, r in enumerate(reqs):
-                if done[i] or r.cancelled:
-                    continue
-                _log.error(
-                    "request truncated at %d/%d tokens (batch window "
-                    "exhausted) — collector grouping bug?",
-                    produced[i], r.n_new,
-                )
-                r.push(RuntimeError(
-                    f"generation truncated at {produced[i]}/{r.n_new} "
-                    "tokens (incompatible batch)"
-                ))
+            BatchRun(self, reqs, admit).run()
         except Exception as e:  # noqa: BLE001 — delivered to every waiter
             _log.error("generation batch of %d failed: %s", len(reqs), e)
             for r in reqs:

@@ -213,20 +213,21 @@ class FusedSinglePath:
                 r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
                 for r in reqs
             )
-            all_sampled = eng.spec_sample and all(
-                r.temperature > 0.0 for r in reqs
-            )
+            uniform_sampled = all(r.temperature > 0.0 for r in reqs)
+            all_sampled = eng.spec_sample and uniform_sampled
             if fits and (all_greedy or all_sampled):
                 spec = True
                 sampled = all_sampled and not all_greedy
-            elif not (all_greedy or all_sampled):
-                # Mixed greedy/sampled: ``sampled`` is static per
-                # program — the host batched-spec / chunked paths
-                # serve it.
+            elif not (all_greedy or uniform_sampled):
+                # Genuinely MIXED greedy/sampled: ``sampled`` is
+                # static per program — the host batched-spec /
+                # chunked paths serve it.
                 return False
-            # No spec headroom: degrade to the plain fused-batched
-            # program (same policy as the solo path) — one dispatch
-            # still beats the host loop through a tunnel.
+            # No spec headroom — or a homogeneous sampled batch with
+            # spec_sample off (speculation can't serve it, but the
+            # plain program can, exactly like the solo path): degrade
+            # to the plain fused-batched program — one dispatch still
+            # beats the host loop through a tunnel.
         if not spec and bucket + tier > eng.model.max_positions:
             return False
         b = len(reqs)
