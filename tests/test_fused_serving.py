@@ -302,3 +302,99 @@ async def test_streaming_requests_stay_chunked(pair):
 @pytest.fixture
 def anyio_backend():
     return "asyncio"
+
+
+@pytest.mark.anyio
+async def test_fused_batch_holb_wait_is_bounded(pair):
+    """The HOLB bound behind the ``fused_batch="auto"`` policy
+    (fused_single.py try_run_batch), pinned with the policy FORCED on:
+    a fused batch is ONE uninterruptible device program, so a stream
+    arriving mid-program waits — but for at most THAT one program
+    (the slowest row's budget) plus its own prefill, never two.
+    Two structural facts deliver the bound: arrivals during a fused
+    program stage in ``_admit``/the collector queue in FIFO order, so
+    no later-arriving batch can fuse ahead of the waiting stream; and
+    a group CONTAINING the stream can't take the fused path at all
+    (try_run_batch declines streams), so the stream's own batch starts
+    promptly once the in-flight program drains.
+
+    The wall-clock assertion is deliberately generous (2.5x the
+    measured fused-batch time + scheduling slack) — it exists to catch
+    the unbounded failure modes (stream starved behind a second fused
+    batch, or behind re-fused continuations), not to benchmark."""
+    import time
+
+    eng = _engine(pair, fused_batch=True)
+    loop = asyncio.get_running_loop()
+    N = 64  # the fused rows' budget == the bound's "slowest row"
+
+    def batch_reqs():
+        return [
+            eng._encode("the quick brown fox", N, 0.0, 0, loop),
+            eng._encode("jumps over", N, 0.0, 0, loop),
+        ]
+
+    async def drain(r):
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                return
+            assert not isinstance(item, Exception), item
+
+    # Warm by execution: the fused 2-row program and the stream's
+    # chunked programs must be compiled OUTSIDE the timed window.
+    warm = batch_reqs()
+    await loop.run_in_executor(None, lambda: eng._run_batch(warm, True))
+    for r in warm:
+        await drain(r)
+    warm_s = eng._encode("xy", 8, 0.0, 0, loop, stream=True)
+    await loop.run_in_executor(None, lambda: eng._run_batch([warm_s]))
+    await drain(warm_s)
+    assert eng.fused_batch_calls == 1
+
+    # Reference: one fused batch of the same shape, warmed, timed.
+    ref = batch_reqs()
+    t0 = time.perf_counter()
+    await loop.run_in_executor(None, lambda: eng._run_batch(ref, True))
+    t_fused = time.perf_counter() - t0
+    for r in ref:
+        await drain(r)
+    assert eng.fused_batch_calls == 2
+
+    # The race, through the real collector: the fused batch must be
+    # IN FLIGHT (batch_calls ticks at _run_batch entry) before the
+    # stream is submitted. If the stream sneaks into the staging list
+    # first, try_run_batch declines and no HOLB occurs — retry.
+    await eng.start()
+    try:
+        for _ in range(3):
+            base_fused = eng.fused_batch_calls
+            base_calls = eng.batch_calls
+            a, b = [
+                await eng.submit("the quick brown fox",
+                                 max_new_tokens=N),
+                await eng.submit("jumps over", max_new_tokens=N),
+            ]
+            for _ in range(2000):
+                if eng.batch_calls > base_calls:
+                    break
+                await asyncio.sleep(0.001)
+            t1 = time.perf_counter()
+            s = await eng.submit("xy", max_new_tokens=8, stream=True)
+            first = await s.queue.get()
+            t_wait = time.perf_counter() - t1
+            assert not isinstance(first, Exception), first
+            await drain(s)
+            await drain(a)
+            await drain(b)
+            if eng.fused_batch_calls > base_fused:
+                break  # the race landed: stream waited on a fused batch
+        else:
+            pytest.skip("stream kept winning the staging race "
+                        "(fused path never engaged mid-arrival)")
+        assert t_wait <= 2.5 * t_fused + 0.5, (
+            f"stream first-token wait {t_wait:.3f}s exceeds the "
+            f"one-fused-program bound (~{t_fused:.3f}s fused batch)"
+        )
+    finally:
+        await eng.stop()
