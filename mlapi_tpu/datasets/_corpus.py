@@ -1,0 +1,96 @@
+"""The documentation corpus shared by the doc-driven datasets.
+
+``docs_clf`` (the config-5 classification proxy) and ``docs_text``
+(the LM / speculation anchors) read the SAME four prose files, and
+both must default to the commit-pinned snapshot in ``docs_corpus/``
+so their published numbers reproduce from a clean checkout — the live
+repo docs grow every round, which silently sank the r04 docsclf
+headline's held-out margin from ~0.19 to ~0.07 (VERDICT r04 weak #2).
+This module is the ONE place that knows the file list, the snapshot
+location, the flat-vs-repo layout fallback, and the provenance
+string, so the two datasets cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+# The corpus files, in repo layout. The frozen snapshot stores each at
+# the top level (flat); resolve_doc() tries both.
+DOC_SOURCES = (
+    "README.md",
+    "SURVEY.md",
+    "BASELINE.md",
+    "docs/DESIGN.md",
+)
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def frozen_corpus() -> Path:
+    """The commit-pinned snapshot directory (provenance and sha256s in
+    its ``MANIFEST.json``)."""
+    return Path(__file__).resolve().parent / "docs_corpus"
+
+
+def resolve_root(root: str | None) -> Path:
+    """``None`` → the frozen snapshot; ``"live"`` → the repo's current
+    (growing) docs; anything else → a user directory holding the
+    corpus files (flat or repo-layout)."""
+    if root is None:
+        return frozen_corpus()
+    if root == "live":
+        return repo_root()
+    return Path(root)
+
+
+def resolve_doc(base: Path, rel: str) -> Path | None:
+    """Find one corpus file under ``base``: repo layout first, then
+    the flat layout the snapshot (and any user-supplied flat dir)
+    uses. ``None`` when absent — callers decide whether a missing
+    class/file is fatal."""
+    p = base / rel
+    if p.exists():
+        return p
+    flat = base / Path(rel).name
+    if flat.exists():
+        return flat
+    return None
+
+
+def corpus_provenance(base: Path) -> str:
+    """The provenance string measurements carry in
+    ``extras["corpus"]``: the frozen snapshot reports its pinned
+    commit, anything else reports the path it read.
+
+    A frozen claim is VERIFIED, not trusted: every file listed in
+    MANIFEST.json must hash to its recorded sha256, otherwise the
+    published accuracies would silently stop reproducing while still
+    reporting ``frozen@...`` — the exact failure mode the snapshot
+    exists to eliminate. Corruption raises; it must not degrade to a
+    quiet "live" label."""
+    mf = base / "MANIFEST.json"
+    if not mf.exists():
+        return f"live:{base}"
+    import hashlib
+    import json
+
+    manifest = json.loads(mf.read_text())
+    for name, meta in manifest.get("files", {}).items():
+        p = base / name
+        digest = (
+            hashlib.sha256(p.read_bytes()).hexdigest()
+            if p.exists() else "<missing>"
+        )
+        if digest != meta.get("sha256"):
+            raise ValueError(
+                f"frozen corpus snapshot is corrupted: {name} hashes "
+                f"to {digest[:12]}…, MANIFEST.json records "
+                f"{str(meta.get('sha256'))[:12]}… — restore "
+                f"datasets/docs_corpus/ from git before trusting any "
+                f"measurement"
+            )
+    commit = manifest.get("source_commit", "?")
+    return f"frozen@{commit}"

@@ -26,18 +26,15 @@ import numpy as np
 from mlapi_tpu.datasets import SupervisedSplits, register_dataset
 from mlapi_tpu.utils.vocab import LabelVocab
 
-# Files with enough distinct prose to classify. Globs resolve from the
-# repo root; missing files are skipped (the dataset needs >= 2 present).
-_DOC_SOURCES = (
-    "README.md",
-    "SURVEY.md",
-    "BASELINE.md",
-    "docs/DESIGN.md",
+# Corpus files, snapshot location, layout fallback, and provenance
+# all live in datasets/_corpus.py — shared with docs_text so the two
+# doc-driven datasets read the same bytes by construction.
+from mlapi_tpu.datasets._corpus import (
+    DOC_SOURCES as _DOC_SOURCES,
+    corpus_provenance as _corpus_provenance,
+    resolve_doc as _resolve_doc,
+    resolve_root as _resolve_root,
 )
-
-
-def _repo_root() -> Path:
-    return Path(__file__).resolve().parents[2]
 
 
 @register_dataset("docs_clf")
@@ -57,17 +54,23 @@ def load_docs_clf(
     With overlapping windows (``stride < seq_len``) adjacent windows
     share bytes, so the split falls back to each file's TAIL to keep
     train/test disjoint.
+
+    ``root`` selects the corpus: ``None`` (default) reads the FROZEN
+    commit-pinned snapshot shipped in ``docs_corpus/`` so measured
+    accuracies reproduce; ``"live"`` reads the repo's current docs
+    (the old behavior — drifts every round); any other value is a
+    directory of the four files (flat or repo-layout).
     """
     from mlapi_tpu.text import ByteTokenizer
 
     tok = ByteTokenizer()
     stride = stride or seq_len
-    base = Path(root) if root else _repo_root()
+    base = _resolve_root(root)
 
     per_class: list[tuple[str, np.ndarray]] = []
     for rel in _DOC_SOURCES:
-        p = base / rel
-        if not p.exists():
+        p = _resolve_doc(base, rel)
+        if p is None:
             continue
         ids = np.asarray(
             tok.token_ids(p.read_text(errors="replace")), np.int32
@@ -87,11 +90,19 @@ def load_docs_clf(
 
     rng_split = np.random.default_rng(11)
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
-    for label, (_, windows) in enumerate(per_class):
+    for label, (name, windows) in enumerate(per_class):
         n_test = max(1, int(len(windows) * test_fraction))
         if stride >= seq_len:
             order = rng_split.permutation(len(windows))
             test_idx, train_idx = order[:n_test], order[n_test:]
+            if len(train_idx) == 0:
+                raise ValueError(
+                    f"docs_clf: class {name!r} yields only "
+                    f"{len(windows)} window(s) at seq_len={seq_len} — "
+                    f"the test split takes them all and training "
+                    f"would silently see zero examples of it; shrink "
+                    f"seq_len or test_fraction"
+                )
         else:
             # Tail split with overlapping windows: drop train windows
             # whose span reaches into the first test window's bytes,
@@ -104,6 +115,14 @@ def load_docs_clf(
                  if i * stride + seq_len <= test_start_byte],
                 np.int64,
             )
+            if len(train_idx) == 0:
+                raise ValueError(
+                    f"docs_clf: class {name!r} has no train windows "
+                    f"left after the overlap filter (stride={stride} "
+                    f"<< seq_len={seq_len} for a short document) — "
+                    f"training would silently see zero examples of "
+                    f"it; raise stride or shrink test_fraction"
+                )
         xs_tr.append(windows[train_idx])
         ys_tr.append(np.full(len(train_idx), label, np.int32))
         xs_te.append(windows[test_idx])
@@ -122,5 +141,9 @@ def load_docs_clf(
         y_test=np.concatenate(ys_te),
         vocab=LabelVocab(tuple(n for n, _ in per_class)),
         source="real",
-        extras={"tokenizer": tok.fingerprint(), "max_len": seq_len},
+        extras={
+            "tokenizer": tok.fingerprint(),
+            "max_len": seq_len,
+            "corpus": _corpus_provenance(base),
+        },
     )

@@ -14,18 +14,22 @@ perplexity here demonstrates the PIPELINE, not language quality.
 
 from __future__ import annotations
 
-from pathlib import Path
-
 import numpy as np
 
 from mlapi_tpu.datasets import SupervisedSplits, register_dataset
 from mlapi_tpu.utils.vocab import LabelVocab
 
-_DOC_GLOBS = ("README.md", "SURVEY.md", "BASELINE.md", "docs/*.md")
-
-
-def _repo_root() -> Path:
-    return Path(__file__).resolve().parents[2]
+# Corpus files, snapshot location, layout fallback, and provenance
+# all live in datasets/_corpus.py — shared with docs_clf so the two
+# doc-driven datasets read the same bytes by construction. The LM
+# anchors (docs-llama next-token accuracy, the speculation matrix)
+# must reproduce from a clean checkout, hence the frozen default.
+from mlapi_tpu.datasets._corpus import (
+    DOC_SOURCES as _DOC_SOURCES,
+    corpus_provenance as _corpus_provenance,
+    resolve_doc as _resolve_doc,
+    resolve_root as _resolve_root,
+)
 
 
 @register_dataset("docs_text")
@@ -45,10 +49,11 @@ def load_docs_text(
 
     tok = ByteTokenizer()
     stride = stride or seq_len
-    base = Path(root) if root else _repo_root()
+    base = _resolve_root(root)
     texts = []
-    for pattern in _DOC_GLOBS:
-        for p in sorted(base.glob(pattern)):
+    for rel in _DOC_SOURCES:
+        p = _resolve_doc(base, rel)
+        if p is not None:
             texts.append(p.read_text(errors="replace"))
     if not texts:
         raise FileNotFoundError(f"no corpus files under {base}")
@@ -77,5 +82,9 @@ def load_docs_text(
         y_test=x_test,
         vocab=LabelVocab(("<lm>",)),  # no class labels; engine ignores it
         source="real",
-        extras={"tokenizer": tok.fingerprint(), "task": "lm"},
+        extras={
+            "tokenizer": tok.fingerprint(),
+            "task": "lm",
+            "corpus": _corpus_provenance(base),
+        },
     )

@@ -86,6 +86,11 @@ def test_docs_clf_is_real_and_learnable():
 
     splits = get_dataset("docs_clf", seq_len=128)
     assert splits.source == "real"
+    # The dataset must default to the commit-pinned snapshot: the
+    # margin asserted below was measured against those exact bytes
+    # and the live docs drift every round (r04: 0.19 -> 0.07 within
+    # one round, silently).
+    assert splits.extras["corpus"] == "frozen@012402d"
     n_classes = len(splits.vocab.labels)
     assert n_classes >= 2
     assert set(np.unique(splits.y_test)) == set(range(n_classes))
@@ -104,22 +109,20 @@ def test_docs_clf_is_real_and_learnable():
     chance = max(
         np.mean(splits.y_test == c) for c in range(n_classes)
     )
-    # The corpus is the LIVE repo docs — it grows every round, so the
-    # held-out margin drifts (measured 0.19 early r04, 0.07 after the
-    # round's own BASELINE.md growth). The test pins what must never
-    # regress: the pipeline LEARNS real data (train split fits) and
-    # generalizes above chance; the headline held-out number belongs
-    # in BASELINE.json, measured at a point in time, not here.
+    # Frozen corpus (snapshot @012402d), so the margin is a fixed
+    # property of the bytes, not of this round's doc growth:
+    # measured 0.3913 held-out vs 0.3230 chance at this exact config
+    # (100 steps, lr 2e-3), 0.4845 at the 300-step preset — the
+    # BASELINE.json headline. Asserted with ~half the measured
+    # margin as slack for BLAS/thread nondeterminism.
     from mlapi_tpu.train.loop import evaluate
 
     train_acc = evaluate(
         model.apply, r.params, splits.x_train[:256],
         splits.y_train[:256],
     )
-    # ~2x chance on train at 100 steps (measured 0.73 vs 0.32 chance
-    # on the end-of-r04 corpus) — "learns", with slack for growth.
     assert train_acc > chance + 0.25, (float(train_acc), float(chance))
-    assert r.test_accuracy > chance + 0.02, (
+    assert r.test_accuracy > chance + 0.035, (
         r.test_accuracy, float(chance)
     )
 

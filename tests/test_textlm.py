@@ -25,6 +25,10 @@ def corpus():
 
 def test_corpus_shapes_and_provenance(corpus):
     assert corpus.source == "real"
+    # LM anchors must reproduce from a clean checkout: the dataset
+    # defaults to the commit-pinned snapshot (datasets/_corpus.py),
+    # and measurements carry the provenance.
+    assert corpus.extras["corpus"] == "frozen@012402d"
     assert corpus.x_train.ndim == 2 and corpus.x_train.shape[1] == 64
     assert np.array_equal(corpus.x_train, corpus.y_train)  # LM: y == x
     assert corpus.x_train.max() < 260  # byte tokenizer range
