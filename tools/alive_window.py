@@ -33,7 +33,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(ROOT, "ALIVE_r04.jsonl")
+OUT = os.path.join(ROOT, "ALIVE_r05.jsonl")
 
 
 def log(stage: str, payload) -> None:
@@ -133,6 +133,15 @@ def main() -> int:
          [py, "-m", "mlapi_tpu.train", "--bench", "--preset",
           "criteo-widedeep", "--bench-steps", "30"],
          1200, None),
+        # r05: the sharp-target speculation pair, served on the chip —
+        # the attach where one-dispatch economics actually pay (CPU
+        # canary is loop-overhead-bound at this model size). Trains
+        # the 700-step pair on-TPU (minutes), then measures fused
+        # plain vs fused spec through the engine.
+        ("spec_sharp_target",
+         [py, "tools/spec_sharp_target.py",
+          "--workdir", "/tmp/spec_sharp_tpu"],
+         3600, None),
         ("requires_tpu_tests",
          [py, "-m", "pytest", "tests/", "-m", "requires_tpu", "-q"],
          1800, {"MLAPI_TPU_TESTS": "1"}),
