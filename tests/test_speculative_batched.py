@@ -238,7 +238,11 @@ def test_fused_batched_rows_match_solo_fused():
     dp = draft.init(jax.random.key(1))
     bucket, k, tier, b = 12, 4, 24, 4
     rows = np.zeros((b, bucket), np.int32)
-    lens = [12, 9, 12, 5]
+    # Two distinct prompt lengths (not four): each DISTINCT length
+    # compiles its own solo fused reference program, and the per-row
+    # variety this test pins — pads, budgets, seeds — is already
+    # covered by the length pair + the budget spread below.
+    lens = [12, 5, 12, 5]
     for i, ln in enumerate(lens):
         rows[i, bucket - ln:] = (np.arange(ln) * (i + 3)) % 200 + 4
     n_pad = np.asarray([bucket - ln for ln in lens], np.int32)
