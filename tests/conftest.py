@@ -53,10 +53,10 @@ def pytest_collection_modifyitems(config, items):
             if "requires_tpu" in item.keywords:
                 item.add_marker(skip)
     # Cluster each cache family at its first member's position so the
-    # shared-window fixture actually shares: family members are not
-    # alphabetically adjacent (test_continuous_batching vs
-    # test_prefix_cache), and a window only persists across
-    # CONSECUTIVE modules. Stable within groups and across groups.
+    # shared-window fixture shares even when a CLI file list or -k
+    # selection breaks the default alphabetical adjacency the family
+    # relies on. A no-op for default runs (the spec family is already
+    # contiguous); stable within and across groups.
     first_seen: dict = {}
     for i, item in enumerate(items):
         g = _cache_group(item.module.__name__)

@@ -133,6 +133,11 @@ def main() -> int:
          [py, "-m", "mlapi_tpu.train", "--bench", "--preset",
           "criteo-widedeep", "--bench-steps", "30"],
          1200, None),
+        # r05: the decomposed gather profile that DECIDES the SURVEY
+        # §7 Pallas-gather question (embed fraction of step, random-
+        # vs-sequential scatter penalty, attained GB/s per stage).
+        ("criteo_gather_probe",
+         [py, "tools/criteo_gather_probe.py"], 900, None),
         # r05: the sharp-target speculation pair, served on the chip —
         # the attach where one-dispatch economics actually pay (CPU
         # canary is loop-overhead-bound at this model size). Trains
