@@ -398,3 +398,48 @@ async def test_fused_batch_holb_wait_is_bounded(pair):
         )
     finally:
         await eng.stop()
+
+
+def test_fused_batch_requires_fused_single(pair):
+    """fused_batch=True rides the solo path's warm grid and dispatch
+    machinery, so combining it with fused_single=False would be
+    silently inert — the constructor must reject the contradiction."""
+    with pytest.raises(ValueError, match="fused_single"):
+        _engine(pair, fused=False, fused_batch=True)
+
+
+@pytest.mark.anyio
+async def test_homogeneous_sampled_batch_degrades_to_plain_fused(pair):
+    """With a draft attached but --spec-sample OFF, an all-sampled
+    formed batch cannot speculate ('sampled' is static per program) —
+    but it must still take the PLAIN fused-batched program like the
+    solo path does, not fall back to chunked decode. Rows stay
+    byte-identical to their solo runs."""
+    eng = _engine(pair, draft=True, fused_batch=True)
+    solo = _engine(pair)
+    loop = asyncio.get_running_loop()
+    specs = [
+        ("the quick brown fox", dict(n=12, temp=0.9, seed=3)),
+        ("jumps over", dict(n=9, temp=0.7, seed=5)),
+    ]
+    reqs = [
+        eng._encode(text, kw["n"], kw["temp"], kw["seed"], loop)
+        for text, kw in specs
+    ]
+    await loop.run_in_executor(None, lambda: eng._run_batch(reqs, True))
+    assert eng.fused_batch_calls == 1   # plain fused-batched engaged
+    assert eng.spec_rounds == 0         # no speculation without the flag
+    assert eng.chunk_calls == 0
+    for (text, kw), r in zip(specs, reqs):
+        got = []
+        while True:
+            item = await r.queue.get()
+            if item is None:
+                break
+            assert not isinstance(item, Exception), item
+            got.extend(item["token_ids"])
+        ref = solo.generate_text(
+            text, max_new_tokens=kw["n"], temperature=kw["temp"],
+            seed=kw["seed"],
+        )
+        assert got == ref["token_ids"], text
