@@ -86,21 +86,49 @@ class WideDeepClassifier:
             }
         return jax.tree.map(lambda a: a.astype(jnp.float32), params)
 
-    def apply(self, params: dict, x: jax.Array) -> jax.Array:
-        nd, f = self.num_dense, self.num_categorical
-        dense = x[:, :nd]
-        # Ids arrive as floats in the flat row; clamp into the table.
-        cat = jnp.remainder(
-            x[:, nd:].astype(jnp.int32), jnp.asarray(self.vocab_sizes, jnp.int32)
-        )  # [B, F]
+    def embedding_ids(self, x: jax.Array) -> jax.Array:
+        """Categorical ids for a batch, ``[B, F]`` int32. Ids arrive
+        as floats in the flat row; clamp into each table's vocab."""
+        return jnp.remainder(
+            x[:, self.num_dense:].astype(jnp.int32),
+            jnp.asarray(self.vocab_sizes, jnp.int32),
+        )
 
-        feat_idx = jnp.arange(f)[None, :]  # [1, F] broadcasts over batch
-        wide_cat = params["wide_tables"][feat_idx, cat]  # [B, F, K]
-        deep_emb = params["deep_tables"][feat_idx, cat]  # [B, F, D]
+    # -- sparse-embedding-update protocol (train/sparse_embed.py) ----
+    # The forward is split at the GATHER so a training step can take
+    # gradients w.r.t. the gathered [B, F, D] rows instead of the
+    # dense [F, V, D] tables — the dense table cotangent (and the
+    # dense optimizer sweep it forces) is the criteo step's dominant
+    # HBM traffic.
+
+    def split_embeddings(self, params: dict) -> tuple[dict, dict]:
+        """(dense leaves, embedding-table leaves)."""
+        tables = {k: v for k, v in params.items() if k.endswith("_tables")}
+        dense = {k: v for k, v in params.items() if k not in tables}
+        return dense, tables
+
+    @staticmethod
+    def merge_embeddings(dense: dict, tables: dict) -> dict:
+        return {**dense, **tables}
+
+    def gather_rows(self, tables: dict, ids: jax.Array) -> dict:
+        """Per-occurrence embedding rows for every table,
+        ``{name: [B, F, D_k]}``."""
+        feat_idx = jnp.arange(self.num_categorical)[None, :]
+        return {k: t[feat_idx, ids] for k, t in tables.items()}
+
+    def apply_from_rows(
+        self, dense_params: dict, rows: dict, x: jax.Array
+    ) -> jax.Array:
+        """Forward from pre-gathered embedding rows — identical math
+        to :meth:`apply`, which delegates here."""
+        dense = x[:, : self.num_dense]
+        wide_cat = rows["wide_tables"]  # [B, F, K]
+        deep_emb = rows["deep_tables"]  # [B, F, D]
 
         wide_logits = (
-            dense @ params["wide_dense"]
-            + params["wide_bias"]
+            dense @ dense_params["wide_dense"]
+            + dense_params["wide_bias"]
             + jnp.sum(wide_cat, axis=1)
         )
 
@@ -110,12 +138,20 @@ class WideDeepClassifier:
         ).astype(cdt)
         n_hidden = len(self.hidden_dims)
         for i in range(n_hidden):
-            layer = params[f"deep_{i}"]
-            h = jax.nn.relu(h @ layer["kernel"].astype(cdt) + layer["bias"].astype(cdt))
-        out = params[f"deep_{n_hidden}"]
+            layer = dense_params[f"deep_{i}"]
+            h = jax.nn.relu(
+                h @ layer["kernel"].astype(cdt)
+                + layer["bias"].astype(cdt)
+            )
+        out = dense_params[f"deep_{n_hidden}"]
         deep_logits = h.astype(jnp.float32) @ out["kernel"] + out["bias"]
 
         return wide_logits + deep_logits
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        dense_params, tables = self.split_embeddings(params)
+        rows = self.gather_rows(tables, self.embedding_ids(x))
+        return self.apply_from_rows(dense_params, rows, x)
 
     def param_shardings(self, layout=None) -> dict:
         """PartitionSpec pytree matching ``init``'s structure: tables

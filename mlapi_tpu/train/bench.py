@@ -105,24 +105,38 @@ def bench_train(
             mesh = create_mesh(cfg.mesh_shape)
 
     params = model.init(jax.random.key(cfg.seed))
-    tx = _make_optimizer(
-        optimizer or cfg.optimizer, cfg.learning_rate,
-        model=model, params=params,
-    )
-    if mesh is not None:
-        params = params_for_model(model, params, mesh)
-        opt_state = jax.jit(tx.init)(params)
-    else:
-        opt_state = tx.init(params)
     # Same task resolution as fit: explicit dataset marker first,
     # label-shape fallback — the bench must time the exact program
     # fit runs (LM presets use the shifted, pad-masked objective).
     task = splits.extras.get(
         "task", "lm" if np.asarray(splits.y_train).ndim == 2 else "classify"
     )
-    step_fn = make_train_step(
-        model.apply, tx, weight_decay=cfg.weight_decay, task=task
-    )
+    opt_name = optimizer or cfg.optimizer
+    if opt_name.startswith("recsys-sparse-"):
+        # The sparse-embedding step (train/sparse_embed.py): the bench
+        # must time the exact program fit runs for this optimizer.
+        from mlapi_tpu.train.sparse_embed import make_sparse_recsys_step
+
+        base = _make_optimizer(
+            opt_name[len("recsys-sparse-"):], cfg.learning_rate
+        )
+        init_opt, step_fn = make_sparse_recsys_step(
+            model, base, cfg.learning_rate, task=task,
+            weight_decay=cfg.weight_decay,
+        )
+    else:
+        tx = _make_optimizer(
+            opt_name, cfg.learning_rate, model=model, params=params,
+        )
+        init_opt = tx.init
+        step_fn = make_train_step(
+            model.apply, tx, weight_decay=cfg.weight_decay, task=task
+        )
+    if mesh is not None:
+        params = params_for_model(model, params, mesh)
+        opt_state = jax.jit(init_opt)(params)
+    else:
+        opt_state = init_opt(params)
 
     # One fixed batch, reused: this measures the step program, not the
     # host data pipeline (which fit's (seed, step)-keyed batching does

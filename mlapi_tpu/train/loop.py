@@ -324,6 +324,17 @@ def _make_optimizer(
     ``"recsys-<base>"``: embedding tables (as labelled by the model's
     ``optimizer_partitions``) take rowwise AdaGrad, the rest ``<base>``
     — see ``mlapi_tpu.train.optimizers``."""
+    if name.startswith("recsys-sparse-"):
+        # Not an optax transform: the sparse path changes the GRADIENT
+        # representation (row cotangents + scatter), so it is built at
+        # the STEP level — fit/bench branch to
+        # train/sparse_embed.make_sparse_recsys_step before reaching
+        # here.
+        raise ValueError(
+            f"{name!r} is a step-level optimizer (sparse embedding "
+            "updates), not an optax transform; use train.fit / the "
+            "train CLI, or make_sparse_recsys_step directly"
+        )
     if name.startswith("recsys-"):
         if model is None or not hasattr(model, "optimizer_partitions"):
             raise ValueError(
@@ -414,22 +425,54 @@ def fit(
         init_params if init_params is not None
         else model.init(jax.random.key(seed))
     )
-    tx = _make_optimizer(optimizer, learning_rate, model=model, params=params)
-    if hasattr(model, "trainable_mask"):
-        # Parameter-efficient fine-tuning (LoRA): frozen leaves get no
-        # update and — the part that matters for memory — no optimizer
-        # state at all (adamw moments exist only for the adapters).
-        tx = optax.masked(tx, model.trainable_mask(params))
+    # TRUE sparse embedding updates (recsys-sparse-<base>): gradients
+    # w.r.t. gathered rows + scatter updates of touched rows only —
+    # the dense [F, V, D] cotangent and full-table optimizer sweep
+    # never materialize (train/sparse_embed.py). Orthogonal features
+    # that would force dense table traffic are rejected there or here.
+    sparse_embed = optimizer.startswith("recsys-sparse-")
+    if sparse_embed:
+        from mlapi_tpu.train.sparse_embed import make_sparse_recsys_step
 
+        if distill_from is not None:
+            raise ValueError(
+                "recsys-sparse-* cannot distill: the teacher loss "
+                "needs the full forward's dense gradient path"
+            )
+        if debug_checks:
+            raise ValueError(
+                "recsys-sparse-* does not support --debug-checks; "
+                "use the dense recsys-<base> path to checkify"
+            )
+        base = _make_optimizer(
+            optimizer[len("recsys-sparse-"):], learning_rate
+        )
+        sparse_init, sparse_step = make_sparse_recsys_step(
+            model, base, learning_rate, task=task,
+            weight_decay=weight_decay,
+        )
+        tx = None
+    else:
+        tx = _make_optimizer(
+            optimizer, learning_rate, model=model, params=params
+        )
+        if hasattr(model, "trainable_mask"):
+            # Parameter-efficient fine-tuning (LoRA): frozen leaves
+            # get no update and — the part that matters for memory —
+            # no optimizer state at all (adamw moments exist only for
+            # the adapters).
+            tx = optax.masked(tx, model.trainable_mask(params))
+
+    init_opt = sparse_init if sparse_embed else tx.init
     if mesh is not None:
         # Model-declared layout (e.g. Wide&Deep's sharded embedding
         # tables) or fully replicated. Optimizer state initialised
         # *under jit from placed params*, so its leaves inherit the
         # same shardings (adam moments shard like their params).
         params = params_for_model(model, params, mesh)
-        opt_state = jax.jit(tx.init)(params)
+        opt_state = jax.jit(init_opt)(params)
     else:
-        opt_state = tx.init(params)
+        opt_state = init_opt(params)
 
     # The hyperparameters that define the optimisation trajectory; a
     # resumed run must match them exactly (steps may grow — extending
@@ -485,12 +528,15 @@ def fit(
                 "resume=False / --no-resume"
             )
 
-    step_fn = make_train_step(
-        model.apply, tx, weight_decay=weight_decay,
-        debug_checks=debug_checks, task=task, teacher=teacher,
-        distill_temperature=distill_temperature,
-        distill_alpha=distill_alpha,
-    )
+    if sparse_embed:
+        step_fn = sparse_step
+    else:
+        step_fn = make_train_step(
+            model.apply, tx, weight_decay=weight_decay,
+            debug_checks=debug_checks, task=task, teacher=teacher,
+            distill_temperature=distill_temperature,
+            distill_alpha=distill_alpha,
+        )
 
     def eval_fn(p):
         if task == "lm":

@@ -1,0 +1,176 @@
+"""TRUE sparse embedding updates: touch only the rows a batch read.
+
+``train/optimizers.py``'s rowwise AdaGrad already has sparse
+SEMANTICS (untouched rows are bit-frozen), but it is expressed
+DENSELY: ``jax.grad`` materializes the full ``[F, V, D]`` table
+cotangent (a scatter over ~170 MB for criteo), and the optimizer
+update then reads and rewrites the whole table plus its ``[F, V]``
+accumulator every step. On a memory-bound step (criteo-widedeep:
+0.69 flops/byte, r04 roofline) that dense traffic IS the step.
+
+This module removes it. The train step takes gradients w.r.t. the
+GATHERED rows (``[B, F, D]`` — the model's ``apply_from_rows``
+protocol splits the forward at the gather), aggregates duplicate ids
+with a sort + segment-sum (all static shapes, jit-safe), and
+scatter-updates exactly the touched rows of the table and its
+accumulator:
+
+    traffic/step ~ B*F rows (~27 MB more than the MLP for criteo)
+    instead of 2 full tables + accumulator (~500 MB).
+
+EXACT equivalence with the dense path (``recsys-<base>``), proven in
+``tests/test_sparse_embed.py``: per unique row, the aggregated
+gradient is the dense row gradient (gather autodiff sums occurrence
+cotangents), the accumulator advances once by ``mean(g_row**2)``,
+and the update is ``-lr * g_row / sqrt(acc_new + eps)`` — the same
+numbers rowwise AdaGrad produces, minus the untouched-row rewrites.
+
+Constraints (checked loudly at build time): classification task, no
+weight decay (decay would touch every row — and decaying unseen
+embedding rows is exactly what rowwise AdaGrad exists to avoid), no
+distillation. Spelled ``optimizer: recsys-sparse-<base>`` in configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def sparse_rowwise_adagrad_update(
+    table: jax.Array,
+    acc: jax.Array,
+    ids: jax.Array,
+    occ_grads: jax.Array,
+    *,
+    learning_rate: float,
+    eps: float = 1e-10,
+) -> tuple[jax.Array, jax.Array]:
+    """One rowwise-AdaGrad step touching only the rows in ``ids``.
+
+    ``table``: ``[F, V, D]``; ``acc``: ``[F, V]``; ``ids``:
+    ``[B, F]`` int32; ``occ_grads``: ``[B, F, D]`` per-OCCURRENCE
+    cotangents (duplicate ids carry their own grads and are summed
+    here, matching the dense gather-autodiff semantics).
+
+    Static-shape duplicate aggregation: flatten to ``[N]`` row keys,
+    sort, segment-sum equal keys, then scatter the aggregated update
+    and accumulator increment at FIRST occurrences only (duplicate
+    positions contribute exact zeros — a scatter-add of 0 is a
+    no-op, so no dynamic uniqueness is needed).
+    """
+    f, v, d = table.shape
+    n = ids.shape[0] * ids.shape[1]
+    keys = (
+        ids.astype(jnp.int32)
+        + jnp.arange(f, dtype=jnp.int32)[None, :] * v
+    ).reshape(n)
+    g = occ_grads.astype(jnp.float32).reshape(n, d)
+
+    order = jnp.argsort(keys)
+    sk = keys[order]
+    g = g[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
+    )
+    seg = jnp.cumsum(first) - 1
+    g_agg = jax.ops.segment_sum(g, seg, num_segments=n)[seg]  # [N, D]
+
+    # Scatter in NATIVE [F, V] coordinates: flattening to [F*V] would
+    # merge the model-axis-sharded vocab dim and make GSPMD replicate
+    # the result; 2-d indices keep the table's declared layout.
+    fidx, vidx = sk // v, sk % v
+    inc = jnp.where(first, jnp.mean(jnp.square(g_agg), axis=-1), 0.0)
+    acc_new = acc.at[fidx, vidx].add(inc)
+    denom = jnp.sqrt(acc_new[fidx, vidx] + eps)[:, None]
+    upd = jnp.where(first[:, None], -learning_rate * g_agg / denom, 0.0)
+    table_new = table.at[fidx, vidx].add(upd.astype(table.dtype))
+    return table_new, acc_new
+
+
+def make_sparse_recsys_step(
+    model,
+    base_tx: optax.GradientTransformation,
+    learning_rate: float,
+    *,
+    task: str = "classify",
+    weight_decay: float = 0.0,
+    eps: float = 1e-10,
+    initial_accumulator_value: float = 0.1,
+):
+    """Build ``(init_state, step)`` for a model implementing the
+    sparse-embedding protocol (``split_embeddings`` /
+    ``embedding_ids`` / ``gather_rows`` / ``apply_from_rows``).
+
+    ``step(params, opt_state, x, y) -> (params, opt_state, loss)``
+    with params/opt_state donated, exactly like
+    ``loop.make_train_step``'s contract.
+    """
+    if task != "classify":
+        raise ValueError(
+            "recsys-sparse-* supports classification steps only "
+            f"(got task={task!r})"
+        )
+    if weight_decay:
+        raise ValueError(
+            "recsys-sparse-* requires weight_decay=0: decay touches "
+            "every table row, which defeats the sparse update (and "
+            "decaying unseen embedding rows is the failure mode "
+            "rowwise AdaGrad exists to avoid)"
+        )
+    for proto in ("split_embeddings", "embedding_ids", "gather_rows",
+                  "apply_from_rows", "merge_embeddings"):
+        if not hasattr(model, proto):
+            raise ValueError(
+                f"model {type(model).__name__} does not implement the "
+                f"sparse-embedding protocol (missing {proto})"
+            )
+
+    def init_state(params):
+        dense, tables = model.split_embeddings(params)
+        return {
+            "base": base_tx.init(dense),
+            "acc": {
+                k: jnp.full(
+                    t.shape[:-1], initial_accumulator_value, jnp.float32
+                )
+                for k, t in tables.items()
+            },
+        }
+
+    def step(params, opt_state, x, y):
+        dense, tables = model.split_embeddings(params)
+        ids = model.embedding_ids(x)
+        rows = model.gather_rows(tables, ids)
+
+        def loss_fn(dense_p, rows_p):
+            logits = model.apply_from_rows(dense_p, rows_p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, (g_dense, g_rows) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1)
+        )(dense, rows)
+
+        updates, base_state = base_tx.update(
+            g_dense, opt_state["base"], dense
+        )
+        dense_new = optax.apply_updates(dense, updates)
+
+        tables_new = {}
+        acc_new = {}
+        for k, t in tables.items():
+            tables_new[k], acc_new[k] = sparse_rowwise_adagrad_update(
+                t, opt_state["acc"][k], ids, g_rows[k],
+                learning_rate=learning_rate, eps=eps,
+            )
+        return (
+            model.merge_embeddings(dense_new, tables_new),
+            {"base": base_state, "acc": acc_new},
+            loss,
+        )
+
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+    return init_state, jitted

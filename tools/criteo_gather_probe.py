@@ -23,11 +23,13 @@ readback (never ``block_until_ready`` through the tunnel):
 3. ``gather_grad``       — forward + scatter-add backward, random
                            ids (training's actual embedding cost).
 4. ``apply_fwd``         — the full model forward.
-5. ``train_step``        — the full jitted train step on THIS
-                           attach's topology (recorded with device
-                           count; the committed 13.0 ms basis was
-                           the bench's own topology — compare only
-                           same-topology numbers).
+5. ``train_step_dense`` / ``train_step_sparse`` — the full jitted
+                           step, dense-recsys control vs the preset's
+                           TRUE-sparse embedding update
+                           (train/sparse_embed.py), interleaved, on
+                           THIS attach's topology (recorded with
+                           device count; compare only same-topology
+                           numbers).
 
 Decision rule, recorded with the output: a Pallas gather kernel can
 only help the portion of (3) above the streaming floor implied by
@@ -168,33 +170,61 @@ def main() -> int:
           flush=True)
 
     from mlapi_tpu.train.loop import _make_optimizer, make_train_step
+    from mlapi_tpu.train.sparse_embed import make_sparse_recsys_step
 
-    tx = _make_optimizer(cfg.optimizer, cfg.learning_rate,
-                         model=model, params=params)
-    opt_state = tx.init(params)
-    step_fn = make_train_step(model.apply, tx)
+    def build_step(kind):
+        p0 = model.init(jax.random.key(2))
+        if kind == "sparse":
+            base = _make_optimizer("adamw", cfg.learning_rate)
+            init, step = make_sparse_recsys_step(
+                model, base, cfg.learning_rate
+            )
+            return p0, init(p0), step
+        tx = _make_optimizer("recsys-adamw", cfg.learning_rate,
+                             model=model, params=p0)
+        return p0, tx.init(p0), make_train_step(model.apply, tx)
 
-    # params/opt_state are DONATED: time a chained run (each call
-    # consumes the previous state — the real training pattern), one
-    # scalar sync at the end.
-    p, s, warm_loss = step_fn(params, opt_state, x, y)  # compile+warm
-    float(warm_loss)  # settle: the warm step must NOT leak into t0
-    rtt = rtt_of(lambda: warm_loss + 0)
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(REPS):
-        p, s, loss = step_fn(p, s, x, y)
-    float(loss)
-    dt = max(time.perf_counter() - t0 - rtt, 1e-9) / REPS
+    # Dense control vs the preset's TRUE-sparse step, INTERLEAVED
+    # (this box's absolute throughput drifts; the ratio is the
+    # result). params/opt_state are DONATED: chained runs, one
+    # scalar sync per window.
+    steps = {"train_step_dense": build_step("dense"),
+             "train_step_sparse": build_step("sparse")}
+    rtts = {}
+    for k, (p0, s0, step) in steps.items():
+        p, s, warm_loss = step(p0, s0, x, y)  # compile + warm
+        float(warm_loss)  # settle: the warm step must NOT leak in
+        rtts[k] = rtt_of(lambda: warm_loss + 0)
+        steps[k] = (p, s, step)
+    totals = {k: 0.0 for k in steps}
+    executed = 4 * (REPS // 4)  # windows x steps actually run
+    for _ in range(4):
+        for k in steps:
+            p, s, step = steps[k]
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(REPS // 4):
+                p, s, loss = step(p, s, x, y)
+            float(loss)
+            totals[k] += max(
+                time.perf_counter() - t0 - rtts[k], 1e-9
+            )
+            steps[k] = (p, s, step)
     # Single-process topology: no mesh here — compare only against
     # same-topology numbers, never across (the committed bench basis
     # ran the bench's own topology).
-    res["train_step"] = {"ms": round(dt * 1e3, 3),
-                         "devices": len(jax.devices()),
-                         "mesh": None,
-                         "rtt_deducted_ms": round(rtt * 1e3, 2)}
-    print(json.dumps({"stage": "train_step", **res["train_step"]}),
-          flush=True)
+    for k in totals:
+        res[k] = {"ms": round(totals[k] / executed * 1e3, 3),
+                  "devices": len(jax.devices()),
+                  "mesh": None,
+                  "rtt_deducted_ms": round(rtts[k] * 1e3, 2)}
+        print(json.dumps({"stage": k, **res[k]}), flush=True)
+    res["train_step"] = res["train_step_dense"]  # summary basis
+    print(json.dumps({
+        "stage": "sparse_speedup",
+        "x": round(res["train_step_dense"]["ms"]
+                   / res["train_step_sparse"]["ms"], 2),
+    }), flush=True)
 
     embed_ms = res["gather_grad"]["ms"]
     step_ms = res["train_step"]["ms"]
