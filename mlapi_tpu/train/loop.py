@@ -444,6 +444,16 @@ def fit(
                 "recsys-sparse-* does not support --debug-checks; "
                 "use the dense recsys-<base> path to checkify"
             )
+        if hasattr(model, "trainable_mask"):
+            # A LoRA wrapper delegates the sparse-embedding protocol
+            # to its inner model, so the step would silently train the
+            # frozen base with full moments and ignore the adapters.
+            raise ValueError(
+                "recsys-sparse-* cannot train a parameter-efficient "
+                "(LoRA) wrapper: the sparse step bypasses "
+                "trainable_mask; fine-tune with the dense "
+                "recsys-<base> path instead"
+            )
         base = _make_optimizer(
             optimizer[len("recsys-sparse-"):], learning_rate
         )

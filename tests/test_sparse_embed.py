@@ -77,15 +77,16 @@ def test_sparse_step_matches_dense_recsys_exactly(model, batch):
     sparse_p, _, sparse_loss = _run_sparse(model, p0, x, y, 5, 3e-3)
     assert np.isclose(dense_loss, sparse_loss, rtol=1e-5)
     dl, treedef = jax.tree.flatten(dense_p)
+    # flatten_up_to validates sparse_p's structure AGAINST dense_p's
+    # treedef, so the zipped leaves are guaranteed aligned.
     sl = treedef.flatten_up_to(sparse_p)
     paths = [str(k) for k, _ in jax.tree_util.tree_flatten_with_path(
         dense_p)[0]]
-    for path, a, b in zip(paths, dl, jax.tree.leaves(sparse_p)):
+    for path, a, b in zip(paths, dl, sl):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b),
             rtol=2e-5, atol=2e-6, err_msg=path,
         )
-    del sl
 
 
 def test_untouched_rows_are_bit_frozen(model, batch):
