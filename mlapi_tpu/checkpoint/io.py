@@ -254,7 +254,11 @@ def load_checkpoint(
         # metadata (shapes/dtypes) so the topology pin below applies
         # to this path too — not just to callers that know the tree.
         with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as mck:
-            im = mck.metadata(path / _PARAMS_DIR).item_metadata
+            im = mck.metadata(path / _PARAMS_DIR)
+        # Orbax's metadata container changed across releases: newer
+        # versions wrap the tree in .item_metadata (sometimes again in
+        # .tree), older ones return the metadata pytree directly.
+        im = getattr(im, "item_metadata", im)
         abstract_params = jax.tree.map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
             im.tree if hasattr(im, "tree") else im,

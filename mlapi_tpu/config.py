@@ -33,8 +33,13 @@ class TrainConfig:
     seed: int = 0
     eval_every: int = 0
 
-    # Parallelism: mesh shape over (data, model) axes. None = no mesh
-    # (single device). (8, 1) = pure DP over 8 chips, (2, 4) = DP x TP.
+    # Parallelism: mesh shape over (data, model) axes, or THREE dims
+    # (data, fsdp, model) to add ZeRO-style parameter/optimizer-state
+    # sharding. None = no mesh (single device). (8, 1) = pure DP over
+    # 8 chips, (2, 4) = DP x TP, (1, 8, 1) = FSDP over 8 chips
+    # (per-device params + AdamW moments drop ~8x; same math,
+    # reduce-scatter/all-gather instead of all-reduce). The CLI's
+    # --mesh-shape d,f,m overrides per run.
     mesh_shape: tuple[int, ...] | None = None
 
     checkpoint_dir: str | None = None

@@ -60,17 +60,42 @@ def resolve_doc(base: Path, rel: str) -> Path | None:
     return None
 
 
+def live_markdown_docs(base: Path) -> list[Path]:
+    """Every ``docs/*.md`` under ``base`` beyond ``DOC_SOURCES``,
+    sorted by name.
+
+    ``docs_text``'s live mode follows the repo's documentation as it
+    GROWS: the pre-unification loader globbed ``docs/*.md``, and the
+    shared ``DOC_SOURCES`` list (frozen-snapshot compatible) names
+    only ``docs/DESIGN.md`` — without this, new design docs would
+    silently drop out of live LM corpora (ADVICE r05 #2).
+    ``docs_clf`` must NOT use this: its classes are the fixed
+    ``DOC_SOURCES`` files, one label per file."""
+    known = {Path(rel).name for rel in DOC_SOURCES}
+    return sorted(
+        p for p in (base / "docs").glob("*.md") if p.name not in known
+    )
+
+
 def corpus_provenance(base: Path) -> str:
     """The provenance string measurements carry in
     ``extras["corpus"]``: the frozen snapshot reports its pinned
     commit, anything else reports the path it read.
 
-    A frozen claim is VERIFIED, not trusted: every file listed in
-    MANIFEST.json must hash to its recorded sha256, otherwise the
-    published accuracies would silently stop reproducing while still
-    reporting ``frozen@...`` — the exact failure mode the snapshot
-    exists to eliminate. Corruption raises; it must not degrade to a
-    quiet "live" label."""
+    A frozen claim is VERIFIED, not trusted, twice over (ADVICE r05
+    #1):
+
+    - the manifest must cover EXACTLY the ``DOC_SOURCES`` basenames —
+      a foreign or empty ``MANIFEST.json`` (no ``files``, extra
+      files, missing files) previously passed its per-file loop
+      vacuously and labeled arbitrary user content ``frozen@?``; such
+      a directory is just a user corpus and reports ``live:<path>``;
+    - every covered file must hash to its recorded sha256, otherwise
+      the published accuracies would silently stop reproducing while
+      still reporting ``frozen@...`` — the exact failure mode the
+      snapshot exists to eliminate. Corruption raises; it must not
+      degrade to a quiet "live" label.
+    """
     mf = base / "MANIFEST.json"
     if not mf.exists():
         return f"live:{base}"
@@ -78,7 +103,12 @@ def corpus_provenance(base: Path) -> str:
     import json
 
     manifest = json.loads(mf.read_text())
-    for name, meta in manifest.get("files", {}).items():
+    files = manifest.get("files", {})
+    if set(files) != {Path(rel).name for rel in DOC_SOURCES}:
+        # Not OUR snapshot manifest — whatever wrote it, this dir's
+        # contents are unpinned as far as the framework is concerned.
+        return f"live:{base}"
+    for name, meta in files.items():
         p = base / name
         digest = (
             hashlib.sha256(p.read_bytes()).hexdigest()

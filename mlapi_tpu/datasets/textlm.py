@@ -27,6 +27,7 @@ from mlapi_tpu.utils.vocab import LabelVocab
 from mlapi_tpu.datasets._corpus import (
     DOC_SOURCES as _DOC_SOURCES,
     corpus_provenance as _corpus_provenance,
+    live_markdown_docs as _live_markdown_docs,
     resolve_doc as _resolve_doc,
     resolve_root as _resolve_root,
 )
@@ -44,17 +45,26 @@ def load_docs_text(
     int32); the LM loss shifts targets itself. Windows are cut with
     ``stride`` (default ``seq_len``, i.e. non-overlapping); the test
     split is the TAIL of the stream, so train/test windows never
-    overlap even with stride < seq_len."""
+    overlap even with stride < seq_len.
+
+    ``root="live"`` reads the repo's CURRENT docs and — unlike the
+    frozen default, which is pinned to the four ``DOC_SOURCES`` files
+    so published numbers reproduce — also sweeps every other
+    ``docs/*.md``, restoring the pre-unification glob (the corpus
+    FOLLOWS the documentation as it grows; ADVICE r05 #2). Frozen
+    and user-dir modes stay exactly ``DOC_SOURCES``."""
     from mlapi_tpu.text import ByteTokenizer
 
     tok = ByteTokenizer()
     stride = stride or seq_len
     base = _resolve_root(root)
-    texts = []
-    for rel in _DOC_SOURCES:
-        p = _resolve_doc(base, rel)
-        if p is not None:
-            texts.append(p.read_text(errors="replace"))
+    paths = [
+        p for rel in _DOC_SOURCES
+        if (p := _resolve_doc(base, rel)) is not None
+    ]
+    if root == "live":
+        paths += _live_markdown_docs(base)
+    texts = [p.read_text(errors="replace") for p in paths]
     if not texts:
         raise FileNotFoundError(f"no corpus files under {base}")
     ids = np.asarray(tok.token_ids("\n\n".join(texts)), np.int32)

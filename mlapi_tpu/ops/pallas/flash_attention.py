@@ -241,17 +241,27 @@ def _jnp_flash(q, k, v, mask, causal, scale, window=None):
     return out, lse
 
 
+def _vma_of(x):
+    """The varying-manual-axes of ``x``'s aval, or None. jax.typeof
+    (and the vma type system) only exist on newer jax; on releases
+    without it there is no vma checker to satisfy."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
+
+
 def _inside_vma_shard_map(x):
     """True when tracing inside a vma-checked shard_map (the aval
     carries varying-manual-axes) — static at trace time."""
-    return bool(getattr(jax.typeof(x), "vma", None))
+    return bool(_vma_of(x))
 
 
 def _out_struct(shape, dtype, like):
     # Inside shard_map, pallas_call outputs must declare which mesh
     # axes they vary over (vma); mirror the query operand's type so
     # the kernels compose with the ring/sequence-parallel paths.
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = _vma_of(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

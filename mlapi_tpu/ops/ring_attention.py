@@ -41,8 +41,13 @@ def _varying_like(x, like):
     type mismatch in jax 0.9's vma checker. ``lax.pcast`` refuses
     axes a value already varies over, so cast only the missing ones.
     """
-    want = getattr(jax.typeof(like), "vma", None) or frozenset()
-    have = getattr(jax.typeof(x), "vma", None) or frozenset()
+    # jax.typeof / vma / lax.pcast exist only on newer jax; on older
+    # releases (no vma checker) the cast is a no-op by construction.
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None or not hasattr(jax.lax, "pcast"):
+        return x
+    want = getattr(typeof(like), "vma", None) or frozenset()
+    have = getattr(typeof(x), "vma", None) or frozenset()
     missing = tuple(a for a in want if a not in have)
     if not missing:
         return x
@@ -440,11 +445,24 @@ def ring_self_attention(
         block_impl=block_impl,
         zigzag=zigzag,
     )
-    mapped = jax.shard_map(
+    # jax.shard_map graduated from jax.experimental between releases;
+    # accept either spelling so the SP path runs on both. The old
+    # experimental checker has no replication rule for pallas_call
+    # (the vma type system that replaced it handles this), so it
+    # needs check_rep=False to admit the flash block kernels.
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+        extra = {}
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        extra = {"check_rep": False}
+    mapped = _shard_map(
         inner,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
+        **extra,
     )
     if mask is None:
         mask = jnp.ones(q.shape[:2], jnp.float32)

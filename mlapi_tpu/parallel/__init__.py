@@ -10,12 +10,21 @@ sharded ``jit`` — no hand-written NCCL/MPI-style transport).
 
 from mlapi_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
+    FSDP_AXIS,
     MODEL_AXIS,
+    batch_shard_axes,
+    batch_shard_size,
     create_mesh,
     params_for_model,
     place_params,
+    place_train_state,
     replicate_for_mesh,
     shard_batch_for_mesh,
+    state_shardings_like,
 )
-from mlapi_tpu.parallel.layout import SpecLayout  # noqa: F401
+from mlapi_tpu.parallel.layout import (  # noqa: F401
+    FSDP_MIN_SIZE,
+    SpecLayout,
+    fsdp_spec_tree,
+)
 from mlapi_tpu.parallel.distributed import initialize_from_env  # noqa: F401

@@ -15,12 +15,13 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 
 
 def create_mesh(
     shape: tuple[int, ...] | None = None,
-    axis_names: tuple[str, ...] = (DATA_AXIS, MODEL_AXIS),
+    axis_names: tuple[str, ...] | None = None,
     *,
     devices=None,
 ) -> Mesh:
@@ -30,9 +31,22 @@ def create_mesh(
     a trivial ``model`` axis — right for pure data-parallel configs.
     Pass an explicit ``shape`` (e.g. ``(2, 4)``) for configs that
     shard params over ``model`` (Criteo embeddings, BERT TP).
+
+    A THREE-dimensional ``shape`` names the axes ``(data, fsdp,
+    model)``: the middle axis is a second data-parallel axis over
+    which parameters and optimizer state are ZeRO-sharded
+    (``layout.fsdp_spec_tree``) — GSPMD turns the gradient all-reduce
+    over it into reduce-scatter + all-gather, cutting per-device state
+    memory by the axis size at equal math.
     """
     devices = list(jax.devices() if devices is None else devices)
     n = len(devices)
+    if axis_names is None:
+        axis_names = (
+            (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+            if shape is not None and len(shape) == 3
+            else (DATA_AXIS, MODEL_AXIS)
+        )
     if shape is None:
         shape = (n,) + (1,) * (len(axis_names) - 1)
     if int(np.prod(shape)) != n:
@@ -95,28 +109,164 @@ def params_for_model(model, params, mesh: Mesh, layout=None):
 
     ``layout`` (a ``SpecLayout``) renames the mesh axes consistently
     across every model — pass it when the mesh doesn't use the default
-    ``data``/``model`` axis names."""
+    ``data``/``model`` axis names.
+
+    On a mesh with a non-trivial ``fsdp`` axis the model's TP specs
+    (or the replicated default) are augmented leaf-by-leaf with
+    ZeRO-style parameter sharding (``layout.fsdp_spec_tree``): every
+    large-enough leaf gets its largest still-unsharded dimension
+    partitioned over ``fsdp``. Models need no FSDP awareness — the
+    derivation composes with whatever TP layout they declare."""
     spec_fn = getattr(model, "param_shardings", None)
-    return place_params(params, mesh, spec_fn(layout) if spec_fn else None)
+    spec_tree = spec_fn(layout) if spec_fn else None
+    fsdp_axis = layout.fsdp_axis if layout is not None else FSDP_AXIS
+    if mesh.shape.get(fsdp_axis, 1) > 1:
+        from mlapi_tpu.parallel.layout import fsdp_spec_tree
+
+        spec_tree = fsdp_spec_tree(
+            params, spec_tree, mesh.shape[fsdp_axis], fsdp_axis=fsdp_axis
+        )
+    return place_params(params, mesh, spec_tree)
 
 
-def shard_batch_for_mesh(pytree, mesh: Mesh, axis: str = DATA_AXIS):
+def state_shardings_like(opt_abstract, params, mesh: Mesh):
+    """Shardings for an optimizer-state pytree, mirrored from placed
+    ``params`` — the piece that makes ZeRO sharding cover the moments,
+    which for AdamW are 2x the params.
+
+    ``jax.jit(tx.init)(placed_params)`` does NOT inherit the param
+    shardings (measured: the zeros have no data dependence on the
+    inputs, so GSPMD assigns them the default device) — the moments
+    must be placed explicitly. Optax states mirror the param tree's
+    dict structure under their namedtuple/tuple wrappers, so each
+    state leaf is matched to its param by the trailing run of dict
+    keys in its path (``.mu['dense_0']['kernel']`` →
+    ``['dense_0']['kernel']``), longest suffix first:
+
+    - exact shape match → the param's own sharding (adam mu/nu);
+    - leading-dims match → the param's spec truncated to the leaf's
+      rank (rowwise-AdaGrad accumulators: ``[F, V]`` for a
+      ``[F, V, D]`` table keeps the table's vocab sharding);
+    - no match (step counters, ``optax.MaskedNode``) → replicated.
+    """
+    from jax.tree_util import DictKey, tree_leaves_with_path
+
+    # Param index: every dict-key path suffix → (shape, sharding);
+    # ambiguous suffixes (two params sharing a trailing key) drop out
+    # — their leaves fall back through shorter suffixes or replication.
+    index: dict = {}
+    collisions: set = set()
+    for path, leaf in tree_leaves_with_path(params):
+        keys = tuple(
+            k.key for k in path if isinstance(k, DictKey)
+        )
+        for i in range(len(keys)):
+            suffix = keys[i:]
+            if suffix in index:
+                collisions.add(suffix)
+            else:
+                index[suffix] = (tuple(leaf.shape), leaf.sharding)
+    replicated = NamedSharding(mesh, P())
+
+    def match(path, leaf):
+        if not hasattr(leaf, "shape"):
+            return replicated  # defensive: unshaped leaf
+        shape = tuple(leaf.shape)
+        keys = [k.key for k in path if isinstance(k, DictKey)]
+        # The trailing run of dict keys (state wrappers are tuples/
+        # namedtuples; dicts inside the run that are NOT param path
+        # segments — e.g. a state dict {'acc': ...} — are shed as the
+        # suffix shortens).
+        for i in range(len(keys)):
+            suffix = tuple(keys[i:])
+            if suffix in collisions or suffix not in index:
+                continue
+            p_shape, p_sharding = index[suffix]
+            if shape == p_shape:
+                return p_sharding
+            if shape == p_shape[: len(shape)]:
+                spec = tuple(p_sharding.spec)[: len(shape)]
+                return NamedSharding(mesh, P(*spec))
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(match, opt_abstract)
+
+
+def place_train_state(model, params, init_opt, mesh: Mesh, layout=None):
+    """Place a full train state on ``mesh``: params in the model's
+    (FSDP-augmented) layout, optimizer state EXPLICITLY in the
+    mirrored layout, and the sharding trees a train step needs to pin
+    its outputs.
+
+    Returns ``(params, opt_state, state_shardings)`` with
+    ``state_shardings = (param_shardings, opt_shardings)`` — the ONE
+    implementation of the "moments must be placed explicitly"
+    invariant, shared by ``fit``, the bench, and the multichip dryrun
+    so they cannot measure different memory layouts than training
+    uses.
+    """
+    params = params_for_model(model, params, mesh, layout)
+    opt_sh = state_shardings_like(
+        jax.eval_shape(init_opt, params), params, mesh
+    )
+    opt_state = jax.jit(init_opt, out_shardings=opt_sh)(params)
+    return params, opt_state, (
+        jax.tree.map(lambda a: a.sharding, params), opt_sh
+    )
+
+
+def batch_shard_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The mesh axes a batch dimension shards over: ``data``, plus
+    ``fsdp`` when present — the FSDP axis is a second data-parallel
+    axis (each of its shards sees different examples; what it changes
+    is where the *state* lives, not the math)."""
+    axes = tuple(
+        a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names
+    )
+    return axes or (DATA_AXIS,)
+
+
+def batch_shard_size(mesh: Mesh) -> int:
+    """Product of the batch-sharding axis sizes (divisibility unit
+    for batch/bucket dimensions on this mesh)."""
+    n = 1
+    for a in batch_shard_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
+def shard_batch_for_mesh(pytree, mesh: Mesh, axis: str | tuple = DATA_AXIS):
     """Shard each leaf's leading (batch) dimension over ``axis``.
 
     Leading dims must be divisible by the axis size — callers pad
     (the serving batcher pads to bucket sizes for exactly this
     reason, and to avoid recompilation).
+
+    When the mesh carries an ``fsdp`` axis and the default ``data``
+    axis is requested, the batch shards over BOTH ``(data, fsdp)`` —
+    on an FSDP mesh every device holds distinct examples, and the
+    divisibility unit grows to ``data * fsdp``
+    (:func:`batch_shard_size`).
     """
-    axis_size = mesh.shape[axis]
+    if axis == DATA_AXIS:
+        axes = batch_shard_axes(mesh)
+    elif isinstance(axis, (tuple, list)):
+        axes = tuple(axis)
+    else:
+        axes = (axis,)
+    axis_size = 1
+    for a in axes:
+        axis_size *= mesh.shape[a]
+    dim0 = axes if len(axes) > 1 else axes[0]
 
     def put(leaf):
         arr = np.asarray(leaf)
         if arr.shape[0] % axis_size:
             raise ValueError(
-                f"batch dim {arr.shape[0]} not divisible by mesh axis "
-                f"{axis!r} of size {axis_size}; pad first"
+                f"batch dim {arr.shape[0]} not divisible by mesh axes "
+                f"{axes!r} of total size {axis_size}; pad first"
             )
-        spec = P(axis, *(None,) * (arr.ndim - 1))
+        spec = P(dim0, *(None,) * (arr.ndim - 1))
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, pytree)

@@ -84,18 +84,24 @@ class InferenceEngine:
         self.mesh = mesh
         self.meta = dict(meta or {})
         if mesh is not None:
-            from mlapi_tpu.parallel import DATA_AXIS, params_for_model
+            from mlapi_tpu.parallel import batch_shard_size, params_for_model
 
-            axis = mesh.shape[DATA_AXIS]
+            # Batches shard over data AND (when present) fsdp — the
+            # divisibility unit is their product.
+            axis = batch_shard_size(mesh)
             bad = [b for b in self.buckets if b % axis]
             if bad:
                 raise ValueError(
-                    f"buckets {bad} not divisible by data-axis size {axis}"
+                    f"buckets {bad} not divisible by batch-sharding "
+                    f"axes of total size {axis}"
                 )
             # Serve in the model's declared layout (e.g. Wide&Deep's
             # vocab-sharded tables) — the reason to serve on a mesh at
             # all is that the params don't fit (or shouldn't be
-            # copied) per chip.
+            # copied) per chip. A 3-axis mesh additionally
+            # ZeRO-shards every large leaf over ``fsdp``
+            # (params_for_model): weights all-gather per use, so a
+            # model too big per chip serves from sharded storage.
             params = params_for_model(model, params, mesh)
         else:
             params = jax.device_put(params)
@@ -942,12 +948,28 @@ class TextGenerationEngine:
                         timeout = deadline - loop.time()
                         if timeout <= 0:
                             break
-                        try:
-                            nxt = await asyncio.wait_for(
-                                self._queue.get(), timeout
-                            )
-                        except asyncio.TimeoutError:
-                            break
+                        # NOT asyncio.wait_for: on py<3.12 wait_for
+                        # can SWALLOW an external cancel that lands
+                        # just as the inner pop completes (the classic
+                        # lost-cancellation race) — a killed collector
+                        # then keeps collecting and stop() deadlocks.
+                        # Plain asyncio.wait never consumes the
+                        # waiter's cancellation, and the outer ``get``
+                        # keeps a claimed request visible to the
+                        # finally below.
+                        get = asyncio.ensure_future(self._queue.get())
+                        done, _ = await asyncio.wait({get}, timeout=timeout)
+                        if not done:
+                            # Window expired with the pop pending:
+                            # retract it without dropping an item the
+                            # pop claims in the same instant.
+                            get.cancel()
+                            await asyncio.wait({get})
+                            if get.cancelled():
+                                get = None
+                                break
+                        nxt = get.result()
+                        get = None
                         if self._compatible(reqs, nxt):
                             reqs.append(nxt)
                         else:
@@ -995,17 +1017,19 @@ class TextGenerationEngine:
                         get = None
                     else:
                         get.cancel()
-                        try:
-                            await get
-                        except asyncio.CancelledError:
-                            # Distinguish OUR cancel of the child pop
-                            # from the ENGINE being stopped: swallowing
-                            # an external cancel here would un-cancel
-                            # the collector and leave stop() awaiting
-                            # it forever (observed deadlock).
-                            if asyncio.current_task().cancelling():
-                                raise
-                        else:
+                        # ``asyncio.wait`` never re-raises the CHILD's
+                        # cancellation into the waiter, so our own
+                        # cancel of the pop stays silent on every
+                        # Python version, while an EXTERNAL cancel
+                        # (stop(), or a simulated collector death)
+                        # lands on this await and propagates. This
+                        # replaces a py3.11-only Task.cancelling()
+                        # disambiguation — on 3.10 that crashed the
+                        # collector with AttributeError, and any
+                        # flag-based fallback either deadlocks stop()
+                        # or un-cancels a killed collector.
+                        await asyncio.wait({get})
+                        if not get.cancelled():
                             # get won the race with our cancel: the
                             # queue item is in hand — keep it.
                             with self._alock:

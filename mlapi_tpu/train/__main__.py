@@ -267,6 +267,15 @@ def main(argv=None) -> None:
         "--steps", type=int, default=None, help="override config steps"
     )
     parser.add_argument(
+        "--mesh-shape", default=None,
+        help="override the config's device mesh, comma-separated: "
+             "'8,1' = pure DP, '2,4' = DP x TP, and THREE dims "
+             "'d,f,m' add a ZeRO/FSDP axis — e.g. '1,8,1' shards "
+             "params AND optimizer moments over 8 devices "
+             "(per-device state bytes drop ~8x; same math). Works "
+             "with --bench for memory sweeps",
+    )
+    parser.add_argument(
         "--save-every", type=int, default=0,
         help="checkpoint full train state every N steps (enables resume)",
     )
@@ -320,6 +329,21 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
+    mesh_shape = None
+    if args.mesh_shape:
+        try:
+            mesh_shape = tuple(int(d) for d in args.mesh_shape.split(","))
+        except ValueError:
+            parser.error(
+                f"--mesh-shape {args.mesh_shape!r} is not a "
+                "comma-separated list of integers (e.g. '1,8,1')"
+            )
+        if len(mesh_shape) not in (2, 3) or any(d < 1 for d in mesh_shape):
+            parser.error(
+                f"--mesh-shape {args.mesh_shape!r}: need 2 (data,model) "
+                "or 3 (data,fsdp,model) positive dimensions"
+            )
+
     if args.bench:
         from mlapi_tpu.train.bench import DEFAULT_BENCH_PRESETS, bench_train
 
@@ -342,6 +366,7 @@ def main(argv=None) -> None:
             row = bench_train(
                 t, bench_steps=args.bench_steps,
                 batch_size=args.bench_batch,
+                mesh_shape=mesh_shape,
             )
             print(json.dumps(row))
         return
@@ -353,6 +378,8 @@ def main(argv=None) -> None:
 
     if args.steps is not None:
         cfg = dataclasses.replace(cfg, steps=args.steps)
+    if mesh_shape is not None:
+        cfg = dataclasses.replace(cfg, mesh_shape=mesh_shape)
     if args.distill_from is not None:
         cfg = dataclasses.replace(cfg, distill_from=args.distill_from)
     if cfg.distill_required and cfg.distill_from is None:

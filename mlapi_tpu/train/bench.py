@@ -59,6 +59,24 @@ def _peak_for(device, table=_PEAK_FLOPS) -> float | None:
     return None
 
 
+def bytes_per_device(tree) -> int:
+    """Max-over-devices of the bytes a pytree's shards occupy locally
+    (``addressable_shards[...].data.nbytes``) — the committed,
+    deterministic measure of the FSDP memory win (wall-clock on this
+    box swings ±25-30%; byte counts do not). A replicated leaf costs
+    its full ``nbytes`` on EVERY device; an fsdp-sharded leaf 1/axis
+    of it. Host numpy leaves count once (single-device placement)."""
+    per_dev: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            for s in shards:
+                per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
+        elif hasattr(leaf, "nbytes"):
+            per_dev[None] = per_dev.get(None, 0) + leaf.nbytes
+    return max(per_dev.values(), default=0)
+
+
 def bench_train(
     preset,
     *,
@@ -67,19 +85,25 @@ def bench_train(
     batch_size: int | None = None,
     optimizer: str | None = None,
     use_mesh: bool = True,
+    mesh_shape: tuple[int, ...] | None = None,
 ) -> dict[str, Any]:
     """Measure the training step of one ladder preset (by name) or an
     explicit ``TrainConfig`` on the attached backend. Returns a flat
-    dict of numbers (JSON-ready)."""
+    dict of numbers (JSON-ready).
+
+    ``mesh_shape`` overrides the preset's mesh (FSDP-vs-DP memory
+    sweeps: run once per shape and compare the per-device state
+    bytes)."""
     from mlapi_tpu.config import get_preset
     from mlapi_tpu.datasets import get_dataset
     from mlapi_tpu.models import get_model
     from mlapi_tpu.parallel import (
         create_mesh,
-        params_for_model,
+        place_train_state,
         shard_batch_for_mesh,
     )
     from mlapi_tpu.train.loop import _make_optimizer, make_train_step
+    from mlapi_tpu.utils.logging import get_logger
 
     cfg = get_preset(preset) if isinstance(preset, str) else preset
     splits = get_dataset(cfg.dataset, **cfg.dataset_kwargs)
@@ -99,10 +123,20 @@ def bench_train(
     bs = batch_size or cfg.batch_size or min(256, len(splits.x_train))
 
     mesh = None
-    if use_mesh and cfg.mesh_shape is not None:
-        need = int(np.prod(cfg.mesh_shape))
+    bench_mesh_shape = mesh_shape or cfg.mesh_shape
+    if use_mesh and bench_mesh_shape is not None:
+        need = int(np.prod(bench_mesh_shape))
         if need <= jax.device_count():
-            mesh = create_mesh(cfg.mesh_shape)
+            mesh = create_mesh(bench_mesh_shape)
+        else:
+            # Same warning the fit path logs: a silently dropped mesh
+            # makes a memory sweep report single-device bytes with no
+            # hint why the FSDP win vanished.
+            get_logger("train.bench").warning(
+                "bench wants mesh %s but only %d device(s) visible; "
+                "benching unsharded",
+                bench_mesh_shape, jax.device_count(),
+            )
 
     params = model.init(jax.random.key(cfg.seed))
     # Same task resolution as fit: explicit dataset marker first,
@@ -129,14 +163,39 @@ def bench_train(
             opt_name, cfg.learning_rate, model=model, params=params,
         )
         init_opt = tx.init
-        step_fn = make_train_step(
-            model.apply, tx, weight_decay=cfg.weight_decay, task=task
-        )
+        step_fn = None  # built below, once state shardings are known
     if mesh is not None:
-        params = params_for_model(model, params, mesh)
-        opt_state = jax.jit(init_opt)(params)
+        # The SAME placement fit uses (parallel.mesh.place_train_state):
+        # params in the model's (FSDP-augmented) layout, optimizer
+        # state placed explicitly in the matching shardings, step
+        # outputs pinned — the bench must measure the same program
+        # AND the same memory layout.
+        params, opt_state, state_shardings = place_train_state(
+            model, params, init_opt, mesh
+        )
     else:
         opt_state = init_opt(params)
+        state_shardings = None
+    if step_fn is None:
+        step_fn = make_train_step(
+            model.apply, tx, weight_decay=cfg.weight_decay, task=task,
+            state_shardings=state_shardings,
+        )
+    elif state_shardings is not None:
+        # Sparse path on a mesh: rebuild with the output pin, exactly
+        # like fit does.
+        _, step_fn = make_sparse_recsys_step(
+            model, base, cfg.learning_rate, task=task,
+            weight_decay=cfg.weight_decay,
+            state_shardings=state_shardings,
+        )
+
+    # Per-device state bytes, BEFORE the first step donates the
+    # buffers. This is the FSDP headline number: (1, 8, 1) must report
+    # ~1/8th the replicated (8, 1, 1) bytes for every leaf above the
+    # sharding threshold.
+    param_bytes_per_device = bytes_per_device(params)
+    opt_bytes_per_device = bytes_per_device(opt_state)
 
     # One fixed batch, reused: this measures the step program, not the
     # host data pipeline (which fit's (seed, step)-keyed batching does
@@ -232,8 +291,10 @@ def bench_train(
         "backend": jax.default_backend(),
         "device_kind": getattr(dev, "device_kind", "cpu"),
         "devices": n_dev,
-        "mesh": list(cfg.mesh_shape) if mesh is not None else None,
+        "mesh": list(bench_mesh_shape) if mesh is not None else None,
         "batch_size": int(bs),
+        "param_bytes_per_device": int(param_bytes_per_device),
+        "opt_bytes_per_device": int(opt_bytes_per_device),
         "step_ms": round(step_s * 1e3, 3),
         "examples_per_s": round(bs / step_s, 1),
         "flops_per_step": flops,

@@ -49,12 +49,22 @@ def make_train_step(
     teacher: tuple | None = None,
     distill_temperature: float = 2.0,
     distill_alpha: float = 0.5,
+    state_shardings: tuple | None = None,
 ) -> Callable:
     """Build a jit-compiled SGD step ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
 
     ``params`` and ``opt_state`` are donated — the optimizer update
     happens in-place in device memory, no copies.
+
+    ``state_shardings=(param_shardings, opt_shardings)`` (sharding
+    pytrees mirroring the two state args) pins the step's OUTPUT
+    layouts to them. Without the pin GSPMD is free to re-shard the
+    updated state (measured on the FSDP mesh: a replicated bias came
+    back fsdp-sharded), which both breaks donation aliasing and makes
+    the next call recompile against the drifted input layout. Meshed
+    training passes the placed state's own shardings; single-device
+    callers leave it None.
 
     ``task`` selects the objective: ``"classify"`` (softmax CE against
     ``y`` class ids) or ``"lm"`` (next-token CE — ``y`` is the same
@@ -158,7 +168,19 @@ def make_train_step(
 
         return checked_step
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
+    out_shardings = None
+    if state_shardings is not None:
+        p_sh, o_sh = state_shardings
+        mesh_of = next(
+            s for s in jax.tree.leaves(p_sh)
+            if hasattr(s, "mesh")
+        ).mesh
+        scalar = jax.sharding.NamedSharding(
+            mesh_of, jax.sharding.PartitionSpec()
+        )
+        out_shardings = (p_sh, o_sh, scalar)
+
+    jitted = jax.jit(step, donate_argnums=(0, 1), out_shardings=out_shardings)
 
     def run_step(params, opt_state, x, y):
         # Teacher params ride as an ordinary (undonated) argument —
@@ -474,13 +496,20 @@ def fit(
             tx = optax.masked(tx, model.trainable_mask(params))
 
     init_opt = sparse_init if sparse_embed else tx.init
+    state_shardings = None
     if mesh is not None:
         # Model-declared layout (e.g. Wide&Deep's sharded embedding
-        # tables) or fully replicated. Optimizer state initialised
-        # *under jit from placed params*, so its leaves inherit the
-        # same shardings (adam moments shard like their params).
-        params = params_for_model(model, params, mesh)
-        opt_state = jax.jit(init_opt)(params)
+        # tables), augmented with ZeRO-style ``fsdp``-axis sharding
+        # when the mesh has one, or fully replicated. The optimizer
+        # state is placed EXPLICITLY in the matching layout — jit-
+        # initialising from placed params does not inherit their
+        # shardings, see parallel.mesh.place_train_state (the one
+        # shared implementation).
+        from mlapi_tpu.parallel import place_train_state
+
+        params, opt_state, state_shardings = place_train_state(
+            model, params, init_opt, mesh
+        )
     else:
         opt_state = init_opt(params)
 
@@ -540,12 +569,23 @@ def fit(
 
     if sparse_embed:
         step_fn = sparse_step
+        if state_shardings is not None:
+            # Rebuild with the placed state's shardings pinned on the
+            # step outputs (the build above ran before placement and
+            # exists for its loud validation errors; jit is lazy, so
+            # only this step ever compiles).
+            _, step_fn = make_sparse_recsys_step(
+                model, base, learning_rate, task=task,
+                weight_decay=weight_decay,
+                state_shardings=state_shardings,
+            )
     else:
         step_fn = make_train_step(
             model.apply, tx, weight_decay=weight_decay,
             debug_checks=debug_checks, task=task, teacher=teacher,
             distill_temperature=distill_temperature,
             distill_alpha=distill_alpha,
+            state_shardings=state_shardings,
         )
 
     def eval_fn(p):

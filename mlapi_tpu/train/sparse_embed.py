@@ -98,6 +98,7 @@ def make_sparse_recsys_step(
     weight_decay: float = 0.0,
     eps: float = 1e-10,
     initial_accumulator_value: float = 0.1,
+    state_shardings: tuple | None = None,
 ):
     """Build ``(init_state, step)`` for a model implementing the
     sparse-embedding protocol (``split_embeddings`` /
@@ -105,7 +106,11 @@ def make_sparse_recsys_step(
 
     ``step(params, opt_state, x, y) -> (params, opt_state, loss)``
     with params/opt_state donated, exactly like
-    ``loop.make_train_step``'s contract.
+    ``loop.make_train_step``'s contract — including its
+    ``state_shardings=(param_shardings, opt_shardings)`` output pin:
+    on a mesh, unpinned outputs let GSPMD re-shard the updated state,
+    which breaks donation aliasing and recompiles every subsequent
+    step against the drifted layout.
     """
     if task != "classify":
         raise ValueError(
@@ -172,5 +177,16 @@ def make_sparse_recsys_step(
             loss,
         )
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
+    out_shardings = None
+    if state_shardings is not None:
+        p_sh, o_sh = state_shardings
+        mesh_of = next(
+            s for s in jax.tree.leaves(p_sh) if hasattr(s, "mesh")
+        ).mesh
+        scalar = jax.sharding.NamedSharding(
+            mesh_of, jax.sharding.PartitionSpec()
+        )
+        out_shardings = (p_sh, o_sh, scalar)
+
+    jitted = jax.jit(step, donate_argnums=(0, 1), out_shardings=out_shardings)
     return init_state, jitted

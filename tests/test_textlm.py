@@ -36,6 +36,90 @@ def test_corpus_shapes_and_provenance(corpus):
     assert len(corpus.x_test) >= 1
 
 
+def test_provenance_requires_exact_manifest_coverage(tmp_path):
+    """A frozen@ claim must imply "the four known files hashed clean"
+    (ADVICE r05 #1): a directory with a FOREIGN or vacuous
+    MANIFEST.json (no 'files', extra files, missing files) is a user
+    corpus and reports live:<path>; only hash corruption of a real
+    snapshot raises."""
+    import hashlib
+    import json
+
+    from mlapi_tpu.datasets._corpus import DOC_SOURCES, corpus_provenance
+
+    # No manifest at all -> live.
+    assert corpus_provenance(tmp_path) == f"live:{tmp_path}"
+
+    # Empty / foreign manifest used to pass the hash loop vacuously
+    # and report frozen@? — must be live now.
+    (tmp_path / "MANIFEST.json").write_text(json.dumps({"files": {}}))
+    assert corpus_provenance(tmp_path) == f"live:{tmp_path}"
+    (tmp_path / "MANIFEST.json").write_text(
+        json.dumps({"files": {"OTHER.md": {"sha256": "0" * 64}}})
+    )
+    assert corpus_provenance(tmp_path) == f"live:{tmp_path}"
+
+    # Exact coverage with verifying hashes -> frozen@commit.
+    files = {}
+    for rel in DOC_SOURCES:
+        from pathlib import Path
+
+        name = Path(rel).name
+        (tmp_path / name).write_text(f"content of {name}\n")
+        files[name] = {
+            "sha256": hashlib.sha256(
+                (tmp_path / name).read_bytes()
+            ).hexdigest()
+        }
+    (tmp_path / "MANIFEST.json").write_text(
+        json.dumps({"source_commit": "abc1234", "files": files})
+    )
+    assert corpus_provenance(tmp_path) == "frozen@abc1234"
+
+    # Superset coverage (one extra tracked file) -> live, not frozen.
+    extra = dict(files)
+    extra["EXTRA.md"] = {"sha256": "0" * 64}
+    (tmp_path / "MANIFEST.json").write_text(
+        json.dumps({"source_commit": "abc1234", "files": extra})
+    )
+    assert corpus_provenance(tmp_path) == f"live:{tmp_path}"
+
+    # Exact coverage + corrupted bytes -> raises, never a quiet label.
+    (tmp_path / "MANIFEST.json").write_text(
+        json.dumps({"source_commit": "abc1234", "files": files})
+    )
+    (tmp_path / "README.md").write_text("tampered\n")
+    with pytest.raises(ValueError, match="corrupted"):
+        corpus_provenance(tmp_path)
+
+    # The shipped snapshot still verifies end to end.
+    from mlapi_tpu.datasets._corpus import frozen_corpus
+
+    assert corpus_provenance(frozen_corpus()).startswith("frozen@")
+
+
+def test_live_mode_sweeps_docs_markdown(tmp_path, monkeypatch):
+    """docs_text's live mode follows the repo docs as they grow: any
+    docs/*.md beyond DOC_SOURCES joins the corpus (the pre-unification
+    glob, restored per ADVICE r05 #2); frozen/user-dir modes stay
+    pinned to DOC_SOURCES."""
+    import mlapi_tpu.datasets._corpus as _corpus
+
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "README.md").write_text("readme prose " * 50)
+    (tmp_path / "SURVEY.md").write_text("survey prose " * 50)
+    (tmp_path / "BASELINE.md").write_text("baseline prose " * 50)
+    (tmp_path / "docs" / "DESIGN.md").write_text("design prose " * 50)
+    (tmp_path / "docs" / "NEWDOC.md").write_text("NEWDOC prose " * 200)
+    monkeypatch.setattr(_corpus, "repo_root", lambda: tmp_path)
+
+    live = get_dataset("docs_text", seq_len=32, root="live")
+    pinned = get_dataset("docs_text", seq_len=32, root=str(tmp_path))
+    # The extra doc makes the live stream strictly longer.
+    assert len(live.x_train) > len(pinned.x_train)
+    assert live.extras["corpus"] == f"live:{tmp_path}"
+
+
 def test_train_test_windows_do_not_overlap():
     d = get_dataset("docs_text", seq_len=64, stride=32)
     # Tail split with stride guard: no train window may reach into
