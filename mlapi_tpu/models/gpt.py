@@ -64,8 +64,18 @@ class GptLM:
     # half-block units per ring step on every device — ~2x wall time).
     ring_block_impl: str = "einsum"
     ring_zigzag: bool = False
+    # KV-cache storage format: "none" keeps the compute dtype;
+    # "int8" stores symmetric per-token-per-head int8 payload + f32
+    # scales (ops/quant.py) — ~2x less decode HBM per cached token,
+    # ~2x the serving cache budget per chip. A dataclass field (not a
+    # method argument) so every lru_cache'd program factory
+    # (prefill_fn, decode_chunk_fn, generate_tier_fn, ...) keys on the
+    # cache format for free.
+    kv_quant: str = "none"
 
     def __post_init__(self):
+        from mlapi_tpu.ops.quant import KV_FORMATS
+
         if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.attention_impl == "ring" and self.mesh is None:
@@ -74,6 +84,10 @@ class GptLM:
             raise ValueError('ring_zigzag needs ring_block_impl="flash"')
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide evenly into heads")
+        if self.kv_quant not in KV_FORMATS:
+            raise ValueError(
+                f"unknown kv_quant {self.kv_quant!r}; one of {KV_FORMATS}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -180,14 +194,17 @@ class GptLM:
 
     # ------------------------------------------------------------------
     def init_cache(self, batch: int, max_len: int) -> dict:
-        """Fixed-shape KV cache: ``[B, max_len, H, D]`` per layer."""
-        nh, hd = self.num_heads, self.head_dim
+        """Fixed-shape KV cache: ``[B, max_len, H, D]`` per layer in
+        the compute dtype, or the int8 payload+scale layout under
+        ``kv_quant="int8"`` (see ``ops/quant.init_kv_cache``)."""
+        from mlapi_tpu.ops.quant import init_kv_cache
+
         cdt = jnp.dtype(self.compute_dtype)
         return {
-            f"layer_{n}": {
-                "k": jnp.zeros((batch, max_len, nh, hd), cdt),
-                "v": jnp.zeros((batch, max_len, nh, hd), cdt),
-            }
+            f"layer_{n}": init_kv_cache(
+                batch, max_len, self.num_heads, self.head_dim, cdt,
+                self.kv_quant,
+            )
             for n in range(self.num_layers)
         }
 
@@ -202,6 +219,7 @@ class GptLM:
         cdt = jnp.dtype(self.compute_dtype)
 
         from mlapi_tpu.ops import full_attention
+        from mlapi_tpu.ops.quant import kv_cache_append
 
         pos_idx = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
         x = params["wte"][prompt_ids] + params["wpe"][pos_idx]
@@ -215,16 +233,13 @@ class GptLM:
                 return full_attention(q, k, v, mask=mask, causal=True)
 
             x = self._block(layer, x, attend)
-            cache[f"layer_{n}"] = {
-                "k": jax.lax.dynamic_update_slice(
-                    cache[f"layer_{n}"]["k"], kv_seen["k"].astype(cdt),
-                    (0, 0, 0, 0),
-                ),
-                "v": jax.lax.dynamic_update_slice(
-                    cache[f"layer_{n}"]["v"], kv_seen["v"].astype(cdt),
-                    (0, 0, 0, 0),
-                ),
-            }
+            # The prompt block attends full-precision in-register
+            # (kv_seen); only the STORED cache is quantized — the
+            # append fuses the quantize into this write (ops/quant).
+            cache[f"layer_{n}"] = kv_cache_append(
+                cache[f"layer_{n}"], kv_seen["k"], kv_seen["v"],
+                jnp.int32(0), cdt,
+            )
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
         last_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
             jnp.float32
@@ -248,10 +263,12 @@ class GptLM:
         region ahead of the per-row pads (see
         :func:`decode_valid_and_shift`).
         """
+        from mlapi_tpu.ops.quant import kv_cache_seq_len
+
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
         hd = self.head_dim
-        max_len = cache["layer_0"]["k"].shape[1]
+        max_len = kv_cache_seq_len(cache)
         if n_pad is None:
             n_pad = jnp.zeros((b,), jnp.int32)
 
@@ -295,10 +312,12 @@ class GptLM:
         (speculative-decoding verification), logits at EVERY block
         position ``[B, U, V]``.
         """
+        from mlapi_tpu.ops.quant import kv_cache_seq_len
+
         cdt = jnp.dtype(self.compute_dtype)
         b, u = token_ids.shape
         hd = self.head_dim
-        max_len = cache["layer_0"]["k"].shape[1]
+        max_len = kv_cache_seq_len(cache)
 
         posq, mask = extend_positions_and_mask(
             max_len, u, pos0, n_pad, prefix_len, prefix_lo
@@ -604,25 +623,19 @@ def cached_attend(
     batched speculation needs, where per-row acceptance lengths
     desynchronize row positions. Scalar callers compile the exact
     HLO they always did.
+
+    Both cache formats route through here: the write goes through
+    ``ops.quant.kv_cache_append`` (quantize fused into the append for
+    int8 layers) and the read through ``kv_cache_kv`` (dequantize
+    fused into the einsum operand read) — int8 is what crosses HBM,
+    in both directions.
     """
     from mlapi_tpu.ops.attention import NEG
+    from mlapi_tpu.ops.quant import kv_cache_append, kv_cache_kv
 
     expand = expand or (lambda t: t)
-    if jnp.ndim(pos):
-        row_write = jax.vmap(
-            lambda c, n, p: jax.lax.dynamic_update_slice(
-                c, n, (p, 0, 0)
-            )
-        )
-        ck = row_write(cache_layer["k"], k_new.astype(cdt), pos)
-        cv = row_write(cache_layer["v"], v_new.astype(cdt), pos)
-    else:
-        ck = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k_new.astype(cdt), (0, pos, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v_new.astype(cdt), (0, pos, 0, 0)
-        )
+    new_layer = kv_cache_append(cache_layer, k_new, v_new, pos, cdt)
+    ck, cv = kv_cache_kv(new_layer, cdt)
     scores = (
         jnp.einsum(
             "bqhd,bkhd->bhqk", q, expand(ck),
@@ -636,7 +649,7 @@ def cached_attend(
         "bhqk,bkhd->bqhd", probs, expand(cv),
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
-    return ctx, {"k": ck, "v": cv}
+    return ctx, new_layer
 
 
 def _prefill_core(model, params, prompt_ids, n_pad, total_len: int):

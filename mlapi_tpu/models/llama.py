@@ -83,8 +83,18 @@ class LlamaLM:
     seq_axis: str = "seq"
     ring_block_impl: str = "einsum"
     ring_zigzag: bool = False
+    # KV-cache storage format — same contract as ``GptLM.kv_quant``
+    # ("none" | "int8"); composes with GQA (the int8 payload shrinks
+    # the ALREADY-grouped [B, L, KVH, D] cache a further ~2x).
+    kv_quant: str = "none"
 
     def __post_init__(self):
+        from mlapi_tpu.ops.quant import KV_FORMATS
+
+        if self.kv_quant not in KV_FORMATS:
+            raise ValueError(
+                f"unknown kv_quant {self.kv_quant!r}; one of {KV_FORMATS}"
+            )
         if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.attention_impl == "ring" and self.mesh is None:
@@ -220,13 +230,17 @@ class LlamaLM:
     # -- incremental decoding (shared engine contract) -----------------
     def init_cache(self, batch: int, max_len: int) -> dict:
         """``[B, max_len, KVH, D]`` per layer — GQA shrinks this by
-        ``num_heads / num_kv_heads`` vs the query-head count."""
+        ``num_heads / num_kv_heads`` vs the query-head count; under
+        ``kv_quant="int8"`` the layer holds int8 payload + f32 scales
+        instead (``ops/quant.init_kv_cache``)."""
+        from mlapi_tpu.ops.quant import init_kv_cache
+
         cdt = jnp.dtype(self.compute_dtype)
         return {
-            f"layer_{n}": {
-                "k": jnp.zeros((batch, max_len, self.kv_heads, self.head_dim), cdt),
-                "v": jnp.zeros((batch, max_len, self.kv_heads, self.head_dim), cdt),
-            }
+            f"layer_{n}": init_kv_cache(
+                batch, max_len, self.kv_heads, self.head_dim, cdt,
+                self.kv_quant,
+            )
             for n in range(self.num_layers)
         }
 
@@ -236,6 +250,7 @@ class LlamaLM:
         target of ``gpt._prefill_core`` (see that docstring for the
         padding/alignment contract)."""
         from mlapi_tpu.ops import full_attention
+        from mlapi_tpu.ops.quant import kv_cache_append
 
         b, p = prompt_ids.shape
         cache = self.init_cache(b, total_len)
@@ -256,16 +271,13 @@ class LlamaLM:
                 )
 
             x = self._block(layer, x, positions, attend)
-            cache[f"layer_{n}"] = {
-                "k": jax.lax.dynamic_update_slice(
-                    cache[f"layer_{n}"]["k"], kv_seen["k"].astype(cdt),
-                    (0, 0, 0, 0),
-                ),
-                "v": jax.lax.dynamic_update_slice(
-                    cache[f"layer_{n}"]["v"], kv_seen["v"].astype(cdt),
-                    (0, 0, 0, 0),
-                ),
-            }
+            # Rotated K / raw V quantize at the append, exactly like
+            # the GPT family (the prompt block itself attended
+            # full-precision above).
+            cache[f"layer_{n}"] = kv_cache_append(
+                cache[f"layer_{n}"], kv_seen["k"], kv_seen["v"],
+                jnp.int32(0), cdt,
+            )
         x = _rms_norm(x, params["rms_f_scale"])
         last_logits = x[:, -1].astype(jnp.float32) @ params["lm_head"].astype(
             jnp.float32
@@ -283,10 +295,11 @@ class LlamaLM:
         plugged in.
         """
         from mlapi_tpu.models.gpt import cached_attend, decode_valid_and_shift
+        from mlapi_tpu.ops.quant import kv_cache_seq_len
 
         cdt = jnp.dtype(self.compute_dtype)
         b = token_ids.shape[0]
-        max_len = cache["layer_0"]["k"].shape[1]
+        max_len = kv_cache_seq_len(cache)
         if n_pad is None:
             n_pad = jnp.zeros((b,), jnp.int32)
 
@@ -323,9 +336,10 @@ class LlamaLM:
         from mlapi_tpu.models.gpt import (
             cached_attend, extend_positions_and_mask,
         )
+        from mlapi_tpu.ops.quant import kv_cache_seq_len
 
         cdt = jnp.dtype(self.compute_dtype)
-        max_len = cache["layer_0"]["k"].shape[1]
+        max_len = kv_cache_seq_len(cache)
         posq, mask = extend_positions_and_mask(
             max_len, token_ids.shape[1], pos0, n_pad, prefix_len,
             prefix_lo,

@@ -10,6 +10,15 @@ model family needs to know: the wrapper satisfies the same protocol
 the engines and ``models/gpt.py``'s model-generic machinery consume,
 and it is hashable/frozen so the ``lru_cache``'d program factories key
 on it like any other model config.
+
+Composes with int8 KV-CACHE quantization orthogonally: the cache
+format is the INNER model's ``kv_quant`` field (forwarded by
+``__getattr__``), so ``QuantizedModel(replace(inner, kv_quant="int8"))``
+serves int8 weights AND an int8 cache — the engine's
+``--quantize int8 --kv-quant int8``. Weight dequantization happens in
+the wrapper's traced methods; cache quantize/dequantize happens inside
+the inner model's append/read seams (``ops/quant.kv_cache_append`` /
+``kv_cache_kv``). Neither knows about the other.
 """
 
 from __future__ import annotations

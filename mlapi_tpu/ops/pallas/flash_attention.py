@@ -744,7 +744,20 @@ def flash_attention(
     from it and accumulates dq (k-inner grid) and dk/dv (q-inner
     grid) — no ``[L, L]`` tensor in HBM in either pass.
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
+
+    Int8-KV boundary policy: quantized ``{"q", "scale"}`` K/V
+    operands dequantize AT THIS BOUNDARY (one fused convert+multiply
+    feeding the kernel's first tile load) — the kernel itself streams
+    full-precision tiles. The int8 cache exists for the DECODE read
+    path, which never routes through this kernel; an in-kernel int8
+    tile path (payload+scales DMA'd to VMEM, dequantized per tile à
+    la paged attention) is only worth building once decode itself
+    runs as a kernel. See ``ops/quant.maybe_dequant_kv``.
     """
+    from mlapi_tpu.ops.quant import maybe_dequant_kv
+
+    k = maybe_dequant_kv(k, q.dtype)
+    v = maybe_dequant_kv(v, q.dtype)
     mask, scale, block_q, block_k = _prepare(
         q, k, v, mask, causal, scale, block_q, block_k, window
     )
@@ -781,7 +794,13 @@ def flash_attention_with_lse(
     log-sum-exp ``[B, H, L]`` — the quantity that lets independently
     computed attention blocks be merged exactly (numerically safe
     weighted average). Used by ``ring_attention``'s flash block mode;
-    differentiable through BOTH outputs."""
+    differentiable through BOTH outputs. Same int8-KV boundary policy
+    as :func:`flash_attention`: quantized K/V pairs dequantize at
+    entry."""
+    from mlapi_tpu.ops.quant import maybe_dequant_kv
+
+    k = maybe_dequant_kv(k, q.dtype)
+    v = maybe_dequant_kv(v, q.dtype)
     mask, scale, block_q, block_k = _prepare(
         q, k, v, mask, causal, scale, block_q, block_k, window
     )

@@ -25,6 +25,8 @@ the generative/serving scale the reference never reaches.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,6 +76,232 @@ def dequantize_tree(params, dtype=jnp.float32):
         return x
 
     return jax.tree.map(leaf, params, is_leaf=_is_quant_leaf)
+
+
+# --- int8 KV-cache quantization ----------------------------------------
+#
+# Decode at generation scale is CACHE-bandwidth-bound, not just
+# weight-bound: every decoded token re-reads every layer's [B, L, H, D]
+# K and V from HBM, and past modest batch x context the cache bytes
+# dominate the weights. The same move that halved weight HBM applies:
+# store the cache as int8 with SYMMETRIC PER-TOKEN-PER-HEAD scales
+# (amax over the head_dim axis), quantize fused into the append path,
+# dequantize fused into the attention read — the full-precision cache
+# is never materialized in HBM. A quantized cache layer is
+# ``{"k_q": int8[B, L, H, D], "k_scale": f32[B, L, H, 1], "v_q": ...,
+# "v_scale": ...}`` (this repo's cache layout is [B, L, H, D]; the
+# scale keeps the reduced axis at length 1 so dequantization is one
+# broadcast multiply, exactly like the weight scheme above).
+#
+# Per-token-per-head granularity is the accuracy sweet spot for KV:
+# per-tensor scales are wrecked by attention-sink outlier tokens, while
+# finer-than-head granularity buys nothing the f32 softmax doesn't
+# already absorb. The f32 scale costs 4 bytes per (token, head) next
+# to D int8 payload bytes — <= 2x total reduction asymptotically in D.
+
+KV_FORMATS = ("none", "int8")
+
+
+def kv_is_quantized_layer(layer: dict) -> bool:
+    """Is this per-layer cache dict in the quantized format?"""
+    return "k_q" in layer
+
+
+def kv_quantize(x):
+    """``[..., D]`` float K or V block → ``(q int8[..., D],
+    scale f32[..., 1])``, symmetric per-token-per-head (amax over the
+    last axis). Runs inside the jitted append, so XLA fuses the
+    abs-max/divide/round into the cache write."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale, dtype):
+    """Traced inverse: int8 payload x broadcast scale → ``dtype``.
+    XLA fuses the convert+multiply into the consumer's operand read
+    (the decode einsum), so the expansion costs no extra HBM round
+    trip — int8 is what crosses the memory bus."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def kv_cache_append(layer: dict, k_new, v_new, pos, cdt) -> dict:
+    """Write a ``[B, U, H, D]`` K/V block into a fixed-shape cache
+    layer at slot ``pos`` — THE append seam both cache formats share
+    (every decoder family's prefill/decode/extend writes through it).
+
+    ``pos`` scalar: one fused slice-update writes every row at the
+    same slot (the serving layout). ``pos`` per-row ``[B]``: the write
+    vmaps over rows so each lands at its own slot (batched
+    speculation's desynchronized layout). For a quantized layer the
+    block is quantized first and the int8 payload + f32 scale written
+    by the same slice-updates — quantization is fused into the append,
+    and the full-precision block dies in registers.
+    """
+    if kv_is_quantized_layer(layer):
+        kq, ks = kv_quantize(k_new)
+        vq, vs = kv_quantize(v_new)
+        updates = {"k_q": kq, "k_scale": ks, "v_q": vq, "v_scale": vs}
+    else:
+        updates = {"k": k_new.astype(cdt), "v": v_new.astype(cdt)}
+
+    if jnp.ndim(pos):
+        row_write = jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (p,) + (0,) * (c.ndim - 1)
+            )
+        )
+        return {
+            name: row_write(layer[name], upd, pos)
+            for name, upd in updates.items()
+        }
+    return {
+        name: jax.lax.dynamic_update_slice(
+            layer[name], upd, (0, pos) + (0,) * (upd.ndim - 2)
+        )
+        for name, upd in updates.items()
+    }
+
+
+def kv_cache_kv(layer: dict, cdt):
+    """The attention-read seam: a cache layer → ``(k, v)`` in the
+    compute dtype. Quantized layers dequantize here, INSIDE the jitted
+    program, right at the einsum operand — see :func:`kv_dequantize`
+    for why this reads int8 from HBM, not floats."""
+    if kv_is_quantized_layer(layer):
+        return (
+            kv_dequantize(layer["k_q"], layer["k_scale"], cdt),
+            kv_dequantize(layer["v_q"], layer["v_scale"], cdt),
+        )
+    return layer["k"], layer["v"]
+
+
+def kv_cache_seq_len(cache: dict) -> int:
+    """Static sequence capacity of a cache pytree, either format."""
+    layer = cache["layer_0"]
+    leaf = layer["k_q"] if kv_is_quantized_layer(layer) else layer["k"]
+    return leaf.shape[1]
+
+
+def init_kv_cache(batch: int, max_len: int, heads: int, head_dim: int,
+                  cdt, kv_quant: str = "none") -> dict:
+    """One layer's fixed-shape KV buffers in the requested format —
+    the single definition of both cache layouts (each decoder family's
+    ``init_cache`` maps it over its layers)."""
+    if kv_quant == "int8":
+        return {
+            "k_q": jnp.zeros((batch, max_len, heads, head_dim), jnp.int8),
+            "k_scale": jnp.zeros((batch, max_len, heads, 1), jnp.float32),
+            "v_q": jnp.zeros((batch, max_len, heads, head_dim), jnp.int8),
+            "v_scale": jnp.zeros((batch, max_len, heads, 1), jnp.float32),
+        }
+    if kv_quant != "none":
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r}; expected one of {KV_FORMATS}"
+        )
+    return {
+        "k": jnp.zeros((batch, max_len, heads, head_dim), cdt),
+        "v": jnp.zeros((batch, max_len, heads, head_dim), cdt),
+    }
+
+
+@functools.lru_cache(maxsize=32)
+def _forced_argmax_fn(model, n_steps: int):
+    """Jitted teacher-forced decode: prefill the prompt, then feed a
+    FIXED token stream through ``decode_step`` and emit each step's
+    argmax — the per-step top-1 prediction of the model's cache
+    format, decoupled from error compounding (a free-running
+    comparison is meaningless past the first divergence)."""
+
+    def _run(params, prompt_ids, forced, n_pad):
+        p = prompt_ids.shape[1]
+        cache, _ = model.prefill_core(
+            params, prompt_ids, n_pad, p + n_steps + 1
+        )
+
+        def step(carry, tok):
+            cache, pos = carry
+            logits, cache = model.decode_step(
+                params, cache, tok[:, None], pos, n_pad
+            )
+            return (cache, pos + 1), jnp.argmax(
+                logits, axis=-1
+            ).astype(jnp.int32)
+
+        (_, _), outs = jax.lax.scan(
+            step, (cache, jnp.int32(p)), forced.T
+        )
+        return outs.T
+
+    return jax.jit(_run)
+
+
+def kv_greedy_agreement(model, params, prompt_ids, max_new_tokens: int,
+                        pad_lens=None) -> float:
+    """The decode-quality guard for int8 KV caches: greedy top-1
+    token agreement of the int8-cache decode vs the full-precision
+    cache, TEACHER-FORCED on the full-precision greedy stream.
+
+    The reference stream is the ``kv_quant="none"`` model's greedy
+    generation; both cache formats then replay that exact stream and
+    the per-step argmaxes are compared. The first token is excluded —
+    it comes from the prefill forward, which attends full-precision
+    in-register under BOTH formats and cannot disagree — so every
+    compared position actually read the quantized cache. ``model`` is
+    the base decoder config (any decoder family with the ``kv_quant``
+    field); returns the agreement fraction in ``[0, 1]``.
+    """
+    import dataclasses
+
+    if max_new_tokens < 2:
+        # Position 0 comes from the prefill forward and is excluded,
+        # so a 1-token window would compare nothing (NaN, not 1.0).
+        raise ValueError("kv_greedy_agreement needs max_new_tokens >= 2")
+    base = dataclasses.replace(model, kv_quant="none")
+    quant = dataclasses.replace(model, kv_quant="int8")
+    b, p = prompt_ids.shape
+    n_pad = (
+        jnp.zeros((b,), jnp.int32) if pad_lens is None
+        else jnp.asarray(pad_lens, jnp.int32)
+    )
+    ref = base.generate(
+        params, prompt_ids, max_new_tokens=max_new_tokens,
+        pad_lens=None if pad_lens is None else pad_lens,
+    )
+    forced = jnp.asarray(ref)[:, :-1]  # step t predicts ref[:, t+1]
+    got = _forced_argmax_fn(quant, max_new_tokens - 1)(
+        params, jnp.asarray(prompt_ids), forced, n_pad
+    )
+    return float(
+        np.mean(np.asarray(got) == np.asarray(ref)[:, 1:])
+    )
+
+
+def maybe_dequant_kv(x, dtype=None):
+    """Kernel-boundary policy for the full-sequence attention kernels
+    (Pallas flash, ring): they consume full-precision ``[B, L, H, D]``
+    tiles, so a quantized ``{"q", "scale"}`` K/V operand DEQUANTIZES
+    AT THE BOUNDARY — one fused convert+multiply feeding the kernel's
+    first tile load — rather than teaching every kernel an int8 tile
+    path. This is deliberate: the quantized cache exists for the
+    DECODE read path (``kv_cache_kv``), which never routes through
+    these kernels (they serve full-sequence training/scoring, where
+    there is no cache); in-kernel int8 tiles (the paged-attention
+    trick of DMA-ing payload+scales into VMEM and dequantizing per
+    tile) only pay once decode itself runs as a kernel. Anything that
+    is neither an array nor a quant pair is rejected loudly."""
+    if isinstance(x, dict):
+        if _is_quant_leaf(x):
+            return kv_dequantize(
+                x["q"], x["scale"], dtype or x["scale"].dtype
+            )
+        raise TypeError(
+            "attention kernels take arrays or {'q', 'scale'} quantized "
+            f"pairs, got dict with keys {sorted(x)}"
+        )
+    return x
 
 
 def is_quantized(params) -> bool:

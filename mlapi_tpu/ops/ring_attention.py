@@ -83,7 +83,19 @@ def ring_attention(
     flash kernel on its local block and the per-block (out, lse)
     pairs are merged exactly (SP × kernel composition). Both are
     differentiable (the flash VJP carries lse cotangents).
+
+    Int8-KV boundary policy: a quantized ``{"q", "scale"}`` K/V
+    operand dequantizes HERE, at the ring entry, before the blocks
+    start rotating — the ppermute'd K/V blocks and the online-softmax
+    state stay full-precision (rotating payload+scale pairs and
+    dequantizing per ring step would re-do the multiply axis_size
+    times for zero HBM savings: the blocks live on-device either
+    way). See ``ops/quant.maybe_dequant_kv`` for the full rationale.
     """
+    from mlapi_tpu.ops.quant import maybe_dequant_kv
+
+    k = maybe_dequant_kv(k, q.dtype)
+    v = maybe_dequant_kv(v, q.dtype)
     if zigzag:
         if not (causal and block_impl == "flash"):
             raise ValueError(
@@ -420,7 +432,15 @@ def ring_self_attention(
     (~2x wall-time win over the plain layout; see :func:`zigzag_perm`).
     The permutation is one gather before ``shard_map`` and its
     inverse after; callers see plain global order.
+
+    Quantized ``{"q", "scale"}`` K/V operands dequantize at THIS
+    boundary, before the shard_map (specs and the ring payload are
+    full-precision arrays — see :func:`ring_attention`).
     """
+    from mlapi_tpu.ops.quant import maybe_dequant_kv
+
+    k = maybe_dequant_kv(k, q.dtype)
+    v = maybe_dequant_kv(v, q.dtype)
     n = mesh.shape[seq_axis]
     if q.shape[1] % n:
         raise ValueError(

@@ -151,6 +151,8 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--max-batch", str(args.max_batch)]
     if getattr(args, "quantize", None):
         cmd += ["--quantize", args.quantize]
+    if getattr(args, "kv_quant", None):
+        cmd += ["--kv-quant", args.kv_quant]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -252,6 +254,16 @@ def main(argv=None) -> None:
              "(single-chip serving only)",
     )
     parser.add_argument(
+        "--kv-quant", choices=["int8"], default=None,
+        help="store decode KV caches as int8 payload + per-token-"
+             "per-head f32 scales: ~2x less decode HBM per cached "
+             "token, ~2x the cache/prefix/slot budget; quantize "
+             "fused into the append, dequantize into the attention "
+             "read. Generative checkpoints only; composes with "
+             "--quantize and --mesh-shape (the draft's cache rides "
+             "the same format)",
+    )
+    parser.add_argument(
         "--draft-checkpoint", default=None,
         help="speculative decoding: a smaller same-tokenizer "
              "checkpoint whose proposals the target verifies in one "
@@ -320,6 +332,20 @@ def main(argv=None) -> None:
                          "(every worker binds the same one)")
         sys.exit(_supervise_workers(args.workers, ckpt, args))
 
+    # Multi-host bootstrap, parity with train/__main__:47 (a no-op on
+    # a plain single host): a multi-host serving deployment exports
+    # the same MLAPI_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID trio and
+    # every process joins the rendezvous BEFORE touching devices —
+    # jax.devices() below then spans the pod, so --mesh-shape can name
+    # a global mesh. NOT in --workers children: the SO_REUSEPORT pool
+    # is single-host CPU scale-out and every child inherits the SAME
+    # PROCESS_ID — N workers claiming one rendezvous slot would wedge
+    # the pool (a worker is a replica, not a pod rank).
+    if not is_worker:
+        from mlapi_tpu.parallel import initialize_from_env
+
+        initialize_from_env()
+
     mesh = None
     if args.mesh_shape:
         import math
@@ -353,6 +379,7 @@ def main(argv=None) -> None:
         mesh = create_mesh(shape, devices=devices[:need])
     engine = InferenceEngine.from_checkpoint(
         ckpt, quantize=args.quantize,
+        kv_quant=args.kv_quant,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,

@@ -611,6 +611,12 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
+            # Deterministic per-slot KV bytes at the default
+            # bucket/tier (addressable_shards nbytes) — the committed
+            # int8-KV number; kv_quant itself rides /healthz meta.
+            snap["gauges"]["generate.kv_cache_bytes_per_slot"] = (
+                engine.kv_cache_slot_bytes()
+            )
         return snap
 
     return app

@@ -44,6 +44,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "requires_tpu: needs real TPU hardware; skipped on CPU"
     )
+    config.addinivalue_line(
+        "markers",
+        "heavy: in-suite model training or soak-style test (tens of "
+        "seconds each on this box). The fast dev profile deselects "
+        "them: pytest -m 'not heavy' (~8 min serial vs ~10.5 full — "
+        "measured times in README). CI and tier-1 run the full suite.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -100,6 +100,7 @@ def test_tp_sharded_forward(tiny_bert, mesh_2x4):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_bert_learns_synthetic_sst2():
     sst2 = get_dataset(
         "sst2", max_len=32, vocab_size=512, n_train=4096, n_test=512

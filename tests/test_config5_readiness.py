@@ -76,6 +76,7 @@ def test_from_hf_cli_initialises_from_torch_weights(tmp_path, monkeypatch):
     assert meta.config["tokenizer"]["kind"] == "wordpiece"
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_docs_clf_is_real_and_learnable():
     """The config-5 local proxy: real repo prose, real labels, and a
     tiny BERT must beat chance decisively on the held-out tail."""

@@ -64,6 +64,7 @@ def _mean_kl(teacher, tp, student, sp, x):
     return float(np.mean(np.sum(np.exp(tl) * (tl - sl), axis=-1)))
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_distilled_student_matches_teacher_better(teacher_checkpoint):
     from mlapi_tpu.checkpoint import load_checkpoint
 
@@ -88,6 +89,7 @@ def test_distilled_student_matches_teacher_better(teacher_checkpoint):
     assert np.isfinite(dist.final_loss)
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_distill_resume_config_guard(teacher_checkpoint, tmp_path):
     """A distilled run's train-state records the teacher; resuming the
     same run works, and the recorded config carries the distillation
@@ -113,6 +115,7 @@ def test_distill_resume_config_guard(teacher_checkpoint, tmp_path):
     assert r.steps == 60
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_distill_cli_flag(tmp_path, monkeypatch):
     """--distill-from plumbs through the train CLI (teacher and
     student must share a vocab, so train a 3-step docs-gpt teacher)."""

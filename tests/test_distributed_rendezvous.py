@@ -1,0 +1,117 @@
+"""The first REAL multi-host rendezvous test: two CPU processes join
+``jax.distributed`` through the env-driven bootstrap
+(``parallel/distributed.py::initialize_from_env`` — the code path
+every entry point calls but CI never executed until now), agree on
+``process_count() == 2``, and run one tiny cross-process collective.
+
+Everything before this exercised multi-DEVICE behaviour on one
+process (the 8 virtual CPU devices); this is the multi-PROCESS story:
+a coordinator address, two ranks, a real barrier at
+``jax.distributed.initialize``, and a gloo-backed ``process_allgather``
+whose result proves bytes actually crossed the process boundary.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    # gloo is the CPU cross-process collectives backend; set before
+    # any jax device/backend touch.
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        gloo = True
+    except Exception:
+        gloo = False
+
+    from mlapi_tpu.parallel import initialize_from_env
+
+    ok = initialize_from_env()
+    import numpy as np
+
+    out = {
+        "rank": int(os.environ["MLAPI_TPU_PROCESS_ID"]),
+        "initialized": bool(ok),
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "gloo": gloo,
+    }
+    if gloo:
+        # One tiny collective: every process contributes rank + 1 and
+        # must see BOTH contributions — data crossed processes.
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(
+            np.asarray([out["rank"] + 1], np.int32)
+        )
+        out["allgather"] = np.asarray(g).ravel().tolist()
+    print("RESULT " + json.dumps(out), flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_and_collective(tmp_path):
+    port = _free_port()
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            MLAPI_TPU_COORDINATOR=f"127.0.0.1:{port}",
+            MLAPI_TPU_NUM_PROCESSES="2",
+            MLAPI_TPU_PROCESS_ID=str(rank),
+        )
+        # One real CPU device per process: the point is processES, and
+        # the virtual-device flag would only blur the device counts.
+        env.pop("XLA_FLAGS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env, cwd=ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, f"rank failed:\n{err[-2000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, out
+        r = json.loads(line[-1][len("RESULT "):])
+        results[r["rank"]] = r
+
+    assert set(results) == {0, 1}
+    for r in results.values():
+        assert r["initialized"] is True
+        # The rendezvous really formed: both ranks see both processes
+        # and the union of their devices.
+        assert r["process_count"] == 2, r
+        assert r["device_count"] == 2 * r["local_device_count"], r
+    # The collective: each rank gathered BOTH contributions (1 and 2),
+    # in rank order — bytes crossed the process boundary, not just the
+    # coordination handshake. (gloo ships with this jax build; if a
+    # future build drops it, the rendezvous asserts above still hold
+    # and this block self-skips.)
+    for r in results.values():
+        if r["gloo"]:
+            assert r["allgather"] == [1, 2], r
+    assert any(r["gloo"] for r in results.values()), (
+        "no CPU collectives backend available — collective never ran"
+    )

@@ -90,6 +90,7 @@ def test_generate_rejects_overflow(model, params):
         )
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_learns_induction_copy_task(model):
     """Train on sequences where token t+1 = token t (constant-run
     sequences): a causal LM must drive loss near zero; a broken mask

@@ -114,6 +114,7 @@ def test_rejects_indivisible_kv_heads():
         )
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_serving_engine_round_trip(model, params, tmp_path):
     """Checkpoint -> TextGenerationEngine -> batched decode: the
     shared GPT machinery must drive this family unchanged."""

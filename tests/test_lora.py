@@ -168,6 +168,7 @@ def test_fit_integration_freezes_base():
         np.testing.assert_array_equal(np.asarray(p_new), p_old)
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_cli_lora_finetunes_from_pretrained_base(tmp_path):
     """--init-from + --lora-rank: the frozen base really is the
     pretrained checkpoint (not a fresh init), and the exported merged

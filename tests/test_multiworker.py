@@ -82,6 +82,7 @@ def _post(port: int, path: str, body: dict, timeout: float = 5.0) -> dict:
         return json.loads(r.read())
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_two_workers_share_one_port(iris_checkpoint):
     port = _free_port()
     env = dict(

@@ -135,6 +135,7 @@ def test_debug_checks_catches_nan(tmp_path):
         fit(model, PoisonedSplits(), steps=5, debug_checks=True)
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_cli_survives_sigkill_and_resumes(tmp_path):
     """Crash-consistency end to end through the CLI: SIGKILL the
     training process mid-run, rerun the same command, and the run

@@ -90,6 +90,7 @@ def test_draft_equals_target_accepts_everything():
     assert stats.tokens_per_round == 4.0  # k+1 every round
 
 
+@pytest.mark.heavy  # in-suite training/soak — fast profile: -m 'not heavy'
 def test_trained_draft_accepts_on_domain():
     """A small draft trained on the same pattern as the target
     accepts a meaningful fraction — the speedup story, measured."""
