@@ -417,6 +417,78 @@ print(json.dumps({{
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _decode_report(ck: str, env: dict) -> dict:
+    """Subprocess (this harness never initialises jax in-process):
+    einsum vs flash decode at the default bucket/tier, BOTH cache
+    formats, measured INTERLEAVED within one window (the only
+    comparison the ±30% cross-day variance bound allows) — plus each
+    config's modeled decode bytes/step, which is exact dtype
+    arithmetic and compares across days. The byte claim this block
+    exists to publish: int8 + flash is the only cell whose per-step
+    attention read drops ~2x; int8 + einsum stores small but READS
+    big (dequant materializes at the read seam)."""
+    src = f"""
+import json, time
+import dataclasses
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+N = 32
+prompts = ["the quick brown fox", "decode reads the cache"]
+engs = {{}}
+for impl in ("einsum", "flash"):
+    for fmt in ("none", "int8"):
+        m = dataclasses.replace(model, kv_quant=fmt,
+                                decode_attn_impl=impl)
+        engs[impl + "/" + fmt] = TextGenerationEngine(
+            m, params, tokenizer=tok, chunk=8, fused_single=False)
+for eng in engs.values():  # compile off the clock
+    for p in prompts:
+        eng.generate_text(p, max_new_tokens=N)
+toks = {{k: 0 for k in engs}}
+secs = {{k: 0.0 for k in engs}}
+for _ in range(3):  # interleaved rounds: each config visits each
+    for key, eng in engs.items():  # prompt inside the same window
+        for p in prompts:
+            t0 = time.perf_counter()
+            out = eng.generate_text(p, max_new_tokens=N)
+            secs[key] += time.perf_counter() - t0
+            toks[key] += len(out["token_ids"])
+streams = {{k: engs[k].generate_text(prompts[0], max_new_tokens=N)
+           ["token_ids"] for k in engs}}
+assert streams["flash/none"] == streams["einsum/none"]
+assert streams["flash/int8"] == streams["einsum/int8"]
+report = {{}}
+for key, eng in engs.items():
+    report[key.replace("/", "_") + "_tokens_per_s"] = round(
+        toks[key] / secs[key], 1)
+    report[key.replace("/", "_") + "_decode_bytes_per_step"] = (
+        eng.decode_bytes_per_step())
+report["flash_read_bytes_ratio_none_over_int8"] = round(
+    report["flash_none_decode_bytes_per_step"]
+    / report["flash_int8_decode_bytes_per_step"], 3)
+report["streams_cross_impl_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"decode_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_generate() -> None:
     """/generate throughput: single-stream vs concurrency-8 batched
     decode through the full HTTP stack (r1 criterion: batched decode
@@ -538,6 +610,12 @@ def bench_generate() -> None:
             # byte counts and agreements are exact where this box's
             # wall-clock drifts (see VARIANCE_NOTE).
             kv_extras.update(_kv_quant_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_DECODE") == "1":
+            # einsum vs flash decode, both cache formats, interleaved
+            # in one window + modeled bytes/step per config (exact
+            # dtype arithmetic; the int8 READ saving is a byte claim,
+            # not a wall-clock claim, on this CPU-attach box).
+            kv_extras.update(_decode_report(ck, server_env))
         prefix_extras = {}
         if os.environ.get("BENCH_GEN_PREFIX") == "1":
             # Prefix-caching TTFT: the same effective prompt served

@@ -72,6 +72,15 @@ class GptLM:
     # (prefill_fn, decode_chunk_fn, generate_tier_fn, ...) keys on the
     # cache format for free.
     kv_quant: str = "none"
+    # Decode-step attention: "einsum" (the reference oracle — one
+    # [B,1,H,D] x [B,L,H,D] einsum over the dequantized cache) or
+    # "flash" (the Pallas split-K flash-decode kernel,
+    # ops/pallas/decode_attention.py, which reads int8 cache tiles
+    # in-kernel — the 2x HBM saving reaches the READ, not just
+    # storage). A MODEL field like kv_quant, so every cached program
+    # factory keys on the decode impl for free. Single-token decode
+    # steps only; block extends (extend_core) stay einsum.
+    decode_attn_impl: str = "einsum"
 
     def __post_init__(self):
         from mlapi_tpu.ops.quant import KV_FORMATS
@@ -87,6 +96,11 @@ class GptLM:
         if self.kv_quant not in KV_FORMATS:
             raise ValueError(
                 f"unknown kv_quant {self.kv_quant!r}; one of {KV_FORMATS}"
+            )
+        if self.decode_attn_impl not in ("einsum", "flash"):
+            raise ValueError(
+                f"unknown decode_attn_impl {self.decode_attn_impl!r}; "
+                'one of ("einsum", "flash")'
             )
 
     @property
@@ -285,7 +299,7 @@ class GptLM:
             def attend(q, k_new, v_new, *, _n=n):
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
-                    cdt, hd,
+                    cdt, hd, impl=self.decode_attn_impl,
                 )
                 return out
 
@@ -609,7 +623,8 @@ def extend_positions_and_mask(max_len, u, pos0, n_pad, prefix_len=None,
 
 
 def cached_attend(
-    cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None
+    cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None,
+    impl: str = "einsum",
 ):
     """One decode-time attention over a fixed-shape KV cache, shared
     by every decoder family: write the new K/V at ``pos``, attend the
@@ -624,17 +639,49 @@ def cached_attend(
     desynchronize row positions. Scalar callers compile the exact
     HLO they always did.
 
-    Both cache formats route through here: the write goes through
-    ``ops.quant.kv_cache_append`` (quantize fused into the append for
-    int8 layers) and the read through ``kv_cache_kv`` (dequantize
-    fused into the einsum operand read) — int8 is what crosses HBM,
-    in both directions.
+    Both cache formats route through here. The write always goes
+    through ``ops.quant.kv_cache_append`` (quantize fused into the
+    append for int8 layers). The READ depends on ``impl``:
+
+    - ``"einsum"`` (default, the reference oracle): ``kv_cache_kv``
+      dequantizes at the read seam and a ``[B,1,H,D] x [B,L,H,D]``
+      einsum attends — the full-precision operand materializes
+      between the dequant and the einsum, so the int8 format saves
+      storage but not read traffic.
+    - ``"flash"``: single-token queries route to the Pallas split-K
+      flash-decode kernel (``ops/pallas/decode_attention``), which
+      reads the STORED tiles — int8 payload + scales dequantized per
+      tile in registers — so int8 is what crosses HBM on the read.
+      Multi-token blocks (``extend_core``) keep the einsum path
+      (block prefill is MXU-bound; the kernel is a decode
+      bandwidth lever).
     """
     from mlapi_tpu.ops.attention import NEG
-    from mlapi_tpu.ops.quant import kv_cache_append, kv_cache_kv
+    from mlapi_tpu.ops.quant import (
+        kv_cache_append, kv_cache_kv, kv_is_quantized_layer,
+    )
 
     expand = expand or (lambda t: t)
     new_layer = kv_cache_append(cache_layer, k_new, v_new, pos, cdt)
+    if impl == "flash" and q.shape[1] == 1:
+        from mlapi_tpu.ops.pallas import decode_attention
+
+        if kv_is_quantized_layer(new_layer):
+            k = {"q": new_layer["k_q"], "scale": new_layer["k_scale"]}
+            v = {"q": new_layer["v_q"], "scale": new_layer["v_scale"]}
+        else:
+            k, v = new_layer["k"], new_layer["v"]
+        ctx = decode_attention(
+            q, k, v, valid[:, 0, 0, :].astype(jnp.float32),
+            scale=1.0 / head_dim**0.5,
+            # Interpret ONLY on CPU (the CI backend). On TPU the
+            # compiled kernel runs; any other accelerator attempts a
+            # real lowering and fails loudly — silently interpreting
+            # every decode step there would be orders slower than the
+            # einsum path this kernel exists to beat.
+            interpret=jax.default_backend() == "cpu",
+        )
+        return ctx, new_layer
     ck, cv = kv_cache_kv(new_layer, cdt)
     scores = (
         jnp.einsum(

@@ -50,15 +50,33 @@ def _rope(x, positions, theta: float):
     ``positions``: ``[B, L]`` int32 effective positions (already
     n_pad-shifted and clamped by callers). rotate-half convention:
     pairs are (x[..., :D/2], x[..., D/2:]).
+
+    Written as ``x * cos + rotate_half(x) * sin`` over the FULL lane
+    dim, with ``rotate_half`` a constant-index gather — deliberately
+    NOT the textbook slice-halves-and-concatenate. Under GSPMD,
+    slice+concat over a dim the ``model`` axis shards finer than one
+    KV head (GQA: ``wk`` is ``[h, kvh*hd]``; a TP degree above
+    ``kvh`` splits heads) MISCOMPILES on this jax/XLA version — the
+    partitioner returns scrambled values, wrong by O(1) even at
+    position 0 where rope is the identity (repro pinned in
+    tests/test_llama.py::test_rope_is_identity_at_position_zero_tp).
+    The gather formulation partitions correctly under every layout
+    and is arithmetically identical (same multiplies/adds per lane).
     """
     d = x.shape[-1]
     half = d // 2
+    lane = jnp.arange(d)
     inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, L, D/2]
-    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)  # [B, L, 1, D/2]
+    # Per-lane angle: lane j pairs with lane (j + half) % d and both
+    # use frequency j % half.
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq[lane % half]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)  # [B, L, 1, D]
     sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
-    x1, x2 = x[..., :half], x[..., half:]
-    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    # rotate_half(x)[j] = -x[j + half] (j < half) else x[j - half].
+    perm = jnp.concatenate([lane[half:], lane[:half]])
+    sign = jnp.where(lane < half, -1.0, 1.0).astype(x.dtype)
+    xr = jnp.take(x, perm, axis=-1) * sign
+    return x * cos + xr * sin
 
 
 @register_model("llama_lm")
@@ -87,6 +105,12 @@ class LlamaLM:
     # ("none" | "int8"); composes with GQA (the int8 payload shrinks
     # the ALREADY-grouped [B, L, KVH, D] cache a further ~2x).
     kv_quant: str = "none"
+    # Decode-step attention — same contract as
+    # ``GptLM.decode_attn_impl`` ("einsum" | "flash"). The flash
+    # kernel is GQA-native: scales and payload index per KV head,
+    # queries grouped in-register — the repeated K/V tensor the
+    # einsum path broadcasts (``_repeat_kv``) never exists.
+    decode_attn_impl: str = "einsum"
 
     def __post_init__(self):
         from mlapi_tpu.ops.quant import KV_FORMATS
@@ -94,6 +118,11 @@ class LlamaLM:
         if self.kv_quant not in KV_FORMATS:
             raise ValueError(
                 f"unknown kv_quant {self.kv_quant!r}; one of {KV_FORMATS}"
+            )
+        if self.decode_attn_impl not in ("einsum", "flash"):
+            raise ValueError(
+                f"unknown decode_attn_impl {self.decode_attn_impl!r}; "
+                'one of ("einsum", "flash")'
             )
         if self.attention_impl not in ("full", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
@@ -317,6 +346,7 @@ class LlamaLM:
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
                     cdt, self.head_dim, expand=self._repeat_kv,
+                    impl=self.decode_attn_impl,
                 )
                 return out
 

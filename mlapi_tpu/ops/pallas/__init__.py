@@ -8,11 +8,20 @@ hand control of VMEM/MXU beats the XLA default:
 - ``flash_attention`` — fused attention: scores, softmax and the
   probability-value contraction stay in VMEM per q-block; the [L, L]
   score matrix never touches HBM.
+- ``decode_attention`` — split-K flash-decode for the serving hot
+  path: single-query attention over the stored KV cache, int8
+  payload + scale tiles dequantized per tile in registers — int8 is
+  what crosses HBM on the decode read.
 """
 
+from mlapi_tpu.ops.pallas.decode_attention import decode_attention
 from mlapi_tpu.ops.pallas.flash_attention import (
     flash_attention,
     flash_attention_with_lse,
 )
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = [
+    "decode_attention",
+    "flash_attention",
+    "flash_attention_with_lse",
+]

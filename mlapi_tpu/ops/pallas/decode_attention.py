@@ -1,0 +1,286 @@
+"""Fused flash-decode kernel: split-K single-query attention that
+reads the KV cache — int8 payload included — in-kernel.
+
+Why decode gets its own kernel: the serving hot path is the decode
+step, and it is memory-bound, not compute-bound. Every generated
+token re-reads every layer's ``[B, L, KVH, D]`` K and V from HBM to
+do O(B·H·L·D) FLOPs — an arithmetic intensity of ~1 FLOP/byte, three
+orders below the MXU's knee. The only lever is bytes moved, and the
+einsum decode path moves the wrong ones: with an int8 cache it
+dequantizes at the read seam (``ops/quant.kv_cache_kv``), so the
+full-precision cache materializes between the dequant and the einsum
+and the int8 format's 2x HBM saving is realized in *storage* only.
+This kernel is where the saving reaches the read: int8 payload +
+per-token-per-head f32 scale tiles are DMA'd to VMEM and dequantized
+per tile in registers — int8 is what crosses HBM on the decode read.
+
+Shape of the computation (one query per row, against a long cache):
+
+- **Split-K over the cache length.** The grid is ``(B, L/block_k)``:
+  each program owns one k-tile of one batch row and computes a
+  partial ``(acc, m, l)`` triple — un-normalized output, running max,
+  running normalizer — for EVERY query head (the whole ``[H, D]``
+  query block rides into each program; it is tiny). A second,
+  pure-jnp stage merges the per-tile triples with the standard
+  log-sum-exp algebra. No ``[B, L]`` probability tensor and no
+  full-precision cache ever exist in HBM: HBM sees q, the stored
+  cache tiles, the ``[B, L]`` key mask, and ``[B, nk, H, D + 2]``
+  f32 partials (acc ``D`` + m + l per head-tile — noise next to one
+  cache read).
+- **GQA-native.** K/V stay at ``KVH`` heads in their STORED
+  ``[B, L, KVH, D]`` layout (no transpose — a transposed copy of the
+  cache would cost the very read we are saving); queries are grouped
+  in-register, ``group = H // KVH`` consecutive query heads per KV
+  head, and each KV head's tile is loaded once for its whole group.
+- **Both cache formats through one seam.** ``k``/``v`` operands are
+  either plain arrays (bf16/f32 tiles load directly) or the
+  ``{"q" int8, "scale" f32}`` pairs of the int8 cache format
+  (``ops/quant``), dequantized per tile with exactly
+  ``kv_dequantize``'s arithmetic. Same operand convention as
+  ``flash_attention``'s quantized K/V — but handled IN-kernel, not at
+  the boundary.
+- **Masking = ``decode_valid_and_shift`` semantics.** The ``[B, L]``
+  binary key mask carries everything the decode layout encodes —
+  per-row ``pos``, ``n_pad`` pad holes, shared-prefix regions,
+  optional windows — so the kernel needs no position algebra of its
+  own. Tiles whose mask is entirely zero (cache slots beyond ``pos``)
+  skip their compute under ``pl.when``: a half-full cache does half
+  the dot-products, the split-K analog of causal tile skipping.
+
+Dead-tile DMA note: the BlockSpec copy of a skipped tile still
+happens (the predicate gates compute, not the pipelined copy), so the
+byte win of skipping is bounded; the format win (int8 vs full
+precision) applies to every tile.
+
+``interpret=True`` runs the Pallas interpreter (CPU CI). In interpret
+mode the grid lowers to plain traced JAX, so the kernel composes with
+GSPMD-partitioned decode programs on virtual meshes — that is what
+the multichip dry run proves. The COMPILED kernel under a model-axis
+mesh is NOT yet hardware-validated: a compiled ``pallas_call`` is an
+opaque custom call to GSPMD, which may all-gather head-sharded cache
+operands around it instead of running the kernel per shard (negating
+the byte saving) — verifying that, and adding a ``shard_map`` wrapper
+if needed, is an open item for the next TPU window (ROADMAP).
+Single-chip TPU serving — where the bandwidth claim lives — needs no
+partitioning.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Same finite large-negative as the sibling kernels (a kernel may not
+# capture traced constants; -inf breaks the masked-row algebra).
+_NEG = -1e30
+
+
+def _decode_kernel(
+    q_ref, *refs, scale, kv_heads, group, quantized,
+):
+    """One (batch row, k-tile) program: partial ``(acc, m, l)`` for
+    all H = kv_heads * group query heads against this tile.
+
+    ``refs`` is the remaining (inputs..., outputs...) ref list; the
+    scale refs exist only in the quantized signature — the bf16/f32
+    path carries no scale operands at all (a dead operand would still
+    be DMA'd per tile, taxing the exact bandwidth-bound read this
+    kernel optimizes)."""
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, mask_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        k_ref, v_ref, mask_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    keep = mask_ref[0, 0]  # [block_k]
+    # Split-K tile skipping: a tile with no valid key (every slot
+    # beyond pos, or inside a pad hole spanning the tile) contributes
+    # the identity triple; the dots are skipped.
+    live = jnp.any(keep > 0)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        acc_ref[0, 0] = jnp.zeros_like(acc_ref[0, 0])
+        m_ref[0, 0] = jnp.full_like(m_ref[0, 0], _NEG)
+        l_ref[0, 0] = jnp.zeros_like(l_ref[0, 0])
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]  # [H, D]
+        if quantized:
+            # The int8 tile path: payload + scales were DMA'd to VMEM
+            # by the BlockSpec copies; dequantize in registers with
+            # kv_dequantize's exact arithmetic (convert to the compute
+            # dtype, broadcast-multiply by the per-(token, head)
+            # scale) — the full-precision tile never exists in HBM.
+            k = k_ref[0].astype(q.dtype) * ks_ref[0].astype(q.dtype)
+            v = v_ref[0].astype(q.dtype) * vs_ref[0].astype(q.dtype)
+        else:
+            k = k_ref[0]  # [block_k, KVH, D]
+            v = v_ref[0]
+        nkeep = (1.0 - keep) * _NEG  # [block_k]
+
+        # Per-KV-head 2D dots (kv_heads/group are static: the loop
+        # unrolls at trace time). Grouped queries: KV head j serves
+        # query heads [j*group, (j+1)*group) — jnp.repeat's layout,
+        # shared with every attention impl in ops/.
+        for j in range(kv_heads):
+            rows = slice(j * group, (j + 1) * group)
+            s = (
+                jax.lax.dot_general(
+                    q[rows], k[:, j, :],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [group, block_k]
+            s = s + nkeep[None, :]
+            m = jnp.max(s, axis=-1, keepdims=True)  # [group, 1]
+            # exp(NEG - NEG) == 1 on lanes with no valid key; * keep
+            # zeroes them (no NaN for fully-masked rows).
+            p = jnp.exp(s - m) * keep[None, :]
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            acc = jax.lax.dot_general(
+                p.astype(v.dtype), v[:, j, :],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [group, D]
+            acc_ref[0, 0, rows, :] = acc
+            m_ref[0, 0, rows, :] = m
+            l_ref[0, 0, rows, :] = l
+
+
+def _fit_block(requested: int, length: int) -> int:
+    """Largest halving of ``requested`` that divides ``length``. Any
+    dividing block >= 8 (the f32 sublane) is kept — a small legal
+    blocking beats one whole-length tile, which loses the split-K
+    grid and can blow VMEM at long L. Only truly awkward lengths
+    (odd test-harness totals like ``p + n_steps + 1``, where the
+    halvings bottom out at < 8) fall back to a single block equal to
+    the array dim (always legal, and those lengths are small).
+    Serving cache tiers are ``bucket + 2^k * chunk``, which fit real
+    tiles."""
+    b = min(requested, length)
+    while length % b:
+        b //= 2
+    if b < 8 and b < length:
+        return length
+    return b
+
+
+def _unpack(x):
+    """An operand is a plain ``[B, L, KVH, D]`` array or an int8
+    ``{"q", "scale"}`` pair (``ops/quant``'s format, ONE definition —
+    the same predicate ``maybe_dequant_kv`` uses). Returns
+    ``(payload, scale_or_None)``."""
+    from mlapi_tpu.ops.quant import _is_quant_leaf
+
+    if isinstance(x, dict):
+        if _is_quant_leaf(x):
+            return x["q"], x["scale"]
+        raise TypeError(
+            "decode_attention takes arrays or {'q', 'scale'} quantized "
+            f"pairs, got dict with keys {sorted(x)}"
+        )
+    return x, None
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def decode_attention(
+    q,
+    k,
+    v,
+    mask,
+    *,
+    scale=None,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Single-query flash-decode attention over a stored KV cache.
+
+    ``q``: ``[B, 1, H, D]``; ``k``/``v``: ``[B, L, KVH, D]`` arrays
+    (any float dtype) or int8 ``{"q", "scale"}`` pairs
+    (``scale f32[B, L, KVH, 1]``); ``mask``: binary ``[B, L]`` over
+    keys (build it with ``models.gpt.decode_valid_and_shift`` for the
+    serving layout). Returns ``[B, 1, H, D]`` in ``q.dtype``.
+
+    Numerics match the einsum decode oracle (``gpt.cached_attend``) to
+    reassociation error: f32 accumulation on every dot, probabilities
+    cast to the value dtype for the PV contraction, normalization by
+    the merged ``l`` after the split-K reduction.
+    """
+    kq, ks = _unpack(k)
+    vq, vs = _unpack(v)
+    quantized = ks is not None
+    if quantized != (vs is not None):
+        raise ValueError("k and v must share one cache format")
+    b, one, h, d = q.shape
+    if one != 1:
+        raise ValueError(
+            f"decode_attention is single-query (q [B, 1, H, D]); got "
+            f"{q.shape} — block extends take the einsum path"
+        )
+    lk, kvh = kq.shape[1], kq.shape[2]
+    if kq.shape != vq.shape or kq.shape[3] != d:
+        raise ValueError(
+            f"cache shapes disagree with q: k {kq.shape}, v {vq.shape}, "
+            f"q {q.shape}"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    group = h // kvh
+    scale = (1.0 / d**0.5) if scale is None else scale
+    bk = _fit_block(block_k, lk)
+    nk = lk // bk
+
+    mask3 = mask.astype(jnp.float32)[:, None, :]  # [B, 1, L]
+
+    q_spec = pl.BlockSpec((1, 1, h, d), lambda bi, ki: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, bk, kvh, d), lambda bi, ki: (bi, ki, 0, 0))
+    sc_spec = pl.BlockSpec((1, bk, kvh, 1), lambda bi, ki: (bi, ki, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, bk), lambda bi, ki: (bi, 0, ki))
+    part_spec = pl.BlockSpec((1, 1, h, d), lambda bi, ki: (bi, ki, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, h, 1), lambda bi, ki: (bi, ki, 0, 0))
+
+    # Scale operands exist ONLY on the quantized path: the kernel
+    # signature (and its BlockSpec copies) carries exactly what the
+    # cache format stores.
+    if quantized:
+        operands = (q, kq, ks, vq, vs, mask3)
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
+    else:
+        operands = (q, kq, vq, mask3)
+        in_specs = [q_spec, kv_spec, kv_spec, mask_spec]
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, kv_heads=kvh, group=group,
+            quantized=quantized,
+        ),
+        grid=(b, nk),
+        in_specs=in_specs,
+        out_specs=[part_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nk, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nk, h, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nk, h, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+
+    # Split-K reduction: merge the per-tile (acc, m, l) triples with
+    # the log-sum-exp algebra. All-dead rows (l == 0 everywhere) come
+    # out exactly zero — but a decode step always has >= 1 valid key
+    # (the token it just wrote).
+    m_max = jnp.max(m, axis=1)                       # [B, H, 1]
+    alpha = jnp.exp(m - m_max[:, None])              # [B, nk, H, 1]
+    l_tot = jnp.sum(alpha * l, axis=1)               # [B, H, 1]
+    acc_tot = jnp.sum(alpha * acc, axis=1)           # [B, H, D]
+    out = acc_tot / jnp.maximum(l_tot, 1e-30)
+    return out.astype(q.dtype)[:, None]              # [B, 1, H, D]

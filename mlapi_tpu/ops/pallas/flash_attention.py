@@ -745,14 +745,16 @@ def flash_attention(
     grid) — no ``[L, L]`` tensor in HBM in either pass.
     ``interpret=True`` runs the Pallas interpreter (CPU testing).
 
-    Int8-KV boundary policy: quantized ``{"q", "scale"}`` K/V
+    Int8-KV policy (the three-way split, see
+    ``ops/quant.maybe_dequant_kv``): quantized ``{"q", "scale"}`` K/V
     operands dequantize AT THIS BOUNDARY (one fused convert+multiply
-    feeding the kernel's first tile load) — the kernel itself streams
-    full-precision tiles. The int8 cache exists for the DECODE read
-    path, which never routes through this kernel; an in-kernel int8
-    tile path (payload+scales DMA'd to VMEM, dequantized per tile à
-    la paged attention) is only worth building once decode itself
-    runs as a kernel. See ``ops/quant.maybe_dequant_kv``.
+    feeding the kernel's first tile load) — full-sequence
+    prefill/training shapes are MXU-bound, so the byte format of the
+    operand read is not the lever here. The DECODE read, which IS
+    bandwidth-bound, runs as its own kernel
+    (``ops/pallas/decode_attention``) that DMAs int8 payload+scale
+    tiles to VMEM and dequantizes per tile in registers; the einsum
+    decode path dequantizes at the read seam (``kv_cache_kv``).
     """
     from mlapi_tpu.ops.quant import maybe_dequant_kv
 
@@ -794,9 +796,10 @@ def flash_attention_with_lse(
     log-sum-exp ``[B, H, L]`` — the quantity that lets independently
     computed attention blocks be merged exactly (numerically safe
     weighted average). Used by ``ring_attention``'s flash block mode;
-    differentiable through BOTH outputs. Same int8-KV boundary policy
-    as :func:`flash_attention`: quantized K/V pairs dequantize at
-    entry."""
+    differentiable through BOTH outputs. Same int8-KV policy as
+    :func:`flash_attention`: quantized K/V pairs dequantize at entry
+    (full-sequence shapes are MXU-bound; the in-kernel int8 tile path
+    belongs to the decode kernel, ``decode_attention``)."""
     from mlapi_tpu.ops.quant import maybe_dequant_kv
 
     k = maybe_dequant_kv(k, q.dtype)

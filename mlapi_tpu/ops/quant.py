@@ -121,9 +121,14 @@ def kv_quantize(x):
 
 def kv_dequantize(q, scale, dtype):
     """Traced inverse: int8 payload x broadcast scale → ``dtype``.
-    XLA fuses the convert+multiply into the consumer's operand read
-    (the decode einsum), so the expansion costs no extra HBM round
-    trip — int8 is what crosses the memory bus."""
+    WHERE this expansion happens decides whether the full-precision
+    tensor crosses HBM — on the einsum decode path (``kv_cache_kv``)
+    the dequantized operand materializes at the read seam, so int8
+    saves storage but not read traffic there; only the flash-decode
+    kernel (``ops/pallas/decode_attention``), which runs this exact
+    arithmetic per tile in registers, keeps int8 on the bus for the
+    read. See :func:`maybe_dequant_kv` for the full three-way
+    policy."""
     return q.astype(dtype) * scale.astype(dtype)
 
 
@@ -239,7 +244,7 @@ def _forced_argmax_fn(model, n_steps: int):
 
 
 def kv_greedy_agreement(model, params, prompt_ids, max_new_tokens: int,
-                        pad_lens=None) -> float:
+                        pad_lens=None, quant_overrides=None) -> float:
     """The decode-quality guard for int8 KV caches: greedy top-1
     token agreement of the int8-cache decode vs the full-precision
     cache, TEACHER-FORCED on the full-precision greedy stream.
@@ -252,6 +257,13 @@ def kv_greedy_agreement(model, params, prompt_ids, max_new_tokens: int,
     compared position actually read the quantized cache. ``model`` is
     the base decoder config (any decoder family with the ``kv_quant``
     field); returns the agreement fraction in ``[0, 1]``.
+
+    ``quant_overrides``: extra dataclass fields replaced on the
+    QUANTIZED side only — e.g. ``{"decode_attn_impl": "flash"}`` pins
+    the flash-decode kernel's int8 tile path against the
+    full-precision EINSUM reference (the oracle both decode impls
+    answer to), so the guard then covers kernel math and quantization
+    error together.
     """
     import dataclasses
 
@@ -260,7 +272,9 @@ def kv_greedy_agreement(model, params, prompt_ids, max_new_tokens: int,
         # so a 1-token window would compare nothing (NaN, not 1.0).
         raise ValueError("kv_greedy_agreement needs max_new_tokens >= 2")
     base = dataclasses.replace(model, kv_quant="none")
-    quant = dataclasses.replace(model, kv_quant="int8")
+    quant = dataclasses.replace(
+        model, kv_quant="int8", **(quant_overrides or {})
+    )
     b, p = prompt_ids.shape
     n_pad = (
         jnp.zeros((b,), jnp.int32) if pad_lens is None
@@ -280,18 +294,30 @@ def kv_greedy_agreement(model, params, prompt_ids, max_new_tokens: int,
 
 
 def maybe_dequant_kv(x, dtype=None):
-    """Kernel-boundary policy for the full-sequence attention kernels
-    (Pallas flash, ring): they consume full-precision ``[B, L, H, D]``
-    tiles, so a quantized ``{"q", "scale"}`` K/V operand DEQUANTIZES
-    AT THE BOUNDARY — one fused convert+multiply feeding the kernel's
-    first tile load — rather than teaching every kernel an int8 tile
-    path. This is deliberate: the quantized cache exists for the
-    DECODE read path (``kv_cache_kv``), which never routes through
-    these kernels (they serve full-sequence training/scoring, where
-    there is no cache); in-kernel int8 tiles (the paged-attention
-    trick of DMA-ing payload+scales into VMEM and dequantizing per
-    tile) only pay once decode itself runs as a kernel. Anything that
-    is neither an array nor a quant pair is rejected loudly."""
+    """Kernel-boundary leg of the THREE-WAY int8-KV dequant policy.
+    Where a quantized ``{"q", "scale"}`` K/V operand expands depends
+    on which path is reading and what bounds it:
+
+    1. **Prefill / full-sequence kernels (here — Pallas flash,
+       ring)**: dequantize AT THE KERNEL BOUNDARY, one fused
+       convert+multiply feeding the first tile load. These shapes are
+       MXU-bound (O(L²) FLOPs over O(L) bytes), so teaching them an
+       int8 tile path would complicate every kernel for a read that
+       isn't the bottleneck.
+    2. **Decode, ``decode_attn_impl="flash"``
+       (``ops/pallas/decode_attention``)**: dequantize PER TILE
+       IN-KERNEL — int8 payload + scale tiles DMA to VMEM and expand
+       in registers. Decode is bandwidth-bound (O(L) FLOPs over O(L)
+       bytes), so the byte format of the read IS the lever: this is
+       the only leg where int8 crosses HBM on the attention read.
+    3. **Decode, ``decode_attn_impl="einsum"`` (``kv_cache_kv``)**:
+       dequantize at the read seam feeding the decode einsum — the
+       reference oracle. The full-precision operand materializes
+       between the dequant and the einsum, so this leg realizes the
+       int8 saving in storage only.
+
+    Anything that is neither an array nor a quant pair is rejected
+    loudly."""
     if isinstance(x, dict):
         if _is_quant_leaf(x):
             return kv_dequantize(

@@ -153,6 +153,8 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--quantize", args.quantize]
     if getattr(args, "kv_quant", None):
         cmd += ["--kv-quant", args.kv_quant]
+    if getattr(args, "decode_attn_impl", None):
+        cmd += ["--decode-attn-impl", args.decode_attn_impl]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -262,6 +264,16 @@ def main(argv=None) -> None:
              "read. Generative checkpoints only; composes with "
              "--quantize and --mesh-shape (the draft's cache rides "
              "the same format)",
+    )
+    parser.add_argument(
+        "--decode-attn-impl", choices=["einsum", "flash"], default=None,
+        help="decode-step attention: 'einsum' (reference oracle; "
+             "dequantizes an int8 cache at the read seam) or 'flash' "
+             "(Pallas split-K flash-decode kernel that reads int8 "
+             "cache tiles IN-kernel — the --kv-quant byte saving "
+             "reaches the decode read, not just storage). Generative "
+             "checkpoints only; the draft, if any, rides the same "
+             "impl",
     )
     parser.add_argument(
         "--draft-checkpoint", default=None,
@@ -380,6 +392,7 @@ def main(argv=None) -> None:
     engine = InferenceEngine.from_checkpoint(
         ckpt, quantize=args.quantize,
         kv_quant=args.kv_quant,
+        decode_attn_impl=args.decode_attn_impl,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,

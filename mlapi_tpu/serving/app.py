@@ -617,6 +617,13 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["gauges"]["generate.kv_cache_bytes_per_slot"] = (
                 engine.kv_cache_slot_bytes()
             )
+            # Modeled HBM read per decode step for the ACTIVE (cache
+            # format, decode impl) pair — the production-observable
+            # form of the int8 flash-decode read saving (exact host
+            # arithmetic, no device work).
+            snap["gauges"]["generate.decode_bytes_per_step"] = (
+                engine.decode_bytes_per_step()
+            )
         return snap
 
     return app

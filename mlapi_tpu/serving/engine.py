@@ -133,6 +133,7 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         quantize: str | None = None,
         kv_quant: str | None = None,
+        decode_attn_impl: str | None = None,
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -163,6 +164,15 @@ class InferenceEngine:
         the draft, which decodes against its own int8 cache.
         Orthogonal to ``quantize`` (weights) and ``mesh``; generative
         checkpoints only.
+
+        ``decode_attn_impl="flash"`` routes every single-token decode
+        step through the Pallas split-K flash-decode kernel
+        (``ops/pallas/decode_attention``) instead of the reference
+        einsum — with an int8 cache the kernel reads int8 tiles from
+        HBM and dequantizes in registers, so the format's 2x byte
+        saving reaches the decode READ, not just storage. A model
+        field like ``kv_quant`` (program factories key on it; the
+        draft mirrors it); generative checkpoints only.
         """
         import dataclasses
 
@@ -202,6 +212,28 @@ class InferenceEngine:
                 raise ValueError(
                     f"{type(model).__name__} declares no kv_quant "
                     "cache-format field"
+                ) from None
+
+        if decode_attn_impl is not None:
+            if decode_attn_impl not in ("einsum", "flash"):
+                raise ValueError(
+                    f"unsupported decode_attn_impl={decode_attn_impl!r}"
+                )
+            if not hasattr(model, "generate"):
+                raise ValueError(
+                    "decode_attn_impl applies to generative checkpoints "
+                    f"(they decode); {type(model).__name__} does not"
+                )
+            try:
+                # Same discipline as kv_quant: a MODEL field, so every
+                # cached program factory keys on the decode impl.
+                model = dataclasses.replace(
+                    model, decode_attn_impl=decode_attn_impl
+                )
+            except TypeError:
+                raise ValueError(
+                    f"{type(model).__name__} declares no "
+                    "decode_attn_impl field"
                 ) from None
 
         # Engine dispatch keys off the INNER model: the quantized
@@ -255,6 +287,11 @@ class InferenceEngine:
                     dmodel = dataclasses.replace(
                         dmodel, kv_quant="int8"
                     )
+                if decode_attn_impl is not None:
+                    # The draft decodes too — same impl as the target.
+                    dmodel = dataclasses.replace(
+                        dmodel, decode_attn_impl=decode_attn_impl
+                    )
                 dabstract = jax.tree.map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     jax.eval_shape(
@@ -274,6 +311,8 @@ class InferenceEngine:
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
+                      **({"decode_attn_impl": decode_attn_impl}
+                         if decode_attn_impl else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
@@ -618,10 +657,13 @@ class TextGenerationEngine:
                 chunk, rtt_ms,
             )
         self.chunk = max(1, int(chunk))
-        # KV-cache storage format, owned by the MODEL (program
-        # factories key on it); mirrored here for /metrics and bench.
+        # KV-cache storage format and decode-attention impl, owned by
+        # the MODEL (program factories key on them); mirrored here for
+        # /metrics and bench.
         self.kv_quant = getattr(model, "kv_quant", "none")
+        self.decode_attn_impl = getattr(model, "decode_attn_impl", "einsum")
         self._kv_slot_bytes: int | None = None
+        self._decode_step_bytes: int | None = None
         # Batcher state (started by the app's startup hook).
         self._queue: asyncio.Queue | None = None
         self._task: asyncio.Task | None = None
@@ -738,16 +780,18 @@ class TextGenerationEngine:
         return min(self.model.max_positions, bucket + tier)
 
     def kv_cache_slot_bytes(self) -> int:
-        """DETERMINISTIC per-slot KV-cache bytes at the default
-        bucket/tier config (largest prompt bucket, default token
-        tier): ``addressable_shards[...].data.nbytes`` summed over a
-        batch-1 cache — the committed-number discipline the FSDP PR
-        set (byte counts are exact where this box's wall-clock swings
-        ±25-30%). One continuous-batching slot, one prefix-cache
-        entry of this tier, and one spec mirror row each cost this
-        much device HBM; ``kv_quant="int8"`` roughly halving it is
-        the whole claim, reported on ``/metrics`` and in the bench
-        block."""
+        """DETERMINISTIC per-slot KV-cache STORAGE bytes at the
+        default bucket/tier config (largest prompt bucket, default
+        token tier): ``addressable_shards[...].data.nbytes`` summed
+        over a batch-1 cache — the committed-number discipline the
+        FSDP PR set (byte counts are exact where this box's
+        wall-clock swings ±25-30%). One continuous-batching slot, one
+        prefix-cache entry of this tier, and one spec mirror row each
+        cost this much device HBM; ``kv_quant="int8"`` roughly
+        halving it is the storage half of the int8-KV claim, reported
+        on ``/metrics`` and in the bench block. The READ half —
+        whether decode traffic actually shrinks — depends on the
+        decode impl too: see :meth:`decode_bytes_per_step`."""
         if self._kv_slot_bytes is None:
             from mlapi_tpu.train.bench import bytes_per_device
 
@@ -758,6 +802,70 @@ class TextGenerationEngine:
             jax.block_until_ready(cache)
             self._kv_slot_bytes = int(bytes_per_device(cache))
         return self._kv_slot_bytes
+
+    def decode_bytes_per_step(self) -> int:
+        """Modeled HBM bytes ONE decode step's attention read moves
+        per slot at the default bucket/tier config — the number that
+        makes the int8 READ saving observable in production
+        (``/metrics`` gauge ``generate.decode_bytes_per_step``), not
+        just in bench. Pure host arithmetic over abstract cache
+        shapes (``jax.eval_shape`` — no device allocation), so it is
+        exact and deterministic. The model, per (cache format,
+        ``decode_attn_impl``):
+
+        - **flash**: the kernel streams the STORED tiles — int8
+          payload + f32 scales, or the compute-dtype arrays, at the
+          cache's native KV-head width (queries group in-register) —
+          so the read is exactly the storage bytes.
+        - **einsum**: the einsum operand is the full-precision cache
+          at QUERY-head width — ``kv_cache_kv`` dequantizes at the
+          read seam and GQA models broadcast KV heads to query heads
+          (``_repeat_kv``), both materialized between the seam and
+          the einsum. ONE consistent accounting: whenever the operand
+          differs from storage (by format or head width), the
+          materializing producer reads the stored cache first and the
+          einsum then reads the operand — storage PLUS operand bytes;
+          when they coincide (MHA, ``kv_quant="none"``) there is one
+          read of the stored cache. These lines are WHY the flash
+          kernel exists: it is the only path where the storage format
+          (and the GQA grouping) reaches the read.
+
+        Computed once per engine (it is constant for the engine's
+        lifetime) — /metrics scrapes read the cached value.
+        """
+        if self._decode_step_bytes is not None:
+            return self._decode_step_bytes
+        total = self._cache_len(
+            self.prompt_buckets[-1], self.default_max_new_tokens
+        )
+        abstract = jax.eval_shape(lambda: self.model.init_cache(1, total))
+        stored = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(abstract)
+        )
+        if self.decode_attn_impl == "flash":
+            self._decode_step_bytes = stored
+            return stored
+        # The einsum operand: full-precision payload at query-head
+        # width (cache payloads store KV heads; GQA's broadcast
+        # multiplies by the group factor).
+        cdt = jnp.dtype(getattr(self.model, "compute_dtype", "float32"))
+        heads = int(getattr(self.model, "num_heads", 0))
+        full = 0
+        for layer in abstract.values():
+            for name in ("k", "v", "k_q", "v_q"):
+                if name not in layer:
+                    continue
+                leaf = layer[name]
+                group = max(1, heads // leaf.shape[2]) if heads else 1
+                full += int(np.prod(leaf.shape)) * group * cdt.itemsize
+        # Operand == storage (MHA, no format): one read. Otherwise
+        # the producer reads storage and the einsum reads the
+        # materialized operand.
+        self._decode_step_bytes = (
+            full if full == stored else stored + full
+        )
+        return self._decode_step_bytes
 
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property
@@ -1223,6 +1331,10 @@ class TextGenerationEngine:
         ever pays an XLA compile — the classification engine's
         contract, honoured by generation too. Larger ``n_new`` tiers
         (power-of-two chunk multiples, log-many) compile on first use.
+        Because ``decode_attn_impl`` (like ``kv_quant``) is a model
+        field every program factory keys on, this same grid
+        precompiles the flash-decode kernel per (bucket, cache tier)
+        when the model selects it — no kernel-specific warm pass.
 
         ``full=False`` (or env ``MLAPI_TPU_WARMUP=minimal``, used by
         the CPU test suite) warms only the smallest bucket at batch=1.

@@ -1,0 +1,422 @@
+"""Flash-decode kernel (`ops/pallas/decode_attention`) and the
+``decode_attn_impl`` model field.
+
+The kernel is the serving hot path's bandwidth lever: split-K
+single-query attention that reads the STORED cache tiles — int8
+payload + scales dequantized per tile in registers — instead of the
+einsum path's dequant-at-the-read-seam. These tests pin three claims:
+
+- **Parity, cell by cell**: interpret-mode kernel output matches the
+  einsum decode oracle across {MHA, GQA} x {f32, bf16} x {kv_quant
+  none, int8} x {plain pos, ragged n_pad, prefix shift, windowed}
+  mask rows, to <= 1e-5 (f32) / <= 2e-2 (bf16) max-abs.
+- **Streams, end to end**: gpt AND llama-GQA generate token-identical
+  greedy streams under ``decode_attn_impl="flash"`` through the model
+  AND engine paths, both cache formats, pads included.
+- **Bytes, exactly**: ``engine.decode_bytes_per_step()`` equals the
+  closed-form dtype arithmetic for every (impl, format) pair, and the
+  int8 flash read is the committed factor below the full-precision
+  read — asserted from arithmetic, never from timing.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.models.gpt import decode_valid_and_shift
+from mlapi_tpu.ops.attention import NEG
+from mlapi_tpu.ops.pallas import decode_attention
+from mlapi_tpu.ops.quant import kv_dequantize, kv_greedy_agreement, kv_quantize
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+B, L, H, D = 3, 64, 4, 16
+
+
+def _einsum_oracle(q, k, v, mask):
+    """The decode einsum read (``gpt.cached_attend``'s math), GQA
+    broadcast included — the reference the kernel answers to."""
+    group = q.shape[2] // k.shape[2]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+        / q.shape[-1] ** 0.5
+    )
+    s = jnp.where(mask[:, None, None, :], s, NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _rows(dtype, kvh):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, L, kvh, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, L, kvh, D)), dtype)
+    return q, k, v
+
+
+def _mask(case):
+    """One [B, L] decode mask per semantics cell, all with per-row
+    variation (the kernel must not assume batch-uniform layouts)."""
+    idx = jnp.arange(L)
+    if case == "plain":
+        pos = jnp.asarray([10, 40, L - 1])
+        valid, _ = decode_valid_and_shift(
+            L, pos, jnp.zeros((B,), jnp.int32)
+        )
+        return valid[:, 0, 0, :]
+    if case == "ragged_n_pad":
+        pos = jnp.asarray([20, 33, 50])
+        n_pad = jnp.asarray([0, 7, 15])
+        valid, _ = decode_valid_and_shift(L, pos, n_pad)
+        return valid[:, 0, 0, :]
+    if case == "prefix_shift":
+        # Shared prefix region [lo, 16) ahead of per-row pad holes.
+        pos = jnp.asarray([30, 40, 55])
+        n_pad = jnp.asarray([2, 5, 0])
+        valid, _ = decode_valid_and_shift(
+            L, pos, n_pad, prefix_len=jnp.int32(16),
+            prefix_lo=jnp.asarray([0, 4, 9]),
+        )
+        return valid[:, 0, 0, :]
+    assert case == "windowed"
+    # Sliding window: only the last 12 slots before pos attend —
+    # whole leading tiles go dead, the split-K skip path.
+    pos = jnp.asarray([15, 35, 60])
+    valid, _ = decode_valid_and_shift(L, pos, jnp.zeros((B,), jnp.int32))
+    win = (idx[None, :] > pos[:, None] - 12)
+    return valid[:, 0, 0, :] & win
+
+
+@pytest.mark.parametrize("kvh", [H, H // 2], ids=["mha", "gqa"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+@pytest.mark.parametrize(
+    "case", ["plain", "ragged_n_pad", "prefix_shift", "windowed"]
+)
+def test_kernel_matches_einsum_oracle(kvh, dtype, fmt, case):
+    q, k, v = _rows(dtype, kvh)
+    mask = _mask(case)
+    if fmt == "int8":
+        kq, ks = kv_quantize(k)
+        vq, vs = kv_quantize(v)
+        # Oracle reads the SAME int8 values through kv_dequantize, so
+        # the comparison isolates kernel math from quantization error.
+        ref = _einsum_oracle(
+            q, kv_dequantize(kq, ks, dtype), kv_dequantize(vq, vs, dtype),
+            mask,
+        )
+        got = decode_attention(
+            q, {"q": kq, "scale": ks}, {"q": vq, "scale": vs},
+            mask.astype(jnp.float32), interpret=True, block_k=16,
+        )
+    else:
+        ref = _einsum_oracle(q, k, v, mask)
+        got = decode_attention(
+            q, k, v, mask.astype(jnp.float32), interpret=True, block_k=16,
+        )
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    diff = np.abs(
+        np.asarray(got, np.float32) - np.asarray(ref, np.float32)
+    ).max()
+    assert diff <= tol, (case, fmt, diff)
+
+
+def test_kernel_awkward_length_single_block_fallback():
+    """Cache lengths that defeat power-of-two blocking (the
+    ``p + n_steps + 1`` harness shapes) fall back to one whole-L
+    block and stay exact."""
+    q, k, v = _rows(jnp.float32, H)
+    lk = 47  # prime-ish: no block divides it
+    mask = _mask("plain")[:, :lk]
+    ref = _einsum_oracle(q, k[:, :lk], v[:, :lk], mask)
+    got = decode_attention(
+        q, k[:, :lk], v[:, :lk], mask.astype(jnp.float32), interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5
+    )
+
+
+def test_kernel_rejects_bad_operands():
+    q, k, v = _rows(jnp.float32, H)
+    mask = jnp.ones((B, L), jnp.float32)
+    with pytest.raises(ValueError, match="single-query"):
+        decode_attention(
+            jnp.concatenate([q, q], axis=1), k, v, mask, interpret=True
+        )
+    with pytest.raises(ValueError, match="one cache format"):
+        kq, ks = kv_quantize(k)
+        decode_attention(
+            q, {"q": kq, "scale": ks}, v, mask, interpret=True
+        )
+    with pytest.raises(TypeError, match="quantized pairs"):
+        decode_attention(q, {"weird": k}, v, mask, interpret=True)
+
+
+def test_bad_decode_attn_impl_rejected():
+    with pytest.raises(ValueError, match="decode_attn_impl"):
+        get_model(
+            "gpt_lm", vocab_size=32, hidden_size=32, num_layers=1,
+            num_heads=2, max_positions=32, decode_attn_impl="paged",
+        )
+
+
+# --- end-to-end streams ------------------------------------------------
+
+GPT_CFG = dict(
+    vocab_size=260, hidden_size=32, num_layers=2, num_heads=2,
+    max_positions=160, compute_dtype="float32",
+)
+LLAMA_CFG = dict(
+    vocab_size=260, hidden_size=32, num_layers=2, num_heads=4,
+    num_kv_heads=2, max_positions=96, compute_dtype="float32",
+)
+
+
+@pytest.mark.parametrize("family,cfg", [
+    ("gpt_lm", GPT_CFG), ("llama_lm", LLAMA_CFG),
+], ids=["gpt", "llama-gqa"])
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+def test_flash_decode_stream_matches_einsum(family, cfg, fmt):
+    """Greedy streams are token-identical across decode impls for
+    both families and both cache formats — left pads included (the
+    bucket-invariance discipline rides the mask into the kernel)."""
+    m = get_model(family, **cfg, kv_quant=fmt)
+    p = m.init(jax.random.key(0))
+    prompt = np.zeros((2, 12), np.int32)
+    prompt[:, 4:] = np.random.default_rng(3).integers(1, 200, (2, 8))
+    pads = np.asarray([4, 4], np.int32)
+    ref = np.asarray(m.generate(
+        p, jnp.asarray(prompt), max_new_tokens=10, pad_lens=pads
+    ))
+    mf = dataclasses.replace(m, decode_attn_impl="flash")
+    got = np.asarray(mf.generate(
+        p, jnp.asarray(prompt), max_new_tokens=10, pad_lens=pads
+    ))
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("family,cfg,dtype", [
+    ("gpt_lm", GPT_CFG, "float32"),
+    ("gpt_lm", GPT_CFG, "bfloat16"),
+    ("llama_lm", LLAMA_CFG, "float32"),
+    ("llama_lm", LLAMA_CFG, "bfloat16"),
+], ids=["gpt-f32", "gpt-bf16", "llama-f32", "llama-bf16"])
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+def test_decode_step_logits_parity(family, cfg, dtype, fmt):
+    """LOGITS-level parity through a real decode_step (prefill +
+    one cached step, ragged pads): flash vs einsum <= 1e-5 (f32) /
+    2e-2 (bf16) max-abs — the whole-model form of the kernel parity."""
+    m = get_model(
+        family, **{**cfg, "compute_dtype": dtype}, kv_quant=fmt
+    )
+    p = m.init(jax.random.key(0))
+    prompt = np.zeros((2, 10), np.int32)
+    prompt[:, 3:] = np.random.default_rng(5).integers(1, 200, (2, 7))
+    n_pad = jnp.asarray([3, 3], jnp.int32)
+    cache, _ = m.prefill_core(p, jnp.asarray(prompt), n_pad, 24)
+    tok = jnp.asarray([[7], [9]], jnp.int32)
+
+    def step(model):
+        logits, _ = jax.jit(model.decode_step)(
+            p, cache, tok, jnp.int32(10), n_pad
+        )
+        return np.asarray(logits, np.float32)
+
+    ref = step(m)
+    got = step(dataclasses.replace(m, decode_attn_impl="flash"))
+    tol = 1e-5 if dtype == "float32" else 2e-2
+    assert np.abs(got - ref).max() <= tol, (family, dtype, fmt)
+
+
+def test_engine_flash_decode_and_prefix_matches_einsum():
+    """The engine path (chunked decode, prefix KV cache) over the
+    flash impl emits the exact einsum-engine stream — the kernel
+    rides ``decode_step``'s mask semantics, prefix regions included."""
+    model = get_model("gpt_lm", **GPT_CFG, kv_quant="int8")
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def eng(impl):
+        return TextGenerationEngine(
+            dataclasses.replace(model, decode_attn_impl=impl), params,
+            tokenizer=tok, chunk=2, fused_single=False,
+        )
+
+    a, b = eng("einsum"), eng("flash")
+    assert b.decode_attn_impl == "flash"
+    ref = a.generate_text("hello world", max_new_tokens=16)
+    got = b.generate_text("hello world", max_new_tokens=16)
+    assert got["token_ids"] == ref["token_ids"]
+    prefix = "the quick brown fox "
+    pref_ref = a.generate_text("tail", prefix=prefix, max_new_tokens=8)
+    pref_got = b.generate_text("tail", prefix=prefix, max_new_tokens=8)
+    assert pref_got["token_ids"] == pref_ref["token_ids"]
+
+
+def test_from_checkpoint_flag_and_rejects(tmp_path):
+    from mlapi_tpu.checkpoint import save_checkpoint
+    from mlapi_tpu.serving import InferenceEngine
+
+    tok = ByteTokenizer()
+    model = get_model("gpt_lm", **GPT_CFG)
+    ck = tmp_path / "ck"
+    save_checkpoint(
+        ck, model.init(jax.random.key(1)), step=1,
+        config={"model": "gpt_lm", "model_kwargs": GPT_CFG,
+                "tokenizer": tok.fingerprint()},
+    )
+    eng = InferenceEngine.from_checkpoint(
+        ck, kv_quant="int8", decode_attn_impl="flash"
+    )
+    assert eng.model.decode_attn_impl == "flash"
+    assert eng.meta["decode_attn_impl"] == "flash"
+    with pytest.raises(ValueError, match="decode_attn_impl"):
+        InferenceEngine.from_checkpoint(ck, decode_attn_impl="paged")
+
+
+# --- the byte model ----------------------------------------------------
+
+
+def test_decode_bytes_per_step_closed_form():
+    """Every (impl, format) pair's modeled read equals the dtype
+    arithmetic, and the int8 flash read clears the committed factor
+    below the full-precision read — from arithmetic, not timing.
+    bf16 gpt-small shapes (head_dim 128): 2D/(D+4) = 1.94x."""
+    small = dict(
+        vocab_size=260, hidden_size=256, num_layers=2, num_heads=2,
+        max_positions=320, compute_dtype="bfloat16",
+    )
+    model = get_model("gpt_lm", **small)
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def eng(impl, fmt):
+        m = dataclasses.replace(
+            model, kv_quant=fmt, decode_attn_impl=impl
+        )
+        return TextGenerationEngine(m, params, tokenizer=tok, chunk=8)
+
+    layers, h, d = small["num_layers"], 2, 128
+    total = 160  # bucket 128 + default tier 32
+    bf16 = layers * 2 * total * h * d * 2
+    int8 = layers * 2 * (total * h * d + total * h * 4)
+    assert eng("flash", "none").decode_bytes_per_step() == bf16
+    assert eng("flash", "int8").decode_bytes_per_step() == int8
+    assert eng("einsum", "none").decode_bytes_per_step() == bf16
+    assert eng("einsum", "int8").decode_bytes_per_step() == bf16 + int8
+    # The read-side claim: exact ratio from dtype arithmetic —
+    # per (token, head): 2D bf16 bytes vs D + 4 int8+scale bytes.
+    assert bf16 / int8 == pytest.approx((2 * d) / (d + 4))
+    assert bf16 / int8 >= 1.9
+    # And the einsum path demonstrably does NOT realize it.
+    assert eng("einsum", "int8").decode_bytes_per_step() > bf16
+
+    # GQA: the einsum operand broadcasts KV heads to query heads
+    # (_repeat_kv materializes), so the einsum step reads the stored
+    # KVH-width cache (the broadcast's producer) PLUS the
+    # query-head-width operand — flash reads the stored tiles once.
+    lm = get_model("llama_lm", **LLAMA_CFG)  # heads 4, kv_heads 2
+    lp = lm.init(jax.random.key(1))
+
+    def leng(impl, fmt):
+        m = dataclasses.replace(lm, kv_quant=fmt, decode_attn_impl=impl)
+        return TextGenerationEngine(
+            m, lp, tokenizer=tok, chunk=8
+        ).decode_bytes_per_step()
+
+    kvh, hd, layers_l = 2, 8, LLAMA_CFG["num_layers"]
+    total_l = 96  # bucket 64 + default tier 32, clamped to window 96
+    stored_f32 = layers_l * 2 * total_l * kvh * hd * 4
+    stored_l = layers_l * 2 * (total_l * kvh * hd + total_l * kvh * 4)
+    full_l = layers_l * 2 * total_l * (2 * kvh) * hd * 4  # f32, H heads
+    assert leng("flash", "none") == stored_f32
+    assert leng("einsum", "none") == stored_f32 + full_l  # group 2: 3x
+    assert leng("flash", "int8") == stored_l
+    assert leng("einsum", "int8") == stored_l + full_l
+
+
+def test_metrics_exports_decode_bytes():
+    import asyncio
+
+    from mlapi_tpu.serving import build_app
+
+    model = get_model("gpt_lm", **GPT_CFG, kv_quant="int8")
+    model = dataclasses.replace(model, decode_attn_impl="flash")
+    eng = TextGenerationEngine(
+        model, model.init(jax.random.key(0)), tokenizer=ByteTokenizer(),
+        chunk=2, fused_single=False,
+    )
+
+    async def scrape():
+        import httpx
+
+        app = build_app(eng)
+        await app.startup()
+        try:
+            transport = httpx.ASGITransport(app=app)
+            async with httpx.AsyncClient(
+                transport=transport, base_url="http://test"
+            ) as c:
+                return (await c.get("/metrics")).json()
+        finally:
+            await app.shutdown()
+
+    snap = asyncio.run(scrape())
+    assert (
+        snap["gauges"]["generate.decode_bytes_per_step"]
+        == eng.decode_bytes_per_step()
+    )
+    assert (
+        snap["gauges"]["generate.kv_cache_bytes_per_slot"]
+        == eng.kv_cache_slot_bytes()
+    )
+
+
+# --- the agreement pin -------------------------------------------------
+
+
+@pytest.mark.heavy  # in-suite soak — fast profile: -m 'not heavy'
+def test_flash_int8_greedy_agreement_256_tokens():
+    """The acceptance pin: teacher-forced greedy top-1 agreement of
+    ``kv_quant="int8", decode_attn_impl="flash"`` vs the
+    FULL-PRECISION EINSUM reference >= 0.99 over 256 tokens x 8
+    prompts on bf16 gpt-small — kernel math and quantization error
+    guarded together, on the exact shape class the byte claim uses."""
+    small = dict(
+        vocab_size=260, hidden_size=256, num_layers=2, num_heads=2,
+        max_positions=320, compute_dtype="bfloat16",
+    )
+    model = get_model("gpt_lm", **small)  # einsum reference config
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+    prompts = [
+        "the quick brown fox", "serving engines batch",
+        "checkpoints commit", "tpu programs compile",
+        "the draft proposes", "sharding follows mesh",
+        "decode reads the cache", "quantize the kv cache",
+    ]
+    width = max(len(tok.token_ids(p)) for p in prompts)
+    rows = np.full((len(prompts), width), tok.pad_id, np.int32)
+    pads = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        ids = tok.token_ids(p)
+        rows[i, width - len(ids):] = ids
+        pads[i] = width - len(ids)
+    agr = kv_greedy_agreement(
+        model, params, jnp.asarray(rows), 257, pad_lens=pads,
+        quant_overrides={"decode_attn_impl": "flash"},
+    )
+    assert agr >= 0.99, agr

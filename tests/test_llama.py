@@ -211,6 +211,45 @@ def test_ring_attention_backend_matches_full(model, params):
     np.testing.assert_allclose(out, ref, atol=2e-4)
 
 
+def test_rope_is_identity_at_position_zero_tp():
+    """Root-cause pin for the (2, 4)-mesh numeric failure this family
+    carried since the seed: it was never accumulation order — XLA's
+    SPMD partitioner on this jax version MISCOMPILES slice+concat
+    over a dim the ``model`` axis shards finer than one KV head
+    (``wk`` is [h, kvh*hd] = [32, 16]; tp=4 > kvh=2 splits heads), so
+    the old rotate-half returned values wrong by O(1) even at
+    position 0, where rope must be the identity. ``_rope`` now uses a
+    constant-index gather, which partitions correctly; this test
+    reruns the exact trigger and pins the identity."""
+    from mlapi_tpu.models.llama import _rope
+    from mlapi_tpu.parallel import create_mesh, params_for_model
+
+    m = get_model("llama_lm", **TINY)
+    params = m.init(jax.random.key(0))
+    mesh = create_mesh((2, 4))
+    sharded = params_for_model(m, params, mesh)
+    ids = np.random.default_rng(5).integers(0, 64, (2, 16)).astype(np.int32)
+
+    def k_roped_pos0(p, ids):
+        from mlapi_tpu.models.llama import _rms_norm
+
+        x = p["wte"][ids]
+        layer = p["layer_0"]
+        xn = _rms_norm(x, layer["rms1_scale"]).astype(jnp.float32)
+        b, l = ids.shape
+        k = (xn @ layer["wk"].astype(jnp.float32)).reshape(
+            b, l, m.kv_heads, m.head_dim
+        )
+        zeros = jnp.zeros((b, l), jnp.int32)
+        return k, _rope(k, zeros, m.rope_theta)
+
+    k, roped = jax.jit(k_roped_pos0)(sharded, ids)
+    np.testing.assert_allclose(
+        np.asarray(roped), np.asarray(k), atol=1e-6,
+        err_msg="rope at position 0 must be the identity, sharded too",
+    )
+
+
 def test_flash_attention_backend_matches_full(model, params):
     """attention_impl='flash' feeds raw GQA kv heads to the kernel
     (no repeated K/V tensor) — logits must match the full backend."""
