@@ -489,6 +489,124 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _paged_report(ck: str, env: dict) -> dict:
+    """Subprocess: paged vs contiguous KV allocation on the SAME
+    checkpoint. Two claim classes, per the variance-bound rule:
+
+    - **Capacity / padding waste — exact arithmetic, asserted.** A
+      contiguous slot always holds its full cache TIER; a paged slot
+      holds ``ceil(tokens / page)`` pages. Both sides come from
+      dtype/shape arithmetic (``kv_page_bytes`` x counts vs the
+      contiguous ``eval_shape`` bytes), never wall-clock, so the
+      numbers compare across days. Reported over the default bucket
+      ladder at the default token budget.
+    - **Throughput — interleaved, report-only.** paged and contiguous
+      engines visit the same prompts inside one window; their token
+      streams are asserted IDENTICAL (the parity the whole design
+      pins), the tokens/s ratio rides the ±30% box variance.
+    """
+    src = f"""
+import json, time
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_page_bytes
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+PAGE = 16
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+cont = TextGenerationEngine(model, params, tokenizer=tok, chunk=8,
+                            fused_single=False)
+paged = TextGenerationEngine(model, params, tokenizer=tok, chunk=8,
+                             fused_single=False, kv_page_size=PAGE)
+
+# --- capacity model: exact dtype/shape arithmetic, asserted ---------
+page_b = paged.kv_page_bytes()
+assert page_b == kv_page_bytes(model, PAGE)
+report = {{"page_tokens": PAGE, "page_bytes": page_b}}
+budget = None
+ladder = {{}}
+for bucket in cont.prompt_buckets:
+    total = cont._cache_len(bucket, cont.default_max_new_tokens)
+    # Contiguous: the slot holds `total` slots whatever the request
+    # used. Bytes from abstract shapes (no device work).
+    abstract = jax.eval_shape(lambda t=total: model.init_cache(1, t))
+    slot_b = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                 for layer in abstract.values()
+                 for l in layer.values())
+    # A typical request at this bucket: a half-full prompt plus the
+    # default budget — the padding the tier forces on it.
+    used_tokens = bucket // 2 + cont.default_max_new_tokens
+    paged_b = -(-used_tokens // PAGE) * page_b
+    # The asserted identity: pool bytes per token == contiguous bytes
+    # per token (paging adds indirection, not byte overhead), so a
+    # FULL tier costs the same either way.
+    assert abs(page_b * (total / PAGE) - slot_b) < 1e-6 * slot_b, (
+        page_b, total, slot_b)
+    budget = cont.max_batch * slot_b  # the contiguous allocation
+    pool_pages = budget // page_b
+    ladder[str(bucket)] = {{
+        "tier_slots": total,
+        "contiguous_slot_bytes": slot_b,
+        "paged_bytes_at_typical_use": paged_b,
+        "padding_waste_contiguous_pct": round(
+            100.0 * (1 - used_tokens / total), 1),
+        "padding_waste_paged_pct": round(
+            100.0 * (1 - used_tokens / (-(-used_tokens // PAGE) * PAGE)),
+            1),
+        # Concurrent slots the SAME byte budget sustains at this
+        # traffic shape (contiguous budget = max_batch full tiers).
+        "slots_contiguous": cont.max_batch,
+        "slots_paged": int(pool_pages // -(-used_tokens // PAGE)),
+    }}
+report["bucket_ladder"] = ladder
+report["capacity_model_asserted"] = True
+
+# --- interleaved throughput + token parity --------------------------
+N = 32
+prompts = ["the quick brown fox", "decode reads the cache",
+           "pages share the prefix"]
+for eng in (cont, paged):  # compile off the clock
+    for p in prompts:
+        eng.generate_text(p, max_new_tokens=N)
+toks = {{"contiguous": 0, "paged": 0}}
+secs = {{"contiguous": 0.0, "paged": 0.0}}
+for _ in range(3):
+    for key, eng in (("contiguous", cont), ("paged", paged)):
+        for p in prompts:
+            t0 = time.perf_counter()
+            out = eng.generate_text(p, max_new_tokens=N)
+            secs[key] += time.perf_counter() - t0
+            toks[key] += len(out["token_ids"])
+for p in prompts:
+    a = cont.generate_text(p, max_new_tokens=N)["token_ids"]
+    b = paged.generate_text(p, max_new_tokens=N)["token_ids"]
+    assert a == b, (p, a, b)
+report["streams_paged_vs_contiguous_identical"] = True
+report["contiguous_tokens_per_s"] = round(
+    toks["contiguous"] / secs["contiguous"], 1)
+report["paged_tokens_per_s"] = round(toks["paged"] / secs["paged"], 1)
+report["kv_pages_total"] = paged.kv_pages_total
+report["kv_pages_in_use_idle"] = paged.kv_pages_in_use
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"paged_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_generate() -> None:
     """/generate throughput: single-stream vs concurrency-8 batched
     decode through the full HTTP stack (r1 criterion: batched decode
@@ -521,6 +639,13 @@ def bench_generate() -> None:
     kv_quant = os.environ.get("BENCH_GEN_KV_QUANT") == "1"
     if kv_quant:
         srv_args += ["--kv-quant", "int8"]
+    kv_paged = os.environ.get("BENCH_GEN_PAGED") == "1"
+    if kv_paged:
+        # The measured server itself runs paged, so the headline
+        # throughput/latency numbers AND the /metrics pool gauges come
+        # from the paged allocator; the capacity-model block rides in
+        # via _paged_report below.
+        srv_args += ["--kv-page-size", "16"]
     server, health, fb_note = _start_with_cpu_fallback(
         workdir, server_env, startup_timeout, args=srv_args
     )
@@ -596,12 +721,20 @@ def bench_generate() -> None:
             kv_slot = after.get("gauges", {}).get(
                 "generate.kv_cache_bytes_per_slot"
             )
+            # Pool gauges under live load (the same block /metrics
+            # exports): present only when the server runs paged.
+            pool_g = {
+                k.removeprefix("generate."): v
+                for k, v in after.get("gauges", {}).items()
+                if k.startswith("generate.kv_page")
+            }
             return (single, batched, mixed_r, shorts_alone, shorts_holb,
-                    admitted, kv_slot)
+                    admitted, kv_slot, pool_g)
 
         (single, batched, mixed_r, shorts_alone, shorts_holb,
-         admitted, kv_slot_bytes) = asyncio.run(measure())
-        kv_extras = {"kv_cache_bytes_per_slot": kv_slot_bytes}
+         admitted, kv_slot_bytes, pool_gauges) = asyncio.run(measure())
+        kv_extras = {"kv_cache_bytes_per_slot": kv_slot_bytes,
+                     **pool_gauges}
         if kv_quant:
             # The committed int8-KV numbers, measured in a subprocess
             # on the SAME checkpoint: deterministic per-slot bytes for
@@ -616,6 +749,11 @@ def bench_generate() -> None:
             # dtype arithmetic; the int8 READ saving is a byte claim,
             # not a wall-clock claim, on this CPU-attach box).
             kv_extras.update(_decode_report(ck, server_env))
+        if kv_paged:
+            # Paged vs contiguous capacity/padding-waste model (exact
+            # arithmetic, asserted in-subprocess) + interleaved
+            # throughput with token-identity asserted.
+            kv_extras.update(_paged_report(ck, server_env))
         prefix_extras = {}
         if os.environ.get("BENCH_GEN_PREFIX") == "1":
             # Prefix-caching TTFT: the same effective prompt served

@@ -300,6 +300,7 @@ class GptLM:
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
                     cdt, hd, impl=self.decode_attn_impl,
+                    mesh=self.mesh,
                 )
                 return out
 
@@ -624,7 +625,7 @@ def extend_positions_and_mask(max_len, u, pos0, n_pad, prefix_len=None,
 
 def cached_attend(
     cache_layer, q, k_new, v_new, pos, valid, cdt, head_dim, expand=None,
-    impl: str = "einsum",
+    impl: str = "einsum", mesh=None,
 ):
     """One decode-time attention over a fixed-shape KV cache, shared
     by every decoder family: write the new K/V at ``pos``, attend the
@@ -655,32 +656,81 @@ def cached_attend(
       Multi-token blocks (``extend_core``) keep the einsum path
       (block prefill is MXU-bound; the kernel is a decode
       bandwidth lever).
+
+    PAGED cache layers (``ops/quant.kv_is_paged_layer``: pool +
+    page-table) route through the same two impls: the einsum path
+    gathers pages into the contiguous oracle layout inside
+    ``kv_cache_kv`` (the reference), while the flash path hands the
+    pools and the table to ``paged_decode_attention`` — the page
+    table becomes the kernel's BlockSpec index map and no contiguous
+    cache ever materializes.
+
+    ``mesh`` (optional): when it carries a ``model`` axis of size > 1
+    that divides the cache's KV-head count, the flash kernel runs
+    under an explicit ``shard_map`` over that axis
+    (``decode_attention_tp`` / ``paged_decode_attention_tp``) so
+    GSPMD cannot all-gather head-sharded cache operands around the
+    opaque ``pallas_call``. Indivisible head counts fall back to the
+    unwrapped kernel (GSPMD decides, as before).
     """
     from mlapi_tpu.ops.attention import NEG
     from mlapi_tpu.ops.quant import (
-        kv_cache_append, kv_cache_kv, kv_is_quantized_layer,
+        kv_cache_append, kv_cache_kv, kv_is_paged_layer,
+        kv_is_quantized_layer,
     )
 
     expand = expand or (lambda t: t)
     new_layer = kv_cache_append(cache_layer, k_new, v_new, pos, cdt)
     if impl == "flash" and q.shape[1] == 1:
-        from mlapi_tpu.ops.pallas import decode_attention
+        from mlapi_tpu.ops.pallas import (
+            decode_attention, decode_attention_tp,
+            paged_decode_attention, paged_decode_attention_tp,
+        )
 
+        paged = kv_is_paged_layer(new_layer)
         if kv_is_quantized_layer(new_layer):
             k = {"q": new_layer["k_q"], "scale": new_layer["k_scale"]}
             v = {"q": new_layer["v_q"], "scale": new_layer["v_scale"]}
+            kvh = new_layer["k_q"].shape[2]
         else:
             k, v = new_layer["k"], new_layer["v"]
-        ctx = decode_attention(
-            q, k, v, valid[:, 0, 0, :].astype(jnp.float32),
-            scale=1.0 / head_dim**0.5,
-            # Interpret ONLY on CPU (the CI backend). On TPU the
-            # compiled kernel runs; any other accelerator attempts a
-            # real lowering and fails loudly — silently interpreting
-            # every decode step there would be orders slower than the
-            # einsum path this kernel exists to beat.
-            interpret=jax.default_backend() == "cpu",
+            kvh = new_layer["k"].shape[2]
+        mask2 = valid[:, 0, 0, :].astype(jnp.float32)
+        scale = 1.0 / head_dim**0.5
+        # Interpret ONLY on CPU (the CI backend). On TPU the
+        # compiled kernel runs; any other accelerator attempts a
+        # real lowering and fails loudly — silently interpreting
+        # every decode step there would be orders slower than the
+        # einsum path this kernel exists to beat.
+        interp = jax.default_backend() == "cpu"
+        tp = (
+            mesh.shape["model"]
+            if mesh is not None and "model" in getattr(
+                mesh, "axis_names", ()
+            )
+            else 1
         )
+        use_tp = tp > 1 and kvh % tp == 0 and q.shape[2] % tp == 0
+        if paged:
+            table = new_layer["table"]
+            if use_tp:
+                ctx = paged_decode_attention_tp(
+                    mesh, q, k, v, table, mask2, scale=scale,
+                    interpret=interp,
+                )
+            else:
+                ctx = paged_decode_attention(
+                    q, k, v, table, mask2, scale=scale,
+                    interpret=interp,
+                )
+        elif use_tp:
+            ctx = decode_attention_tp(
+                mesh, q, k, v, mask2, scale=scale, interpret=interp,
+            )
+        else:
+            ctx = decode_attention(
+                q, k, v, mask2, scale=scale, interpret=interp,
+            )
         return ctx, new_layer
     ck, cv = kv_cache_kv(new_layer, cdt)
     scores = (
@@ -942,6 +992,95 @@ def extend_chunk_fn(model, width: int, total: int):
         )
 
     return jax.jit(_run, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=64)
+def paged_extend_fn(model, width: int):
+    """Jitted ``[B, width]`` block forward against a PAGED cache at
+    traced offset ``pos0`` with traced prefix-region parameters — the
+    paged serving lifecycle's one prefill workhorse. It covers what
+    took two contiguous programs: chunked long-prompt prefill
+    (``prefix_len = 0``, the ``extend_chunk_fn`` role) and
+    shared-prefix suffix prefill (``pos0 = prefix_len = P`` with the
+    region's ``lo``, the ``prefix_prefill_fn`` role) — because a paged
+    cache arrives with its page TABLE already describing the rows
+    (shared prefix pages included), there is no per-variant cache
+    construction left to fuse in. Callers sample the final block's
+    logits with ``sample_fn`` (stream index 0 — byte-identical to the
+    contiguous programs' draws). The cache is donated: pool updates
+    are in place."""
+
+    def _run(params, cache, chunk_ids, pos0, n_pad, prefix_len, lo):
+        return model.extend_core(
+            params, cache, chunk_ids, pos0, n_pad, prefix_len, lo
+        )
+
+    return jax.jit(_run, donate_argnums=(1,))
+
+
+@functools.cache
+def paged_scatter_fn():
+    """Jitted paged ADOPT: copy a contiguous ``[R, W]``-shaped cache
+    pytree (a prefill's output, a joiner's mini cache, a prefix
+    entry's KV) into pool pages at virtual offset ``off`` of the
+    ``[R, NP]`` page-table rows ``table`` — one scatter per leaf, the
+    coordinates shared with ``ops/quant``'s paged append. This is the
+    page-granular replacement for ``admit_scatter_fn`` (no whole-row
+    cache object to write into) and the bridge by which contiguous
+    prefill programs feed the paged pool; formation pays one extra
+    copy of the bytes prefill just wrote (page-native prefill is a
+    noted follow-up), while ADMISSION keeps the contiguous path's
+    shape: bucket-keyed prefill + a trivial scatter."""
+
+    def _run(cache, mini, table, off):
+        from mlapi_tpu.ops.quant import kv_layer_page_size
+
+        out = {}
+        for ln, layer in cache.items():
+            page = kv_layer_page_size(layer)
+            small = mini[ln]
+            w = next(iter(small.values())).shape[1]
+            r = table.shape[0]
+            vpos = off + jnp.arange(w)  # [W] virtual slots
+            pids = jnp.take_along_axis(
+                table, jnp.broadcast_to((vpos // page)[None], (r, w)),
+                axis=1,
+            )
+            offs = jnp.broadcast_to((vpos % page)[None], (r, w))
+            new_layer = {"table": layer["table"]}
+            for name in small:
+                new_layer[name] = layer[name].at[pids, offs].set(
+                    small[name].astype(layer[name].dtype)
+                )
+            out[ln] = new_layer
+        return out
+
+    return jax.jit(_run, donate_argnums=(0,))
+
+
+@functools.cache
+def paged_cow_fn():
+    """Jitted copy-on-write page copy: duplicate pool pages ``src``
+    into freshly-allocated pages ``dst`` (both ``int32 [R]``) across
+    every layer's pools — the device half of COW. One gather+scatter
+    of R pages, independent of sequence length or batch size: this is
+    what lets a shared prefix's last partial page diverge per row
+    without copying anyone's cache. The caller rewrites the HOST page
+    table; the pools are donated."""
+
+    def _run(cache, src, dst):
+        out = {}
+        for ln, layer in cache.items():
+            new_layer = {"table": layer["table"]}
+            for name in layer:
+                if name == "table":
+                    continue
+                pool = layer[name]
+                new_layer[name] = pool.at[dst].set(pool[src])
+            out[ln] = new_layer
+        return out
+
+    return jax.jit(_run, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=16)

@@ -346,7 +346,7 @@ class LlamaLM:
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos, valid,
                     cdt, self.head_dim, expand=self._repeat_kv,
-                    impl=self.decode_attn_impl,
+                    impl=self.decode_attn_impl, mesh=self.mesh,
                 )
                 return out
 

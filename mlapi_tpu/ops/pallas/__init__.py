@@ -14,7 +14,12 @@ hand control of VMEM/MXU beats the XLA default:
   what crosses HBM on the decode read.
 """
 
-from mlapi_tpu.ops.pallas.decode_attention import decode_attention
+from mlapi_tpu.ops.pallas.decode_attention import (
+    decode_attention,
+    decode_attention_tp,
+    paged_decode_attention,
+    paged_decode_attention_tp,
+)
 from mlapi_tpu.ops.pallas.flash_attention import (
     flash_attention,
     flash_attention_with_lse,
@@ -22,6 +27,9 @@ from mlapi_tpu.ops.pallas.flash_attention import (
 
 __all__ = [
     "decode_attention",
+    "decode_attention_tp",
+    "paged_decode_attention",
+    "paged_decode_attention_tp",
     "flash_attention",
     "flash_attention_with_lse",
 ]

@@ -274,13 +274,228 @@ def decode_attention(
         interpret=interpret,
     )(*operands)
 
-    # Split-K reduction: merge the per-tile (acc, m, l) triples with
-    # the log-sum-exp algebra. All-dead rows (l == 0 everywhere) come
-    # out exactly zero — but a decode step always has >= 1 valid key
-    # (the token it just wrote).
+    return _splitk_merge(acc, m, l, q.dtype)
+
+
+def _splitk_merge(acc, m, l, dtype):
+    """Split-K reduction: merge the per-tile (acc, m, l) triples with
+    the log-sum-exp algebra. All-dead rows (l == 0 everywhere) come
+    out exactly zero — but a decode step always has >= 1 valid key
+    (the token it just wrote). Shared verbatim by the contiguous and
+    paged kernels: the page table changes WHERE a tile's bytes live,
+    never the merge arithmetic."""
     m_max = jnp.max(m, axis=1)                       # [B, H, 1]
     alpha = jnp.exp(m - m_max[:, None])              # [B, nk, H, 1]
     l_tot = jnp.sum(alpha * l, axis=1)               # [B, H, 1]
     acc_tot = jnp.sum(alpha * acc, axis=1)           # [B, H, D]
     out = acc_tot / jnp.maximum(l_tot, 1e-30)
-    return out.astype(q.dtype)[:, None]              # [B, 1, H, D]
+    return out.astype(dtype)[:, None]                # [B, 1, H, D]
+
+
+def _paged_kernel(table_ref, q_ref, *refs, scale, kv_heads, group,
+                  quantized):
+    """The paged grid's kernel body IS the contiguous kernel body: the
+    scalar-prefetched page table is consumed entirely by the BlockSpec
+    index maps (it decides which pool page each program's k-tile DMA
+    reads); the math never sees it."""
+    del table_ref
+    _decode_kernel(
+        q_ref, *refs, scale=scale, kv_heads=kv_heads, group=group,
+        quantized=quantized,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q,
+    k,
+    v,
+    table,
+    mask,
+    *,
+    scale=None,
+    interpret: bool = False,
+):
+    """Page-table flash-decode: split-K single-query attention whose
+    k-tiles are POOL PAGES selected per program by a scalar-prefetched
+    page table — the ROADMAP's "a page table is one more BlockSpec
+    index map", literally.
+
+    ``q``: ``[B, 1, H, D]``; ``k``/``v``: ``[P, page, KVH, D]`` pool
+    arrays (any float dtype) or int8 ``{"q", "scale"}`` pool pairs
+    (``scale f32[P, page, KVH, 1]``); ``table``: int32 ``[B, NP]``
+    pool-page ids per virtual tile; ``mask``: binary ``[B, NP*page]``
+    over VIRTUAL key slots (the same ``decode_valid_and_shift`` mask
+    the contiguous kernel takes — paging is invisible to the slot
+    algebra). Returns ``[B, 1, H, D]`` in ``q.dtype``.
+
+    The grid is ``(B, NP)`` — one program per (row, virtual tile), the
+    tile size pinned to the page size so the BlockSpec copy of tile
+    ``ki`` is exactly ``pool[table[b, ki]]``: sequences scattered
+    across non-contiguous pages stream through the SAME kernel body as
+    the contiguous layout, with the int8 in-register dequantization
+    and dead-tile ``pl.when`` skipping intact. Null-page tiles
+    (unallocated table entries) DMA the reserved page and are fully
+    masked — their programs take the dead-tile branch.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    kq, ks = _unpack(k)
+    vq, vs = _unpack(v)
+    quantized = ks is not None
+    if quantized != (vs is not None):
+        raise ValueError("k and v must share one cache format")
+    b, one, h, d = q.shape
+    if one != 1:
+        raise ValueError(
+            f"paged_decode_attention is single-query (q [B, 1, H, D]); "
+            f"got {q.shape}"
+        )
+    page, kvh = kq.shape[1], kq.shape[2]
+    np_tiles = table.shape[1]
+    if kq.shape != vq.shape or kq.shape[3] != d:
+        raise ValueError(
+            f"pool shapes disagree with q: k {kq.shape}, v {vq.shape}, "
+            f"q {q.shape}"
+        )
+    if mask.shape != (b, np_tiles * page):
+        raise ValueError(
+            f"mask {mask.shape} must cover the virtual layout "
+            f"[{b}, {np_tiles * page}]"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    group = h // kvh
+    scale = (1.0 / d**0.5) if scale is None else scale
+
+    mask3 = mask.astype(jnp.float32)[:, None, :]  # [B, 1, NP*page]
+
+    q_spec = pl.BlockSpec((1, 1, h, d), lambda bi, ki, t: (bi, 0, 0, 0))
+    # THE page-table indirection: tile ki of row bi is pool page
+    # t[bi, ki]. Everything else is the contiguous kernel's spec set
+    # with the table ref riding as a trailing index-map argument.
+    kv_spec = pl.BlockSpec(
+        (1, page, kvh, d), lambda bi, ki, t: (t[bi, ki], 0, 0, 0)
+    )
+    sc_spec = pl.BlockSpec(
+        (1, page, kvh, 1), lambda bi, ki, t: (t[bi, ki], 0, 0, 0)
+    )
+    mask_spec = pl.BlockSpec((1, 1, page), lambda bi, ki, t: (bi, 0, ki))
+    part_spec = pl.BlockSpec((1, 1, h, d), lambda bi, ki, t: (bi, ki, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, h, 1), lambda bi, ki, t: (bi, ki, 0, 0))
+
+    if quantized:
+        operands = (kq, ks, vq, vs, mask3)
+        in_specs = [kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
+    else:
+        operands = (kq, vq, mask3)
+        in_specs = [kv_spec, kv_spec, mask_spec]
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, kv_heads=kvh, group=group,
+            quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, np_tiles),
+            in_specs=[q_spec, *in_specs],
+            out_specs=[part_spec, row_spec, row_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, np_tiles, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, np_tiles, h, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, np_tiles, h, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(table, q, *operands)
+
+    return _splitk_merge(acc, m, l, q.dtype)
+
+
+def _head_sharded_call(mesh, fn, q, k, v, head_axis_specs, extras):
+    """shard_map a decode kernel over the TP ``model`` axis so the
+    compiled ``pallas_call`` — an opaque custom call GSPMD cannot see
+    into — runs PER SHARD on its local head slice instead of risking
+    an all-gather of the head-sharded cache operands around it (the
+    ROADMAP open item this wrapper closes). ``head_axis_specs`` maps
+    each of (q, k, v) — arrays or {"q","scale"} pairs — to its
+    PartitionSpec; ``extras`` are replicated operands (mask, table).
+
+    Every per-KV-head loop iteration in the kernel is independent, so
+    sharding heads is exact: each shard computes its own query-head
+    group's full softmax (m/l normalizers are per head) and the
+    outputs concatenate back over the head axis."""
+    # jax.shard_map graduated from jax.experimental between releases;
+    # accept either spelling (the experimental checker needs
+    # check_rep=False to admit pallas_call — same note as
+    # ring_attention).
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+        extra = {}
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        extra = {"check_rep": False}
+
+    q_spec, kv_spec = head_axis_specs
+    rep = jax.sharding.PartitionSpec()
+
+    def tree_spec(operand):
+        if isinstance(operand, dict):
+            return {name: kv_spec for name in operand}
+        return kv_spec
+
+    mapped = _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(q_spec, tree_spec(k), tree_spec(v),
+                  *([rep] * len(extras))),
+        out_specs=q_spec,
+        **extra,
+    )
+    return mapped(q, k, v, *extras)
+
+
+def decode_attention_tp(
+    mesh, q, k, v, mask, *, scale=None, block_k: int = 512,
+    interpret: bool = False, axis: str = "model",
+):
+    """:func:`decode_attention` under model-axis tensor parallelism:
+    q ``[B, 1, H, D]`` and the cache operands ``[B, L, KVH, D]`` are
+    head-sharded over ``axis``; the mask is replicated. Requires the
+    axis size to divide KVH (the caller falls back to the unwrapped
+    kernel otherwise — GSPMD then decides, as before)."""
+    P = jax.sharding.PartitionSpec
+    return _head_sharded_call(
+        mesh,
+        lambda q_, k_, v_, m_: decode_attention(
+            q_, k_, v_, m_, scale=scale, block_k=block_k,
+            interpret=interpret,
+        ),
+        q, k, v,
+        (P(None, None, axis, None), P(None, None, axis, None)),
+        (mask,),
+    )
+
+
+def paged_decode_attention_tp(
+    mesh, q, k, v, table, mask, *, scale=None, interpret: bool = False,
+    axis: str = "model",
+):
+    """:func:`paged_decode_attention` under model-axis TP: the pools
+    ``[P, page, KVH, D]`` shard on their head axis, the page table and
+    mask replicate (page ids are head-invariant — every shard walks
+    the same table over its own head slice of the pool)."""
+    P = jax.sharding.PartitionSpec
+    return _head_sharded_call(
+        mesh,
+        lambda q_, k_, v_, t_, m_: paged_decode_attention(
+            q_, k_, v_, t_, m_, scale=scale, interpret=interpret,
+        ),
+        q, k, v,
+        (P(None, None, axis, None), P(None, None, axis, None)),
+        (table, mask),
+    )

@@ -107,6 +107,111 @@ def kv_is_quantized_layer(layer: dict) -> bool:
     return "k_q" in layer
 
 
+# --- paged KV-cache layout ---------------------------------------------
+#
+# The contiguous layouts above allocate one [B, L, H, D] buffer per
+# batch slot, sized to the slot's whole cache TIER — every sequence
+# pays for its padded tier length, and a shared prefix is COPIED into
+# every row. The paged layout breaks the cache into fixed-size pages
+# and adds one indirection: a per-layer device POOL of pages plus a
+# per-row PAGE TABLE mapping virtual tiles to pool pages. A paged
+# layer reuses the contiguous key names with pool-shaped leaves and
+# carries the table alongside:
+#
+#   ``{"k": [P, page, H, D], "v": ..., "table": int32[B, NP]}``
+#   (int8: the payload+scale quartet with the same pool leading dims)
+#
+# so ``kv_is_quantized_layer`` keeps working and the presence of
+# ``"table"`` is the ONE paged predicate. Virtual slot ``v`` of row
+# ``b`` lives at ``pool[table[b, v // page], v % page]``; page id 0 is
+# the permanently-reserved NULL page — unallocated table entries point
+# at it, its reads are always masked (a row only reads slots it
+# wrote), and dummy/finished rows write their dead tokens into it.
+# Allocation, refcounts, sharing and copy-on-write are HOST metadata
+# (serving/paged_pool.py); these seams only do the device arithmetic.
+
+KV_PAGED_NULL = 0  # reserved pool page id: unallocated / dead writes
+
+
+def kv_is_paged_layer(layer: dict) -> bool:
+    """Is this per-layer cache dict in the paged (pool + page-table)
+    layout?"""
+    return isinstance(layer, dict) and "table" in layer
+
+
+def kv_layer_page_size(layer: dict) -> int:
+    """Tokens per page of a paged layer (pool dim 1)."""
+    leaf = layer["k_q"] if kv_is_quantized_layer(layer) else layer["k"]
+    return leaf.shape[1]
+
+
+def _paged_coords(layer: dict, pos, u: int):
+    """``(pids, offs)`` both ``[B, u]`` for virtual slots
+    ``[pos, pos+u)`` (``pos`` scalar or ``[B]``) of every row."""
+    table = layer["table"]
+    page = kv_layer_page_size(layer)
+    b = table.shape[0]
+    posv = pos[:, None] if jnp.ndim(pos) else pos
+    vpos = jnp.broadcast_to(posv + jnp.arange(u)[None, :], (b, u))
+    pids = jnp.take_along_axis(table, vpos // page, axis=1)
+    return pids, vpos % page
+
+
+def make_paged_pools(model, num_pages: int, page_size: int) -> dict:
+    """Device page pools for every layer of ``model``'s cache format:
+    each contiguous ``[1, page, H, D]``-shaped leaf becomes a
+    ``[num_pages, page, H, D]`` pool (scales ride along for int8).
+    Page 0 is the null page — callers must never allocate it."""
+    proto = jax.eval_shape(lambda: model.init_cache(1, page_size))
+    return {
+        ln: {
+            name: jnp.zeros((num_pages,) + leaf.shape[1:], leaf.dtype)
+            for name, leaf in layer.items()
+        }
+        for ln, layer in proto.items()
+    }
+
+
+def paged_cache_tree(pools: dict, table) -> dict:
+    """Assemble the paged cache pytree a decode/extend program takes:
+    every layer's pool leaves plus that layer's page-table mirror.
+    ``table`` is the HOST ``[B, NP]`` int32 array (the source of
+    truth); each layer gets its OWN device upload — donated programs
+    reject the same buffer appearing twice, and per-layer ``[B, NP]``
+    int32 uploads are noise next to one cache read. ``pools`` may be
+    bare pool layers or a previous program's returned cache (stale
+    tables are replaced)."""
+    host = np.asarray(table, np.int32)
+    return {
+        ln: {
+            **{n: a for n, a in layer.items() if n != "table"},
+            "table": jnp.asarray(host),
+        }
+        for ln, layer in pools.items()
+    }
+
+
+def paged_pools_of(cache: dict) -> dict:
+    """Inverse of :func:`paged_cache_tree`: strip the table mirrors,
+    keeping the (possibly donated-updated) pool arrays."""
+    return {
+        ln: {n: a for n, a in layer.items() if n != "table"}
+        for ln, layer in cache.items()
+    }
+
+
+def kv_page_bytes(model, page_size: int) -> int:
+    """Exact per-page device bytes across every layer — pure
+    dtype/shape arithmetic (the capacity-model unit the paged bench
+    asserts against, never wall-clock)."""
+    proto = jax.eval_shape(lambda: model.init_cache(1, page_size))
+    return sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for layer in proto.values()
+        for leaf in layer.values()
+    )
+
+
 def kv_quantize(x):
     """``[..., D]`` float K or V block → ``(q int8[..., D],
     scale f32[..., 1])``, symmetric per-token-per-head (amax over the
@@ -152,6 +257,22 @@ def kv_cache_append(layer: dict, k_new, v_new, pos, cdt) -> dict:
     else:
         updates = {"k": k_new.astype(cdt), "v": v_new.astype(cdt)}
 
+    if kv_is_paged_layer(layer):
+        # Paged write: ONE scatter per leaf lands every row's block at
+        # its table-mapped pool coordinates — scalar and per-row pos,
+        # single-token and U-token blocks, all through the same index
+        # arithmetic (a block may span pages; the [B, U] coordinate
+        # arrays express that for free). Rows whose table entry is the
+        # null page (dummies, finished rows) scatter their dead tokens
+        # there; null-page slots are never read unmasked.
+        pids, offs = _paged_coords(layer, pos, k_new.shape[1])
+        out = {"table": layer["table"]}
+        for name, upd in updates.items():
+            out[name] = layer[name].at[pids, offs].set(
+                upd.astype(layer[name].dtype)
+            )
+        return out
+
     if jnp.ndim(pos):
         row_write = jax.vmap(
             lambda c, n, p: jax.lax.dynamic_update_slice(
@@ -174,7 +295,29 @@ def kv_cache_kv(layer: dict, cdt):
     """The attention-read seam: a cache layer → ``(k, v)`` in the
     compute dtype. Quantized layers dequantize here, INSIDE the jitted
     program, right at the einsum operand — see :func:`kv_dequantize`
-    for why this reads int8 from HBM, not floats."""
+    for why this reads int8 from HBM, not floats. Paged layers GATHER
+    their pool pages into the contiguous ``[B, L, H, D]`` oracle
+    layout first (``pool[table]`` + reshape) — the einsum decode path
+    over a paged cache is the contiguous reference with one extra
+    gather, which is exactly what makes it the parity oracle for the
+    page-table flash kernel (the kernel reads the pages in place)."""
+    if kv_is_paged_layer(layer):
+        table = layer["table"]
+
+        def gather(pool):
+            g = pool[table]  # [B, NP, page, ...]
+            return g.reshape((g.shape[0], -1) + g.shape[3:])
+
+        if kv_is_quantized_layer(layer):
+            return (
+                kv_dequantize(
+                    gather(layer["k_q"]), gather(layer["k_scale"]), cdt
+                ),
+                kv_dequantize(
+                    gather(layer["v_q"]), gather(layer["v_scale"]), cdt
+                ),
+            )
+        return gather(layer["k"]), gather(layer["v"])
     if kv_is_quantized_layer(layer):
         return (
             kv_dequantize(layer["k_q"], layer["k_scale"], cdt),
@@ -184,9 +327,14 @@ def kv_cache_kv(layer: dict, cdt):
 
 
 def kv_cache_seq_len(cache: dict) -> int:
-    """Static sequence capacity of a cache pytree, either format."""
+    """Static sequence capacity of a cache pytree, any layout: the
+    contiguous buffer length, or pages-per-row x page size for the
+    paged layout (the VIRTUAL length every mask/position helper sees —
+    paging changes where bytes live, never the slot arithmetic)."""
     layer = cache["layer_0"]
     leaf = layer["k_q"] if kv_is_quantized_layer(layer) else layer["k"]
+    if kv_is_paged_layer(layer):
+        return layer["table"].shape[1] * leaf.shape[1]
     return leaf.shape[1]
 
 

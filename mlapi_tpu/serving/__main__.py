@@ -155,6 +155,10 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--kv-quant", args.kv_quant]
     if getattr(args, "decode_attn_impl", None):
         cmd += ["--decode-attn-impl", args.decode_attn_impl]
+    if getattr(args, "kv_page_size", None):
+        cmd += ["--kv-page-size", str(args.kv_page_size)]
+    if getattr(args, "kv_pages", None):
+        cmd += ["--kv-pages", str(args.kv_pages)]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -276,6 +280,26 @@ def main(argv=None) -> None:
              "impl",
     )
     parser.add_argument(
+        "--kv-page-size", type=int, default=None,
+        help="paged KV cache: allocate decode caches as fixed-size "
+             "pages of this many tokens from a device-resident pool "
+             "(page tables per sequence) instead of contiguous "
+             "per-slot tier buffers — near-zero padding waste, "
+             "ref-counted shared prefix pages with copy-on-write, "
+             "O(table) batch growth/compaction. Token streams are "
+             "pinned identical to contiguous allocation; composes "
+             "with --kv-quant and --decode-attn-impl flash (the "
+             "kernel reads pages via a page-table index map). "
+             "Generative checkpoints only",
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=None,
+        help="with --kv-page-size: total pool pages (default: the "
+             "contiguous-equivalent budget — max_batch slots at the "
+             "default cache tier). A full pool rejects loudly; watch "
+             "generate.kv_page_utilization on /metrics",
+    )
+    parser.add_argument(
         "--draft-checkpoint", default=None,
         help="speculative decoding: a smaller same-tokenizer "
              "checkpoint whose proposals the target verifies in one "
@@ -393,6 +417,8 @@ def main(argv=None) -> None:
         ckpt, quantize=args.quantize,
         kv_quant=args.kv_quant,
         decode_attn_impl=args.decode_attn_impl,
+        kv_page_size=args.kv_page_size,
+        kv_pages=args.kv_pages,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,

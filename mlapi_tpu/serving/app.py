@@ -624,6 +624,26 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["gauges"]["generate.decode_bytes_per_step"] = (
                 engine.decode_bytes_per_step()
             )
+            if getattr(engine, "pool", None) is not None:
+                # Paged KV pool observability: capacity headroom
+                # (total vs in_use), how much of the live footprint is
+                # prefix sharing (shared), and the utilization ratio —
+                # the "do I need more --kv-pages" dashboard block.
+                snap["gauges"]["generate.kv_pages_total"] = (
+                    engine.kv_pages_total
+                )
+                snap["gauges"]["generate.kv_pages_in_use"] = (
+                    engine.kv_pages_in_use
+                )
+                snap["gauges"]["generate.kv_pages_shared"] = (
+                    engine.kv_pages_shared
+                )
+                snap["gauges"]["generate.kv_page_utilization"] = (
+                    engine.kv_page_utilization
+                )
+                snap["gauges"]["generate.kv_page_bytes"] = (
+                    engine.kv_page_bytes()
+                )
         return snap
 
     return app

@@ -120,16 +120,40 @@ class BatchRun:
         for i, r in enumerate(reqs):
             self.lo[i] = self.p_len - r.prefix_len + r.prefix_lo
 
-        first = self._prefill()
-        self.pos = self.p_len + self.bucket
-        # rows[i]: request i's current row in the (possibly resized)
-        # device batch. Rows are independent (per-row mask/positions/
-        # PRNG streams), so gathering live rows into a different-size
-        # warmed program changes nothing but cost.
-        self.rows: list = list(range(b))
-        self.b_cur = b_pad
-        self._first_token(first)
-        self.chain = DispatchChain(self._deliver)
+        # Paged mode: the device batch state is (pool arrays, HOST
+        # page table). ``tab[row, i]`` maps virtual tile i of device
+        # row ``row`` to a pool page (0 = the unallocated null page);
+        # it is re-uploaded into the cache pytree whenever it changes
+        # (``_tab_dirty``). Page lifecycle (alloc/COW/release) is host
+        # bookkeeping against ``eng.pool``.
+        self.pool = eng.pool
+        self.page = self.pool.page if self.pool is not None else 0
+        self.npv = (
+            -(-self.total // self.page) if self.pool is not None else 0
+        )
+        self.tab = (
+            np.zeros((b_pad, self.npv), np.int32)
+            if self.pool is not None else None
+        )
+        self._tab_dirty = False
+        try:
+            first = self._prefill()
+            self.pos = self.p_len + self.bucket
+            # rows[i]: request i's current row in the (possibly
+            # resized) device batch. Rows are independent (per-row
+            # mask/positions/PRNG streams), so gathering live rows
+            # into a different-size warmed program changes nothing
+            # but cost.
+            self.rows: list = list(range(b))
+            self.b_cur = b_pad
+            self._first_token(first)
+            self.chain = DispatchChain(self._deliver)
+        except BaseException:
+            # Formation failed (incl. a loud PagePoolExhausted before
+            # any dispatch): give every held page back — the wrapper
+            # delivers the error to the waiters.
+            self._paged_cleanup(write_back=False)
+            raise
 
     # -- formation ----------------------------------------------------
 
@@ -140,6 +164,8 @@ class BatchRun:
         bucket, total = self.bucket, self.total
         from mlapi_tpu.models.gpt import prefill_fn, prefix_prefill_fn
 
+        if self.pool is not None:
+            return self._prefill_paged()
         if self.p_len:
             # Shared-prefix batch: the prefix KV is scattered into
             # every row and only the suffix block is computed — the
@@ -200,6 +226,230 @@ class BatchRun:
             )
         return first
 
+    # -- paged formation + page lifecycle ------------------------------
+
+    def _alloc_rows(self, rows, lo_slot: int, hi_slot: int) -> None:
+        """Allocate pool pages covering virtual slots
+        ``[lo_slot, hi_slot)`` for the given device rows, skipping
+        tiles already mapped. THE paged capacity lever: a row only
+        ever holds pages covering slots it has actually reached, so
+        padding waste is bounded by one page per row instead of the
+        tier remainder. Raises :class:`PagePoolExhausted` BEFORE any
+        device work, so a loud reject leaves the pool consistent."""
+        if hi_slot <= lo_slot:
+            return
+        want: list[tuple[int, int]] = []
+        for row in rows:
+            for i in range(lo_slot // self.page,
+                           -(-hi_slot // self.page)):
+                if self.tab[row, i] == 0:
+                    want.append((row, i))
+        if not want:
+            return
+        pages = self.pool.alloc(len(want))
+        for (row, i), pid in zip(want, pages):
+            self.tab[row, i] = pid
+        self._tab_dirty = True
+
+    def _release_row(self, row: int) -> None:
+        """Zero a device row's table and drop its page holds (shared
+        prefix pages just lose one reference). In-flight chunks may
+        still WRITE the released pages through the old device table —
+        that is safe by the layout invariant that a row only READS
+        (unmasked) slots it wrote itself: stale bytes land in slots a
+        future owner has either not yet written (still masked for it)
+        or will overwrite before its ``pos`` reaches them."""
+        if self.tab[row].any():
+            self.pool.release(self.tab[row])
+            self.tab[row] = 0
+            self._tab_dirty = True
+
+    def _paged_cleanup(self, write_back: bool = True) -> None:
+        """End-of-batch page release + pool write-back (idempotent;
+        also the error path's safety net). ``write_back`` re-binds the
+        engine pool's device arrays from the batch's last cache pytree
+        — skipped when formation failed before a cache existed."""
+        if self.pool is None or self.tab is None:
+            return
+        for row in range(len(self.tab)):
+            self._release_row(row)
+        if write_back and getattr(self, "cache", None) is not None:
+            from mlapi_tpu.ops.quant import paged_pools_of
+
+            self.pool.layers = paged_pools_of(self.cache)
+
+    def _with_tables(self) -> None:
+        """Re-upload the host page table into every layer of the cache
+        pytree (each layer gets its own device copy — donation forbids
+        one buffer appearing twice)."""
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        self.cache = paged_cache_tree(self.cache, self.tab[:self.b_cur])
+        self._tab_dirty = False
+
+    def _ensure_pages(self, size: int, live: list) -> None:
+        """Chunk-boundary page allocation: the next ``size`` decode
+        steps write slots ``[pos, pos+size)`` — map them for every
+        live row (dummy and finished rows write into the null page).
+        Also flushes any pending host-table change to the device
+        mirrors before the dispatch reads them."""
+        self._alloc_rows(
+            sorted({self.rows[i] for i in live}),
+            self.pos, min(self.pos + size, self.total),
+        )
+        if self._tab_dirty:
+            self._with_tables()
+
+    def _prefill_paged(self):
+        """Paged formation: page-table setup (host) + prefill via the
+        paged program set. Plain batches keep the contiguous
+        bucket-length prefill program and ADOPT its cache into freshly
+        allocated pages (one extra copy of the bytes prefill just
+        wrote); chunked long prompts extend straight into the paged
+        cache; prefix batches point their table rows at the entry's
+        shared pages (ref-counted) and only compute the suffix —
+        nothing copies the prefix anymore."""
+        eng = self.eng
+        bucket = self.bucket
+        import jax.numpy as jnp
+
+        from mlapi_tpu.models.gpt import (
+            paged_extend_fn, paged_scatter_fn, prefill_fn, sample_fn,
+        )
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        if self.p_len:
+            return self._prefill_paged_prefix()
+        cp = eng.prompt_buckets[-1]
+        if bucket > cp and bucket % cp == 0:
+            # Chunked long-prompt prefill, page-native: extend_core
+            # writes every block straight into pool pages.
+            self._alloc_rows(range(self.b), 0, bucket)
+            self.cache = paged_cache_tree(
+                eng.pool.layers, self.tab
+            )
+            self._tab_dirty = False
+            n_pad_j = jnp.asarray(self.n_pad)
+            logits = None
+            for c0 in range(0, bucket, cp):
+                eng.prefill_chunks += 1
+                self.cache, logits = paged_extend_fn(eng.model, cp)(
+                    eng.params, self.cache,
+                    jnp.asarray(self.prompt[:, c0:c0 + cp]),
+                    jnp.int32(c0), n_pad_j, jnp.int32(0), jnp.int32(0),
+                )
+            return sample_fn(eng.model)(
+                logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
+                jnp.asarray(self.topk), jnp.asarray(self.topp),
+            )
+        # Plain: the bucket-length contiguous prefill (the same
+        # program admission warms), adopted into pages.
+        first, mini = prefill_fn(eng.model, bucket)(
+            eng.params, jnp.asarray(self.prompt),
+            jnp.asarray(self.keys), jnp.asarray(self.temps),
+            jnp.asarray(self.n_pad), jnp.asarray(self.topk),
+            jnp.asarray(self.topp),
+        )
+        self._alloc_rows(range(self.b), 0, bucket)
+        self.cache = paged_cache_tree(eng.pool.layers, self.tab)
+        self._tab_dirty = False
+        self.cache = paged_scatter_fn()(
+            self.cache, mini, jnp.asarray(self.tab), jnp.int32(0)
+        )
+        return first
+
+    def _prefill_paged_prefix(self):
+        """Paged shared-prefix formation. Same-fp batches SHARE the
+        entry's pool pages: every live row's table points at them
+        (one reference each), a partial last page is copied-on-write
+        per row (the suffix's first tokens land mid-page), and only
+        the suffix block is computed — the per-row prefix broadcast
+        copy of the contiguous path is gone. Cross-prefix (stacked)
+        batches keep the copy semantics for now: each row's widened
+        prefix KV adopts into private pages (regions right-aligned to
+        the group end are sub-page shifts of each other, which page
+        identity cannot express — DESIGN §15 notes the aligned-share
+        follow-up)."""
+        eng, reqs = self.eng, self.reqs
+        import jax.numpy as jnp
+
+        from mlapi_tpu.models.gpt import (
+            paged_cow_fn, paged_extend_fn, paged_scatter_fn, sample_fn,
+        )
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        P, page = self.p_len, self.page
+        npp = -(-P // page)
+        # HOST PHASE first — every allocation that can raise
+        # PagePoolExhausted happens before any donating device call,
+        # so a loud reject can never leave the engine pool bound to
+        # consumed buffers.
+        adopt = None
+        srcs, dsts = [], []
+        if not self.mixed_prefix:
+            # holds=b: every live row's reference is taken atomically
+            # with the entry lookup — a concurrent LRU eviction of
+            # this entry can then only drop the ENTRY's own hold.
+            entry_pages, need_adopt = eng.prefix.paged_entry(
+                reqs[0].prefix_fp, reqs[0].prefix_kv, holds=self.b
+            )
+            if need_adopt:
+                adopt = (reqs[0].prefix_kv, entry_pages)
+            for i in range(self.b):
+                self.tab[i, :npp] = entry_pages
+                if P % page:
+                    # The entry's last page is partially prefix: this
+                    # row's suffix will write into it, so diverge it
+                    # by COW — one page copied per row, not one cache.
+                    own = self.eng.pool.alloc(1)[0]
+                    srcs.append(int(entry_pages[-1]))
+                    dsts.append(int(own))
+                    self.eng.pool.release([entry_pages[-1]])
+                    self.tab[i, npp - 1] = own
+        else:
+            # Copy path: widened per-row stacks into private pages.
+            self._alloc_rows(range(self.b), 0, npp * page)
+        # Suffix pages behind the prefix region.
+        self._alloc_rows(range(self.b), npp * page, P + self.bucket)
+
+        # DEVICE PHASE: adopt/copy/COW scatters, then ONE fused block
+        # forward of the suffix against the shared pages.
+        self.cache = paged_cache_tree(eng.pool.layers, self.tab)
+        self._tab_dirty = False
+        if self.mixed_prefix:
+            stack = eng.prefix.stacked(reqs, P, self.b_pad)
+            self.cache = paged_scatter_fn()(
+                self.cache, stack, jnp.asarray(self.tab[:, :npp]),
+                jnp.int32(0),
+            )
+        if adopt is not None:
+            kv, entry_pages = adopt
+            tab1 = np.zeros((1, len(entry_pages)), np.int32)
+            tab1[0] = entry_pages
+            self.cache = paged_scatter_fn()(
+                self.cache, kv, jnp.asarray(tab1), jnp.int32(0)
+            )
+        if srcs:
+            self.eng.pool.cow_copies += len(srcs)
+            self.cache = paged_cow_fn()(
+                self.cache,
+                jnp.asarray(np.asarray(srcs, np.int32)),
+                jnp.asarray(np.asarray(dsts, np.int32)),
+            )
+        lo_arg = (
+            jnp.asarray(self.lo) if self.mixed_prefix
+            else jnp.int32(self.p_lo)
+        )
+        self.cache, logits = paged_extend_fn(eng.model, self.bucket)(
+            eng.params, self.cache, jnp.asarray(self.prompt),
+            jnp.int32(P), jnp.asarray(self.n_pad), jnp.int32(P),
+            lo_arg,
+        )
+        return sample_fn(eng.model)(
+            logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
+            jnp.asarray(self.topk), jnp.asarray(self.topp),
+        )
+
     def _first_token(self, first) -> None:
         """Decide the first token's delivery: the speculative phase
         reads/writes the host token mirror, so spec-eligible batches
@@ -211,6 +461,14 @@ class BatchRun:
         temps, topk, topp = self.temps, self.topk, self.topp
         self.spec_eligible = (
             eng.draft_model is not None
+            # Paged batches decline the speculative phases for now:
+            # the spec handoff's per-row cache REALIGN (realign_fn's
+            # roll) and the draft-mirror machinery are contiguous
+            # programs, and rolling a paged row is a repack, not a
+            # table op. Paging targets the many-slot capacity regime;
+            # speculation targets solo-stream latency — a deployment
+            # picks its lever (ROADMAP notes the composition).
+            and self.pool is None
             and b == 1 and self.p_len == 0
             and not reqs[0].cancelled
             and (
@@ -228,6 +486,7 @@ class BatchRun:
         # verify block.
         self.spec_batched = (
             eng.draft_model is not None
+            and self.pool is None  # same decline as spec_eligible
             and b > 1 and self.p_len == 0
             and bool(
                 np.all(temps[:b] <= 0.0)
@@ -448,18 +707,30 @@ class BatchRun:
                 # camping in the staging list where it would block
                 # compaction and draining.
                 b_t = self.b_cur * 2 if grow else self.b_cur
-                blocked = bkt not in eng._warmed_joiner or (
-                    not eng._admit_eager
-                    and (
-                        (bkt, self.total, b_t)
-                        not in eng._warmed_scatter
-                        or (
-                            grow
-                            and (self.b_cur, self.b_cur * 2, self.total)
-                            not in eng._warmed_growth
+                if self.pool is not None:
+                    # Paged: growth is a host table op (nothing to
+                    # warm) and the admission scatter is keyed on
+                    # (bucket, table width) — batch-size-free.
+                    blocked = bkt not in eng._warmed_joiner or (
+                        not eng._admit_eager
+                        and (bkt, self.npv) not in eng._warmed_scatter
+                    )
+                else:
+                    blocked = bkt not in eng._warmed_joiner or (
+                        not eng._admit_eager
+                        and (
+                            (bkt, self.total, b_t)
+                            not in eng._warmed_scatter
+                            or (
+                                grow
+                                and (
+                                    self.b_cur, self.b_cur * 2,
+                                    self.total,
+                                )
+                                not in eng._warmed_growth
+                            )
                         )
                     )
-                )
                 if blocked:
                     self._unstage(cand)
                     with eng._alock:
@@ -486,17 +757,48 @@ class BatchRun:
                 sel = np.concatenate(
                     [np.arange(self.b_cur), np.zeros(self.b_cur)]
                 ).astype(np.int32)
-                self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
+                if self.pool is not None:
+                    # Paged growth moves ZERO cache bytes: the new
+                    # dummy rows get null page tables (their dead
+                    # writes land in the null page — duplicating row
+                    # 0's TABLE would alias its live pages) and only
+                    # the host mirrors double. O(table), the claim.
+                    self.tab = np.vstack(
+                        [self.tab, np.zeros_like(self.tab)]
+                    )
+                    self._tab_dirty = True
+                else:
+                    self.cache = _compact_fn()(
+                        self.cache, jnp.asarray(sel)
+                    )
+                    eng._warmed_growth.add(
+                        (self.b_cur, self.b_cur * 2, self.total)
+                    )
                 self._mirrors_take(sel)
                 self.n_pad[self.b_cur:] = self.pos  # mask dummies fully
                 self.temps[self.b_cur:] = 0.0
                 self.b_cur *= 2
                 free = list(range(self.b_cur // 2, self.b_cur))
-                eng._warmed_growth.add(
-                    (self.b_cur // 2, self.b_cur, self.total)
-                )
                 eng.growths += 1
             row = free[0]
+            if self.pool is not None:
+                from mlapi_tpu.serving.paged_pool import (
+                    PagePoolExhausted,
+                )
+
+                # The row may still hold a finished request's pages;
+                # its slots restart at the joiner's region.
+                self._release_row(row)
+                try:
+                    self._alloc_rows([row], self.pos - bkt, self.pos)
+                except PagePoolExhausted:
+                    # Not an error: the pool is momentarily full of
+                    # live sequences — hand the joiner to the next
+                    # batch instead of killing this one.
+                    self._unstage(cand)
+                    with eng._alock:
+                        eng._deferred.append(cand)
+                    continue
             first1, mini = prefill_fn(eng.model, bkt)(
                 eng.params, jnp.asarray(cand.row[None]),
                 jnp.asarray(eng._key_data(cand.seed)[None]),
@@ -511,11 +813,23 @@ class BatchRun:
                     np.asarray([cand.top_p], np.float32)
                 ),
             )
-            self.cache = admit_scatter_fn()(
-                self.cache, mini, jnp.int32(row),
-                jnp.int32(self.pos - bkt),
-            )
-            eng._warmed_scatter.add((bkt, self.total, self.b_cur))
+            if self.pool is not None:
+                from mlapi_tpu.models.gpt import paged_scatter_fn
+
+                if self._tab_dirty:
+                    self._with_tables()
+                self.cache = paged_scatter_fn()(
+                    self.cache, mini,
+                    jnp.asarray(self.tab[row:row + 1]),
+                    jnp.int32(self.pos - bkt),
+                )
+                eng._warmed_scatter.add((bkt, self.npv))
+            else:
+                self.cache = admit_scatter_fn()(
+                    self.cache, mini, jnp.int32(row),
+                    jnp.int32(self.pos - bkt),
+                )
+                eng._warmed_scatter.add((bkt, self.total, self.b_cur))
             ftok = int(np.asarray(first1)[0])
             self.n_pad[row] = self.pos - cand.used
             self.temps[row] = cand.temperature
@@ -561,7 +875,8 @@ class BatchRun:
         # instead (correct, just less compact). Shapes prove
         # themselves as warmup and low-RTT runs execute them.
         resize_ok = (
-            not eng._strict_admit
+            self.pool is not None  # paged: no gather program to warm
+            or not eng._strict_admit
             or eng._admit_eager
             or (self.b_cur, want_b, self.total) in eng._warmed_shrink
         )
@@ -570,9 +885,27 @@ class BatchRun:
             sel = [self.rows[i] for i in live]
             sel += [sel[0]] * (want_b - len(sel))
             sel = np.asarray(sel, np.int32)
-            self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
-            eng._warmed_shrink.add((self.b_cur, want_b, self.total))
-            self._mirrors_take(sel)
+            if self.pool is not None:
+                # Paged compaction is O(table), not O(bytes): dropped
+                # rows release their page holds (host refcounts), the
+                # table gathers the survivors, and NO cache payload
+                # moves. Pad rows get null tables (a duplicated table
+                # row would alias live pages) and are masked fully so
+                # their dead writes stay in the null page.
+                keep = {self.rows[i] for i in live}
+                for row in range(self.b_cur):
+                    if row not in keep:
+                        self._release_row(row)
+                self.tab = self.tab[sel]
+                self.tab[len(live):] = 0
+                self._tab_dirty = True
+                self._mirrors_take(sel)
+                self.n_pad[len(live):] = self.pos
+                self.temps[len(live):] = 0.0
+            else:
+                self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
+                eng._warmed_shrink.add((self.b_cur, want_b, self.total))
+                self._mirrors_take(sel)
             self.rows = [None] * len(self.reqs)
             for row, i in enumerate(live):
                 self.rows[i] = row
@@ -637,6 +970,17 @@ class BatchRun:
     # -- the loop -----------------------------------------------------
 
     def run(self) -> None:
+        try:
+            self._run()
+        finally:
+            # Paged: give every page back (shared prefix pages lose
+            # one hold per row) and re-bind the engine pool's device
+            # arrays from the batch's final cache — the pool outlives
+            # the batch; that persistence is what makes prefix pages
+            # shareable ACROSS batches.
+            self._paged_cleanup()
+
+    def _run(self) -> None:
         eng, reqs, chain = self.eng, self.reqs, self.chain
         self._spec_handoff()
 
@@ -658,6 +1002,21 @@ class BatchRun:
                 i for i, r in enumerate(reqs)
                 if not self._sdone(i) and not r.cancelled
             ]
+            if self.pool is not None:
+                # Free finished/cancelled rows' pages EAGERLY (their
+                # tables go null, so any still-chained writes for them
+                # land in the null page) — under pool pressure a long
+                # batch must not sit on dead sequences' pages.
+                for i, r in enumerate(reqs):
+                    row = self.rows[i]
+                    if row is not None and (self.done[i] or r.cancelled):
+                        self._release_row(row)
+                        # Drop the mapping: the row may be reused by a
+                        # joiner, and this request must never release
+                        # the NEW owner's pages on a later sweep. (No
+                        # pending chunk still lists a done row — its
+                        # dispatch frontier was exhausted first.)
+                        self.rows[i] = None
             if not live:
                 # Every remaining consumer disconnected, finished, or
                 # is fully covered by in-flight chunks: deliver what's
@@ -696,6 +1055,12 @@ class BatchRun:
                 chain.drain()
                 break  # cache exhausted — safety net below
             self._maybe_shrink(live, pending_n)
+            if self.pool is not None:
+                # Map the chunk's write range to pool pages (and push
+                # any table change to the device mirrors) BEFORE the
+                # dispatch — a pool-exhausted batch fails loudly here,
+                # with the pool metadata still consistent.
+                self._ensure_pages(size, live)
             self._decode_chunk(size, live)
         chain.drain()
         # Safety net: every waiter MUST get a terminator. The

@@ -134,6 +134,8 @@ class InferenceEngine:
         quantize: str | None = None,
         kv_quant: str | None = None,
         decode_attn_impl: str | None = None,
+        kv_page_size: int | None = None,
+        kv_pages: int | None = None,
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -173,6 +175,17 @@ class InferenceEngine:
         saving reaches the decode READ, not just storage. A model
         field like ``kv_quant`` (program factories key on it; the
         draft mirrors it); generative checkpoints only.
+
+        ``kv_page_size=N`` switches serving KV allocation from
+        contiguous per-slot tier buffers to the block-granular paged
+        pool (``kv_pages`` sizes it; defaults to the
+        contiguous-equivalent budget): sequences hold only the pages
+        covering their actual length, shared prefixes become
+        ref-counted shared pages with copy-on-write divergence, and
+        batch growth/compaction become page-table bookkeeping instead
+        of cache gathers. Token streams are pinned identical to the
+        contiguous layout across both ``kv_quant`` formats and both
+        decode impls (DESIGN §15). Generative checkpoints only.
         """
         import dataclasses
 
@@ -308,15 +321,24 @@ class InferenceEngine:
                 draft=draft,
                 spec_sample=spec_sample,
                 fused_batch=fused_batch,
+                kv_page_size=kv_page_size,
+                kv_pages=kv_pages,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
                       **({"decode_attn_impl": decode_attn_impl}
                          if decode_attn_impl else {}),
+                      **({"kv_page_size": kv_page_size}
+                         if kv_page_size else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
 
+        if kv_page_size is not None or kv_pages is not None:
+            raise ValueError(
+                "kv_page_size/kv_pages apply to generative checkpoints "
+                f"(they hold KV caches); {type(inner).__name__} has none"
+            )
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
         feature_names = meta.config.get("feature_names", feature_names)
@@ -539,6 +561,8 @@ class TextGenerationEngine:
         fused_single: bool = True,
         fused_max_new: int | None = None,
         fused_batch: bool | str = "auto",
+        kv_page_size: int | None = None,
+        kv_pages: int | None = None,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -625,6 +649,32 @@ class TextGenerationEngine:
                 "fused_single=False disables every fused program"
             )
         self.fused_batch = fused_batch
+        if mesh is not None and getattr(
+            model, "decode_attn_impl", "einsum"
+        ) == "flash" and "model" in getattr(
+            mesh, "axis_names", ()
+        ) and mesh.shape["model"] > 1:
+            # Model-axis TP + flash decode: pin the mesh ON the model
+            # so ``cached_attend`` wraps the opaque ``pallas_call`` in
+            # an explicit ``shard_map`` over the head axis — GSPMD
+            # cannot see into the kernel and might otherwise
+            # all-gather the head-sharded cache operands around it
+            # (ROADMAP open item). The field already exists (ring
+            # attention uses it); program factories key on it for
+            # free. The draft mirrors the move below.
+            import dataclasses
+
+            try:
+                model = dataclasses.replace(model, mesh=mesh)
+            except TypeError:
+                pass  # wrapped/legacy models: GSPMD decides, as before
+            if self.draft_model is not None:
+                try:
+                    self.draft_model = dataclasses.replace(
+                        self.draft_model, mesh=mesh
+                    )
+                except TypeError:
+                    pass
         self.model = model
         self.tokenizer = tokenizer
         self.mesh = mesh
@@ -657,6 +707,32 @@ class TextGenerationEngine:
                 chunk, rtt_ms,
             )
         self.chunk = max(1, int(chunk))
+        # Paged KV cache: a device-resident pool of fixed-size pages +
+        # per-row page tables replaces per-slot contiguous tier
+        # buffers (serving/paged_pool.py; DESIGN §15). Opt-in via
+        # kv_page_size; kv_pages defaults to the contiguous-equivalent
+        # budget (every slot at the default tier) so flipping paging
+        # on never costs MORE HBM — the win is that short/ragged
+        # sequences stop paying their padded tier and shared prefixes
+        # stop being copied per row.
+        if kv_pages is not None and kv_page_size is None:
+            raise ValueError("kv_pages requires kv_page_size")
+        self.pool = None
+        if kv_page_size is not None:
+            from mlapi_tpu.serving.paged_pool import PagePool
+
+            max_total = self._cache_len(
+                self.prompt_buckets[-1], self.default_max_new_tokens
+            )
+            if kv_pages is None:
+                kv_pages = (
+                    self.max_batch * -(-max_total // int(kv_page_size))
+                    + 1  # the reserved null page
+                )
+            self.pool = PagePool(
+                model, page_size=int(kv_page_size),
+                num_pages=int(kv_pages),
+            )
         # KV-cache storage format and decode-attention impl, owned by
         # the MODEL (program factories key on them); mirrored here for
         # /metrics and bench.
@@ -866,6 +942,31 @@ class TextGenerationEngine:
             full if full == stored else stored + full
         )
         return self._decode_step_bytes
+
+    # -- paged-pool accounting (state lives in serving/paged_pool.py) -----
+    @property
+    def kv_pages_total(self) -> int:
+        return self.pool.pages_total if self.pool is not None else 0
+
+    @property
+    def kv_pages_in_use(self) -> int:
+        return self.pool.pages_in_use if self.pool is not None else 0
+
+    @property
+    def kv_pages_shared(self) -> int:
+        return self.pool.pages_shared if self.pool is not None else 0
+
+    @property
+    def kv_page_utilization(self) -> float:
+        return self.pool.utilization if self.pool is not None else 0.0
+
+    def kv_page_bytes(self) -> int:
+        """Exact device bytes of ONE page across every layer (pure
+        dtype/shape arithmetic) — the unit of the paged capacity
+        model: a sequence of ``t`` cached tokens holds
+        ``ceil(t / page)`` pages, so its padding waste is bounded by
+        one page instead of (tier - t) slots."""
+        return self.pool.page_bytes if self.pool is not None else 0
 
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property
@@ -1436,6 +1537,37 @@ class TextGenerationEngine:
             )
             self._warmed_joiner.add(bj)
             shapes += 1
+        if self.pool is not None:
+            # Paged admission: growth and compaction are host-side
+            # page-table ops (no device gather to warm), and the
+            # admission scatter is batch-size-independent — one [1, W]
+            # mini lands in one table row whatever the running batch
+            # is. Warm that one scatter per prompt bucket against the
+            # null page and key the warmed set on (bucket, table
+            # width) — the shape pair the paged scatter compiles on.
+            from mlapi_tpu.models.gpt import paged_scatter_fn
+            from mlapi_tpu.ops.quant import (
+                paged_cache_tree, paged_pools_of,
+            )
+
+            for bj in self.prompt_buckets:
+                for total in {
+                    min(self.model.max_positions, rb + tier)
+                    for rb in self.prompt_buckets
+                }:
+                    if bj >= total:
+                        continue
+                    npv = -(-total // self.pool.page)
+                    tab1 = np.zeros((1, npv), np.int32)
+                    cache = paged_cache_tree(self.pool.layers, tab1)
+                    cache = paged_scatter_fn()(
+                        cache, self.model.init_cache(1, bj),
+                        jnp.asarray(tab1), jnp.int32(0),
+                    )
+                    self.pool.layers = paged_pools_of(cache)
+                    self._warmed_scatter.add((bj, npv))
+                    shapes += 1
+            return shapes
         for run_bucket in self.prompt_buckets:
             total = min(self.model.max_positions, run_bucket + tier)
             if total - run_bucket < 1:

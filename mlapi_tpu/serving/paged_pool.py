@@ -1,0 +1,238 @@
+"""Block-granular KV page pool for generative serving.
+
+The contiguous engine allocates one ``[B, total]`` cache per batch,
+sized to the batch's whole TIER: every sequence pays for its padded
+tier length, batch growth/compaction GATHER the full cache bytes, and
+a shared prefix is broadcast-copied into every row. This module is the
+host half of the paged replacement (the vLLM/PagedAttention move,
+landed on this repo's flash-decode layout): device HBM holds one
+fixed-size POOL of KV pages per layer plus per-row page TABLES, and
+everything that used to move cache payloads — admission rows, batch
+growth, compaction, prefix reuse — becomes page-table bookkeeping
+here, in plain numpy, under one lock.
+
+Division of labor:
+
+- **Device** (``ops/quant`` seams + ``models/gpt`` paged factories +
+  ``ops/pallas`` kernels): pool arrays, scatter/gather/COW-copy
+  programs, the page-table flash-decode kernel. The pool's device
+  arrays live on this object (``layers``) between batches and are
+  DONATED through each batch's programs; only the decode thread may
+  touch them.
+- **Host** (this class): the free list, per-page reference counts,
+  prefix-entry page sets with LRU eviction under pressure, and the
+  observability counters ``/metrics`` exports. All guarded by
+  ``self.lock`` — prefix registration threads mutate metadata
+  concurrently with the decode thread.
+
+Invariants:
+
+- Page id 0 is the NULL page: never allocated, permanently ref-pinned.
+  Unallocated table entries point at it; dummy and finished rows write
+  their dead tokens into it; it is never read unmasked (a row only
+  reads slots it wrote — see DESIGN §15).
+- A page with ``ref == 1`` is privately owned and writable. ``ref >
+  1`` means shared (prefix pages): writers must COW first
+  (``models/gpt.paged_cow_fn`` + a table rewrite).
+- Exhaustion first evicts prefix-entry page sets nobody currently
+  references (LRU), then raises :class:`PagePoolExhausted` — a LOUD
+  reject, never a silent spill.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.paged_pool")
+
+NULL_PAGE = 0
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free KV pages (after prefix eviction): the pool is sized too
+    small for the offered concurrency — a capacity-planning signal,
+    surfaced loudly to every waiter of the batch that hit it."""
+
+
+class PagePool:
+    def __init__(self, model, *, page_size: int, num_pages: int):
+        from mlapi_tpu.ops.quant import kv_page_bytes, make_paged_pools
+
+        if page_size < 1:
+            raise ValueError(f"kv_page_size must be >= 1, got {page_size}")
+        if num_pages < 2:
+            raise ValueError(
+                f"kv_pages must be >= 2 (one null + one usable), got "
+                f"{num_pages}"
+            )
+        self.page = int(page_size)
+        self.num_pages = int(num_pages)
+        # Device pools, one [num_pages, page, H, D(|1)] array per cache
+        # leaf per layer. Rebound by the decode thread after every
+        # donated program (BatchRun writes the updated arrays back).
+        self.layers = make_paged_pools(model, num_pages, page_size)
+        self.page_bytes = kv_page_bytes(model, page_size)
+        self.lock = threading.Lock()
+        self.ref = np.zeros((num_pages,), np.int64)
+        self.ref[NULL_PAGE] = 1  # pinned forever
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        # Prefix-entry page sets: fingerprint -> int32[NPe] page ids,
+        # LRU-ordered. Each set holds ONE ref per page for the entry
+        # itself; rows sharing the prefix retain on top of that.
+        self._entries: collections.OrderedDict[object, np.ndarray] = (
+            collections.OrderedDict()
+        )
+        # Counters (exported via the engine's /metrics block).
+        self.cow_copies = 0
+        self.entry_evictions = 0
+        self.exhaustions = 0
+
+    # -- accounting (read by /metrics and bench) -----------------------
+    @property
+    def pages_total(self) -> int:
+        """Allocatable pages (the null page is bookkeeping, not
+        capacity)."""
+        return self.num_pages - 1
+
+    @property
+    def pages_in_use(self) -> int:
+        with self.lock:
+            return self.pages_total - len(self._free)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages referenced more than once (shared prefix blocks).
+        The null page is excluded by index — it is pinned at ref 1,
+        never above."""
+        with self.lock:
+            return int(np.sum(self.ref[NULL_PAGE + 1:] > 1))
+
+    @property
+    def utilization(self) -> float:
+        return self.pages_in_use / max(1, self.pages_total)
+
+    # -- allocation ----------------------------------------------------
+    def alloc(self, n: int) -> np.ndarray:
+        """Pop ``n`` free pages (ref = 1 each). Under pressure, evict
+        prefix-entry page sets with no live-row references, LRU-first;
+        still short → :class:`PagePoolExhausted`."""
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        with self.lock:
+            while len(self._free) < n and self._evict_one_locked():
+                pass
+            if len(self._free) < n:
+                self.exhaustions += 1
+                raise PagePoolExhausted(
+                    f"KV page pool exhausted: need {n} pages, "
+                    f"{len(self._free)} free of {self.pages_total} "
+                    f"(page={self.page} tokens); raise --kv-pages or "
+                    f"lower concurrency"
+                )
+            out = np.asarray(
+                [self._free.pop() for _ in range(n)], np.int32
+            )
+            self.ref[out] = 1
+            return out
+
+    def _evict_one_locked(self) -> bool:
+        """Drop the LRU prefix-entry page set whose pages nobody else
+        references (ref == 1 everywhere: only the entry's own hold).
+        The PrefixCache entry itself survives — its contiguous KV
+        re-adopts into fresh pages on next use."""
+        victim = next(
+            (
+                fp for fp, pages in self._entries.items()
+                if np.all(self.ref[pages] == 1)
+            ),
+            None,
+        )
+        if victim is None:
+            return False
+        pages = self._entries.pop(victim)
+        self._release_locked(pages)
+        self.entry_evictions += 1
+        _log.info(
+            "evicted prefix page set (%d pages) under pool pressure",
+            len(pages),
+        )
+        return True
+
+    def retain(self, pages) -> None:
+        """One more holder of each page (a row sharing prefix
+        pages)."""
+        pages = np.asarray(pages)
+        pages = pages[pages != NULL_PAGE]
+        if len(pages):
+            with self.lock:
+                np.add.at(self.ref, pages, 1)
+
+    def release(self, pages) -> None:
+        """Drop one hold per page; pages at ref 0 return to the free
+        list. Null entries are ignored, so callers can release whole
+        table rows."""
+        pages = np.asarray(pages).ravel()
+        pages = pages[pages != NULL_PAGE]
+        if len(pages):
+            with self.lock:
+                self._release_locked(pages)
+
+    def _release_locked(self, pages) -> None:
+        np.subtract.at(self.ref, pages, 1)
+        if np.any(self.ref[pages] < 0):
+            # A double release is a lifecycle bug: loud, not silent —
+            # the page may already belong to someone else.
+            bad = pages[self.ref[pages] < 0]
+            self.ref[bad] = 0
+            raise AssertionError(
+                f"KV page(s) {sorted(set(int(p) for p in bad))} "
+                "released below zero references"
+            )
+        freed = np.unique(pages[self.ref[pages] == 0])
+        self._free.extend(int(p) for p in freed)
+
+    def is_shared(self, page: int) -> bool:
+        with self.lock:
+            return bool(self.ref[page] > 1)
+
+    # -- prefix-entry page sets ----------------------------------------
+    def entry_pages(self, fp, holds: int = 0) -> np.ndarray | None:
+        """The pool-resident page set of a prefix entry, if paged in
+        (marks it most-recently-used). ``holds`` extra references are
+        taken ATOMICALLY with the lookup — a concurrent entry
+        eviction (``drop_entry`` from a registration thread) between
+        a bare lookup and a later ``retain`` could otherwise free the
+        pages out from under the forming batch."""
+        with self.lock:
+            pages = self._entries.get(fp)
+            if pages is not None:
+                self._entries.move_to_end(fp)
+                if holds:
+                    np.add.at(self.ref, pages, holds)
+            return pages
+
+    def put_entry_pages(self, fp, pages: np.ndarray,
+                        holds: int = 0) -> None:
+        """Register a freshly-adopted entry page set (pages arrive
+        from ``alloc`` holding the entry's own reference); ``holds``
+        row references are added under the same lock so the set is
+        never observable in its evictable state while a batch is
+        about to use it."""
+        with self.lock:
+            pages = np.asarray(pages, np.int32)
+            if holds:
+                np.add.at(self.ref, pages, holds)
+            self._entries[fp] = pages
+
+    def drop_entry(self, fp) -> None:
+        """Release an evicted PrefixCache entry's page set (no-op if
+        never paged in or already evicted under pressure)."""
+        with self.lock:
+            pages = self._entries.pop(fp, None)
+            if pages is not None:
+                self._release_locked(pages)

@@ -83,7 +83,13 @@ class PrefixCache:
                 self._entries[text] = entry
                 self.misses += 1
                 while len(self._entries) > self.max_entries:
-                    self._entries.popitem(last=False)  # evict LRU
+                    old, _ = self._entries.popitem(last=False)  # LRU
+                    if self.eng.pool is not None:
+                        # The evicted entry's pool pages lose their
+                        # entry hold (rows still sharing them keep
+                        # theirs; the pages free when the last row
+                        # departs).
+                        self.eng.pool.drop_entry(old)
             return entry
         finally:
             with self._lock:
@@ -135,6 +141,16 @@ class PrefixCache:
         from mlapi_tpu.models.gpt import decode_chunk_fn, prefix_prefill_fn
 
         eng = self.eng
+        if eng.pool is not None:
+            # Paged engines run the suffix through paged_extend_fn
+            # against pool-shaped caches; warming those needs live
+            # pool state this registration thread must not touch (the
+            # decode thread owns the pool arrays). Strict-mode paged
+            # prefix batches therefore compile their suffix program on
+            # first formation, and cross-prefix mixing stays
+            # same-prefix (mix_warmed never populates) — noted in
+            # DESIGN §15.
+            return
         batches = [1]
         while batches[-1] < eng.max_batch:
             batches.append(batches[-1] * 2)
@@ -186,6 +202,35 @@ class PrefixCache:
                     jnp.int32(p), lo_vec,
                 )
         self.mix_warmed.add(p)
+
+    def paged_entry(self, fp, kv, holds: int):
+        """Pool-page residency for a prefix entry (paged engines):
+        return ``(pages, need_adopt)`` — the shared page ids with
+        ``holds`` row references ALREADY taken (atomically with the
+        lookup/registration, so a concurrent entry eviction can never
+        free the set between lookup and use), plus whether the
+        entry's contiguous ``[1, P]`` KV still has to be scattered
+        into them (first use; once per entry LIFETIME). HOST-ONLY on
+        purpose: the caller performs the adopt scatter after ALL of
+        the batch's page allocation has succeeded, so a
+        :class:`PagePoolExhausted` can never fire after a donating
+        device call has already consumed the pool arrays. After
+        adoption, every batch row naming this prefix just points its
+        page table here (ref-counted; the contiguous path
+        re-broadcast the prefix KV into every row of every batch).
+        Under pool pressure the page set may have been evicted
+        (``PagePool._evict_one_locked``); the entry silently
+        re-adopts."""
+        import jax
+
+        pool = self.eng.pool
+        pages = pool.entry_pages(fp, holds=holds)
+        if pages is not None:
+            return pages, False
+        p = jax.tree.leaves(kv)[0].shape[1]
+        pages = pool.alloc(-(-p // pool.page))
+        pool.put_entry_pages(fp, pages, holds=holds)
+        return pages, True
 
     @staticmethod
     def widen(kv, own_len: int, p_len: int):
