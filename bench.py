@@ -607,6 +607,161 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _prefill_report(ck: str, env: dict) -> dict:
+    """Subprocess: page-native prefill + chunked-prefill interleaving
+    on the SAME checkpoint (BENCH_GEN_PREFILL=1). Claim classes per
+    the variance rule:
+
+    - **Adopt-copy bytes — exact arithmetic, asserted.** The page-
+      native path must move ZERO adopt bytes; the legacy contiguous-
+      then-adopt path moves exactly one ``[1, bucket]`` cache per
+      formation (``ops/quant.kv_tree_bytes`` — dtype/shape arithmetic,
+      never wall-clock). Token streams asserted identical between the
+      paths.
+    - **Interleaved-vs-not TTFT + inter-token — measured interleaved,
+      ratios only.** A long prompt is admitted behind a running decode
+      stream with interleaving on vs off, alternating engines inside
+      ONE window: the long prompt's TTFT and the running stream's
+      per-token gap p50/p95 while the prompt prefills. The structural
+      bound rides the counters (``interleave_max_stall == 1``), not
+      the clock.
+    """
+    src = f"""
+import asyncio, json, time
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_tree_bytes
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+PAGE = 16
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+# cp = 64 so a ~100-token prompt runs as chunked prefill inside the
+# 256-position window (the default 128 bucket leaves no decode room).
+kw = dict(tokenizer=tok, chunk=8, fused_single=False,
+          kv_page_size=PAGE, prompt_buckets=(16, 64))
+ilv = TextGenerationEngine(model, params, **kw)
+leg = TextGenerationEngine(model, params, prefill_page_native=False,
+                           prefill_interleave=False, **kw)
+
+report = {{}}
+# --- adopt bytes: exact, asserted -----------------------------------
+short = "the quick brown fox"  # 19 tokens -> the 64 bucket
+sa = ilv.generate_text(short, max_new_tokens=8)
+sb = leg.generate_text(short, max_new_tokens=8)
+assert sa["token_ids"] == sb["token_ids"]
+expected = kv_tree_bytes(jax.eval_shape(lambda: model.init_cache(1, 64)))
+assert ilv.prefill_adopt_bytes == 0, ilv.prefill_adopt_bytes
+assert leg.prefill_adopt_bytes == expected, (
+    leg.prefill_adopt_bytes, expected)
+report["prefill_adopt_bytes_page_native"] = ilv.prefill_adopt_bytes
+report["prefill_adopt_bytes_legacy_per_formation"] = expected
+report["adopt_bytes_asserted"] = True
+
+long_p = "x" * 100   # -> [128]-wide bucket, two 64-token chunks
+solo = ilv.generate_text(long_p, max_new_tokens=8)["token_ids"]
+
+async def collect(r, stamps=None):
+    out = []
+    while True:
+        item = await r.queue.get()
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        if stamps is not None:
+            stamps.append((time.perf_counter(), len(item["token_ids"])))
+        out.extend(item["token_ids"])
+
+async def one_round(eng):
+    # The running stream's cache tier must leave room for the long
+    # prompt's activation point: 140 tokens put it in the 256 tier.
+    r1 = await eng.submit("hi", max_new_tokens=140, stream=True)
+    head = await r1.queue.get()
+    stamps = [(time.perf_counter(), 0)]
+    t_sub = time.perf_counter()
+    r2 = await eng.submit(long_p, max_new_tokens=8)
+
+    async def ttft():
+        first = await r2.queue.get()
+        if isinstance(first, Exception):
+            raise first
+        t = (time.perf_counter() - t_sub) * 1e3
+        rest = await collect(r2)
+        return t, first["token_ids"] + rest
+
+    (t_first, long_out), _ = await asyncio.gather(
+        ttft(), collect(r1, stamps))
+    # The running stream's per-token gaps WHILE the long prompt was
+    # pending (until its first token landed) — the HOL window.
+    t_act = t_sub + t_first / 1e3
+    gaps = [
+        (t1 - t0) * 1e3 / n
+        for (t0, _), (t1, n) in zip(stamps, stamps[1:])
+        if n and t1 <= t_act + 1e-3
+    ]
+    return t_first, long_out, gaps
+
+async def measure():
+    # Alternate the two engines inside ONE window — the only way
+    # their wall-clock numbers compare on this box (variance rule).
+    await ilv.start()
+    await leg.start()
+    try:
+        for eng in (ilv, leg):  # compile round, off the clock
+            _, long_out, _ = await one_round(eng)
+            assert long_out == solo, "long-prompt stream moved"
+        ts = {{"i": [], "d": []}}
+        gaps = {{"i": [], "d": []}}
+        for _ in range(3):
+            for key, eng in (("i", ilv), ("d", leg)):
+                t_first, long_out, g = await one_round(eng)
+                assert long_out == solo, "long-prompt stream moved"
+                ts[key].append(t_first)
+                gaps[key] += g
+        return ts, gaps
+    finally:
+        await ilv.stop()
+        await leg.stop()
+
+def q(xs, p):
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(p * len(xs)))], 1)
+
+ts_all, gaps_all = asyncio.run(measure())
+ts_i, gaps_i = ts_all["i"], gaps_all["i"]
+ts_d, gaps_d = ts_all["d"], gaps_all["d"]
+assert ilv.interleaved_prefills >= 3
+assert ilv.interleave_max_stall == 1   # THE bound, from counters
+report["interleave_max_stall"] = ilv.interleave_max_stall
+report["interleaved_prefills"] = ilv.interleaved_prefills
+report["long_ttft_p50_ms_interleaved"] = q(ts_i, 0.5)
+report["long_ttft_p50_ms_deferred"] = q(ts_d, 0.5)
+report["stream_intertoken_p50_ms_interleaved"] = q(gaps_i, 0.5)
+report["stream_intertoken_p95_ms_interleaved"] = q(gaps_i, 0.95)
+report["stream_intertoken_p50_ms_deferred"] = q(gaps_d, 0.5)
+report["stream_intertoken_p95_ms_deferred"] = q(gaps_d, 0.95)
+report["engine_latency_interleaved"] = ilv.latency.summary()
+report["streams_interleaved_vs_not_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"prefill_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_generate() -> None:
     """/generate throughput: single-stream vs concurrency-8 batched
     decode through the full HTTP stack (r1 criterion: batched decode
@@ -754,6 +909,13 @@ def bench_generate() -> None:
             # arithmetic, asserted in-subprocess) + interleaved
             # throughput with token-identity asserted.
             kv_extras.update(_paged_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_PREFILL") == "1":
+            # Page-native prefill (adopt bytes 0 vs legacy, exact
+            # arithmetic asserted) + chunked-prefill interleaving:
+            # long-prompt TTFT and running-stream inter-token p50/p95
+            # interleaved-vs-not, alternated inside one window, with
+            # the one-chunk stall bound asserted from counters.
+            kv_extras.update(_prefill_report(ck, server_env))
         prefix_extras = {}
         if os.environ.get("BENCH_GEN_PREFIX") == "1":
             # Prefix-caching TTFT: the same effective prompt served

@@ -222,14 +222,26 @@ class GptLM:
             for n in range(self.num_layers)
         }
 
-    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int,
+                     cache=None, pos0=None):
         """Full causal forward over a left-padded ``[B, P]`` prompt,
         writing K/V into a fresh ``[B, total_len, H, D]`` cache — this
         model family's implementation of the decoder protocol (see
         :func:`_prefill_core` for the shared contract).
+
+        ``cache``/``pos0`` (page-native prefill): write the prompt's
+        K/V into an EXISTING cache pytree at traced slot offset
+        ``pos0`` instead of building a fresh one (``total_len`` is
+        then ignored). With a paged cache this is what makes prefill
+        write pool pages ONCE — the block's attention is unchanged
+        (full-precision in-register over ``kv_seen``), only the
+        append's destination moves, so token streams are pinned
+        identical to the fresh-cache path.
         """
         b, p = prompt_ids.shape
-        cache = self.init_cache(b, total_len)
+        cache = self.init_cache(b, total_len) if cache is None else dict(cache)
+        if pos0 is None:
+            pos0 = jnp.int32(0)
         cdt = jnp.dtype(self.compute_dtype)
 
         from mlapi_tpu.ops import full_attention
@@ -252,7 +264,7 @@ class GptLM:
             # append fuses the quantize into this write (ops/quant).
             cache[f"layer_{n}"] = kv_cache_append(
                 cache[f"layer_{n}"], kv_seen["k"], kv_seen["v"],
-                jnp.int32(0), cdt,
+                pos0, cdt,
             )
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
         last_logits = x[:, -1].astype(jnp.float32) @ params["wte"].T.astype(
@@ -1018,6 +1030,33 @@ def paged_extend_fn(model, width: int):
     return jax.jit(_run, donate_argnums=(1,))
 
 
+@functools.lru_cache(maxsize=64)
+def paged_prefill_fn(model, width: int):
+    """Page-native prefill + first token: the SAME full causal forward
+    as ``prefill_fn`` (prompt block attends full-precision
+    in-register), but the K/V append lands straight in pool pages —
+    ``cache`` is a paged pytree (pool leaves + ``[R, NP]`` table
+    mirrors) and ``off`` the traced VIRTUAL slot of the row's bucket
+    start, so formation and admission write the prefill bytes exactly
+    once (``generate.prefill_adopt_bytes`` reads 0 on this path where
+    the contiguous-then-``paged_scatter_fn`` adopt paid one full extra
+    copy). ``n_pad`` stays the row's LOCAL pad count (``bucket -
+    used``): effective positions are ``local_slot - n_pad``, invariant
+    under ``off``, which is what pins the token stream identical to
+    the adopt path. ``(params, cache, prompt_ids [R, width], off,
+    key_data, temps, n_pad, top_k, top_p) → (first_tok [R], cache)``;
+    the cache is donated (pool updates in place)."""
+
+    def _run(params, cache, prompt_ids, off, key_data, temps, n_pad,
+             top_k, top_p):
+        cache, logits = model.prefill_core(
+            params, prompt_ids, n_pad, 0, cache=cache, pos0=off
+        )
+        return _pick_token(temps, logits, key_data, 0, top_k, top_p), cache
+
+    return jax.jit(_run, donate_argnums=(1,))
+
+
 @functools.cache
 def paged_scatter_fn():
     """Jitted paged ADOPT: copy a contiguous ``[R, W]``-shaped cache
@@ -1077,6 +1116,53 @@ def paged_cow_fn():
                     continue
                 pool = layer[name]
                 new_layer[name] = pool.at[dst].set(pool[src])
+            out[ln] = new_layer
+        return out
+
+    return jax.jit(_run, donate_argnums=(0,))
+
+
+@functools.cache
+def paged_realign_fn():
+    """Jitted paged fallback for the batched-speculation handoff when
+    a row's realign delta is NOT a page multiple (the page-aligned
+    case is a pure HOST table shift — see ``BatchRun._paged_realign``).
+    Row gather + write-back THROUGH the tables: every row's virtual
+    window is gathered at the shifted coordinates
+    (``new[b, v] = old[b, v - delta_b]``, clamped like
+    :func:`realign_fn`) and scattered back into the row's OWN mapped
+    pages (unmapped tiles route through the never-read null page).
+    Cost is one pass over the rows' whole VIRTUAL window — bounded by
+    the cache tier, same order as the contiguous ``realign_fn`` roll
+    it replaces (keying the program on the live extent would compile
+    per handoff width) — a loud, counted repack
+    (``generate.spec_realign_repacks``), kept only for the sub-page
+    case page identity cannot express. Rows must not share pages
+    (``p_len == 0`` batches — the only ones batched spec takes).
+    The cache is donated."""
+
+    def _run(cache, delta):
+        from mlapi_tpu.ops.quant import kv_layer_page_size
+
+        out = {}
+        for ln, layer in cache.items():
+            page = kv_layer_page_size(layer)
+            table = layer["table"]
+            b, npv = table.shape
+            L = npv * page
+            vdst = jnp.arange(L)[None, :]                     # [1, L]
+            vsrc = jnp.clip(vdst - delta[:, None], 0, L - 1)  # [B, L]
+            pd = jnp.take_along_axis(
+                table, jnp.broadcast_to(vdst // page, (b, L)), axis=1
+            )
+            od = jnp.broadcast_to(vdst % page, (b, L))
+            ps = jnp.take_along_axis(table, vsrc // page, axis=1)
+            os_ = vsrc % page
+            new_layer = {"table": table}
+            for name, pool in layer.items():
+                if name == "table":
+                    continue
+                new_layer[name] = pool.at[pd, od].set(pool[ps, os_])
             out[ln] = new_layer
         return out
 

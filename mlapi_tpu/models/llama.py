@@ -273,16 +273,23 @@ class LlamaLM:
             for n in range(self.num_layers)
         }
 
-    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int,
+                     cache=None, pos0=None):
         """Full causal forward over a left-padded ``[B, P]`` prompt,
         writing ROTATED K (and V) into a fresh cache — the dispatch
         target of ``gpt._prefill_core`` (see that docstring for the
-        padding/alignment contract)."""
+        padding/alignment contract, and ``GptLM.prefill_core`` for the
+        page-native ``cache``/``pos0`` variant: rotary phases key on
+        effective positions, which the caller's virtual-slot ``n_pad``
+        keeps invariant under the offset, so the stored rotated K is
+        identical wherever the block lands)."""
         from mlapi_tpu.ops import full_attention
         from mlapi_tpu.ops.quant import kv_cache_append
 
         b, p = prompt_ids.shape
-        cache = self.init_cache(b, total_len)
+        cache = self.init_cache(b, total_len) if cache is None else dict(cache)
+        if pos0 is None:
+            pos0 = jnp.int32(0)
         cdt = jnp.dtype(self.compute_dtype)
 
         positions = jnp.maximum(jnp.arange(p)[None, :] - n_pad[:, None], 0)
@@ -305,7 +312,7 @@ class LlamaLM:
             # full-precision above).
             cache[f"layer_{n}"] = kv_cache_append(
                 cache[f"layer_{n}"], kv_seen["k"], kv_seen["v"],
-                jnp.int32(0), cdt,
+                pos0, cdt,
             )
         x = _rms_norm(x, params["rms_f_scale"])
         last_logits = x[:, -1].astype(jnp.float32) @ params["lm_head"].astype(

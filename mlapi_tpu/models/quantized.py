@@ -52,9 +52,11 @@ class QuantizedModel:
     def apply(self, params, *args, **kwargs):
         return self.inner.apply(self._deq(params), *args, **kwargs)
 
-    def prefill_core(self, params, prompt_ids, n_pad, total_len: int):
+    def prefill_core(self, params, prompt_ids, n_pad, total_len: int,
+                     cache=None, pos0=None):
         return self.inner.prefill_core(
-            self._deq(params), prompt_ids, n_pad, total_len
+            self._deq(params), prompt_ids, n_pad, total_len,
+            cache=cache, pos0=pos0,
         )
 
     def decode_step(self, params, cache, token_ids, pos, n_pad=None,

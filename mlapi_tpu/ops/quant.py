@@ -212,6 +212,18 @@ def kv_page_bytes(model, page_size: int) -> int:
     )
 
 
+def kv_tree_bytes(tree) -> int:
+    """Exact device bytes of a cache pytree from dtype/shape
+    arithmetic alone — the unit the adopt-copy accounting uses
+    (``generate.prefill_adopt_bytes``): an adopt scatter moves exactly
+    the bytes of the contiguous tree it copies into pool pages, so the
+    gauge is deterministic, never wall-clock."""
+    return sum(
+        int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree.leaves(tree)
+    )
+
+
 def kv_quantize(x):
     """``[..., D]`` float K or V block → ``(q int8[..., D],
     scale f32[..., 1])``, symmetric per-token-per-head (amax over the

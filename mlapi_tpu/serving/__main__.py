@@ -159,6 +159,10 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--kv-page-size", str(args.kv_page_size)]
     if getattr(args, "kv_pages", None):
         cmd += ["--kv-pages", str(args.kv_pages)]
+    if not getattr(args, "prefill_page_native", True):
+        cmd += ["--no-prefill-page-native"]
+    if not getattr(args, "prefill_interleave", True):
+        cmd += ["--no-prefill-interleave"]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -300,6 +304,29 @@ def main(argv=None) -> None:
              "generate.kv_page_utilization on /metrics",
     )
     parser.add_argument(
+        "--prefill-page-native", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="with --kv-page-size: prefill writes K/V straight into "
+             "pool pages through the page table (default) — the "
+             "contiguous-then-adopt copy drops to exactly zero bytes "
+             "(generate.prefill_adopt_bytes reads 0). "
+             "--no-prefill-page-native keeps the r09 adopt path for "
+             "comparison; token streams are pinned identical either "
+             "way",
+    )
+    parser.add_argument(
+        "--prefill-interleave", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="with --kv-page-size: a long prompt admitted into a "
+             "running batch prefills as chunked dispatches "
+             "interleaved one-for-one with decode chunks (default) — "
+             "in-flight streams stall by at most ONE prefill-chunk "
+             "dispatch instead of the whole prompt "
+             "(generate.interleave_max_stall pins the bound). "
+             "--no-prefill-interleave defers long joiners to their "
+             "own batch",
+    )
+    parser.add_argument(
         "--draft-checkpoint", default=None,
         help="speculative decoding: a smaller same-tokenizer "
              "checkpoint whose proposals the target verifies in one "
@@ -419,6 +446,8 @@ def main(argv=None) -> None:
         decode_attn_impl=args.decode_attn_impl,
         kv_page_size=args.kv_page_size,
         kv_pages=args.kv_pages,
+        prefill_page_native=args.prefill_page_native,
+        prefill_interleave=args.prefill_interleave,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,

@@ -609,8 +609,44 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.fused_batch_calls"] = (
                 engine.fused_batch_calls
             )
+            # Page-native prefill + interleaving (r10). adopt_bytes is
+            # exact dtype/shape arithmetic: 0 on the page-native path,
+            # one full prefill copy per formation/admission on the
+            # legacy adopt path — the gauge IS the claim.
+            snap["counters"]["generate.prefill_adopt_bytes"] = (
+                engine.prefill_adopt_bytes
+            )
+            snap["counters"]["generate.prefix_adopt_bytes"] = (
+                engine.prefix_adopt_bytes
+            )
+            snap["counters"]["generate.kv_prefix_copy_fallback"] = (
+                engine.kv_prefix_copy_fallback
+            )
+            snap["counters"]["generate.interleaved_prefills"] = (
+                engine.interleaved_prefills
+            )
+            snap["counters"]["generate.spec_realign_table_ops"] = (
+                engine.spec_realign_table_ops
+            )
+            snap["counters"]["generate.spec_realign_repacks"] = (
+                engine.spec_realign_repacks
+            )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
+            # Chunked-prefill interleaving: chunks still queued for
+            # the in-progress long-prompt joiner (0 when idle), and
+            # the worst consecutive prefill-dispatch run live decode
+            # rows ever waited behind (the design pins it at 1).
+            snap["gauges"]["generate.prefill_chunk_queue_depth"] = (
+                engine.prefill_chunk_queue_depth
+            )
+            snap["gauges"]["generate.interleave_max_stall"] = (
+                engine.interleave_max_stall
+            )
+            # TTFT / inter-token latency summaries from the engine's
+            # delivery-time reservoirs (ms; null until traffic).
+            for k, v in engine.latency.summary().items():
+                snap["gauges"][f"generate.{k}"] = v
             # Deterministic per-slot KV bytes at the default
             # bucket/tier (addressable_shards nbytes) — the committed
             # int8-KV number; kv_quant itself rides /healthz meta.

@@ -16,10 +16,14 @@ its own method:
 ``_spec_handoff``       solo / batched speculative phases, handing off
                         to the chunk loop at any ``(cache, pos, tok)``
 ``_admit_waiting``      mid-batch continuous admission (+ batch growth)
+``_pf_step`` et al.     interleaved chunked prefill: a long-prompt
+                        joiner's prefill chunks scheduled one per
+                        decode boundary (paged engines; r10)
 ``_maybe_shrink``       compaction along the warmed halving chain
 ``_decode_chunk``       one chained chunk dispatch + drain policy
-``run``                 the loop: admission → liveness → spec
-                        re-engage → resize → chunk, then terminators
+``run``                 the loop: pf-activation → admission →
+                        liveness → spec re-engage → resize →
+                        pf-chunk → chunk, then terminators
 ======================  ================================================
 
 Invariants the stages share (and why the state is one object):
@@ -136,6 +140,10 @@ class BatchRun:
             if self.pool is not None else None
         )
         self._tab_dirty = False
+        # Active interleaved chunked prefill (paged long-prompt
+        # joiner) + its consecutive-dispatch stall counter.
+        self._pf: dict | None = None
+        self._pf_consec = 0
         try:
             first = self._prefill()
             self.pos = self.p_len + self.bucket
@@ -271,6 +279,11 @@ class BatchRun:
         — skipped when formation failed before a cache existed."""
         if self.pool is None or self.tab is None:
             return
+        if self._pf is not None:
+            # An in-progress interleaved prefill holds private pages.
+            self.pool.release(self._pf["ptab"])
+            self._pf = None
+            self.eng.prefill_chunk_queue_depth = 0
         for row in range(len(self.tab)):
             self._release_row(row)
         if write_back and getattr(self, "cache", None) is not None:
@@ -300,31 +313,112 @@ class BatchRun:
         if self._tab_dirty:
             self._with_tables()
 
+    def _spec_ensure(self, cache, lo: int, hi: int):
+        """Page-allocation hook the speculative phase calls before
+        each verify block: map virtual slots ``[lo, hi)`` for every
+        live row (the phase writes ahead of the chunk loop's
+        ``_ensure_pages``) and push any table change into the cache it
+        is holding. Exhaustion raises loudly mid-phase — same contract
+        as the chunk loop's boundary allocation."""
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        self._alloc_rows(
+            sorted({
+                self.rows[i] for i, r in enumerate(self.reqs)
+                if self.rows[i] is not None and not self.done[i]
+                and not r.cancelled
+            }),
+            lo, min(hi, self.total),
+        )
+        if self._tab_dirty:
+            self._tab_dirty = False
+            return paged_cache_tree(cache, self.tab[:self.b_cur])
+        return cache
+
+    def _paged_realign(self, cache, delta: np.ndarray, top: int):
+        """The batched-speculation handoff realign, paged: rows shift
+        right by ``delta[row]`` so the scalar-``pos`` chunk loop can
+        resume. When every delta is a page multiple this is a pure
+        HOST table edit — each row's table rolls right by
+        ``delta/page`` tiles (shifted-in leading tiles go null, masked
+        by the caller's ``n_pad`` bump; shifted-off tail pages are
+        released) — zero cache bytes move. Sub-page deltas fall back
+        to the device row-gather rewrite (``paged_realign_fn``),
+        O(live row bytes), counted loudly: the one case page identity
+        cannot express."""
+        import jax.numpy as jnp
+
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        eng, page = self.eng, self.page
+        if np.all(delta % page == 0):
+            for row in range(self.b_cur):
+                s = int(delta[row]) // page
+                if s == 0:
+                    continue
+                dropped = self.tab[row, self.npv - s:]
+                if dropped.any():
+                    self.pool.release(dropped)
+                self.tab[row] = np.roll(self.tab[row], s)
+                self.tab[row, :s] = 0
+            eng.spec_realign_table_ops += 1
+            self._tab_dirty = False
+            return paged_cache_tree(cache, self.tab[:self.b_cur])
+        # Destination slots (every row's content ends at ``top`` after
+        # the shift) must be mapped before the device gather writes —
+        # LIVE rows only: a finished row's shifted bytes are never
+        # read again, so its unmapped writes may die in the null page.
+        from mlapi_tpu.models.gpt import paged_realign_fn
+
+        for i, r in enumerate(self.reqs):
+            row = self.rows[i]
+            if row is None or self.done[i] or r.cancelled:
+                continue
+            self._alloc_rows(
+                [row], int(self.n_pad[row] + delta[row]), top,
+            )
+        if self._tab_dirty:
+            self._tab_dirty = False
+            cache = paged_cache_tree(cache, self.tab[:self.b_cur])
+        eng.spec_realign_repacks += 1
+        return paged_realign_fn()(cache, jnp.asarray(delta))
+
     def _prefill_paged(self):
         """Paged formation: page-table setup (host) + prefill via the
-        paged program set. Plain batches keep the contiguous
-        bucket-length prefill program and ADOPT its cache into freshly
-        allocated pages (one extra copy of the bytes prefill just
-        wrote); chunked long prompts extend straight into the paged
-        cache; prefix batches point their table rows at the entry's
-        shared pages (ref-counted) and only compute the suffix —
-        nothing copies the prefix anymore."""
+        paged program set. PAGE-NATIVE (default): the bucket prefill
+        writes K/V straight into pool pages through the table
+        (``paged_prefill_fn`` — same forward, different append
+        destination), so formation writes the prefill bytes exactly
+        once and each row holds only the pages covering its REAL
+        tokens (pad-slot writes land in the null page — prefill
+        padding waste drops to sub-page, like decode's). The legacy
+        r09 path (``prefill_page_native=False``) keeps the contiguous
+        bucket prefill and ADOPTS its cache into pages — one full
+        extra copy of the bytes prefill just wrote, counted exactly
+        into ``eng.prefill_adopt_bytes`` (dtype/shape arithmetic).
+        Chunked long prompts extend straight into the paged cache;
+        prefix batches point their table rows at the entry's shared
+        pages (ref-counted) and only compute the suffix."""
         eng = self.eng
         bucket = self.bucket
         import jax.numpy as jnp
 
         from mlapi_tpu.models.gpt import (
-            paged_extend_fn, paged_scatter_fn, prefill_fn, sample_fn,
+            paged_extend_fn, paged_prefill_fn, paged_scatter_fn,
+            prefill_fn, sample_fn,
         )
-        from mlapi_tpu.ops.quant import paged_cache_tree
+        from mlapi_tpu.ops.quant import kv_tree_bytes, paged_cache_tree
 
         if self.p_len:
             return self._prefill_paged_prefix()
         cp = eng.prompt_buckets[-1]
         if bucket > cp and bucket % cp == 0:
             # Chunked long-prompt prefill, page-native: extend_core
-            # writes every block straight into pool pages.
-            self._alloc_rows(range(self.b), 0, bucket)
+            # writes every block straight into pool pages. Rows map
+            # only the tiles covering their real tokens; the pad
+            # blocks' dead writes land in the null page.
+            for i in range(self.b):
+                self._alloc_rows([i], int(self.n_pad[i]), bucket)
             self.cache = paged_cache_tree(
                 eng.pool.layers, self.tab
             )
@@ -342,14 +436,32 @@ class BatchRun:
                 logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
             )
-        # Plain: the bucket-length contiguous prefill (the same
-        # program admission warms), adopted into pages.
+        if eng.prefill_page_native:
+            # Page-native plain formation: allocate each row's real
+            # span, then ONE fused prefill+sample writing through the
+            # tables at virtual offset 0. Zero adopt bytes — there is
+            # no contiguous intermediate to copy.
+            for i in range(self.b):
+                self._alloc_rows([i], int(self.n_pad[i]), bucket)
+            self.cache = paged_cache_tree(eng.pool.layers, self.tab)
+            self._tab_dirty = False
+            first, self.cache = paged_prefill_fn(eng.model, bucket)(
+                eng.params, self.cache, jnp.asarray(self.prompt),
+                jnp.int32(0), jnp.asarray(self.keys),
+                jnp.asarray(self.temps), jnp.asarray(self.n_pad),
+                jnp.asarray(self.topk), jnp.asarray(self.topp),
+            )
+            return first
+        # Legacy: the bucket-length contiguous prefill (the same
+        # program admission warms), adopted into pages — the extra
+        # copy the page-native path exists to kill, kept measurable.
         first, mini = prefill_fn(eng.model, bucket)(
             eng.params, jnp.asarray(self.prompt),
             jnp.asarray(self.keys), jnp.asarray(self.temps),
             jnp.asarray(self.n_pad), jnp.asarray(self.topk),
             jnp.asarray(self.topp),
         )
+        eng.prefill_adopt_bytes += kv_tree_bytes(mini)
         self._alloc_rows(range(self.b), 0, bucket)
         self.cache = paged_cache_tree(eng.pool.layers, self.tab)
         self._tab_dirty = False
@@ -365,18 +477,24 @@ class BatchRun:
         per row (the suffix's first tokens land mid-page), and only
         the suffix block is computed — the per-row prefix broadcast
         copy of the contiguous path is gone. Cross-prefix (stacked)
-        batches keep the copy semantics for now: each row's widened
-        prefix KV adopts into private pages (regions right-aligned to
-        the group end are sub-page shifts of each other, which page
-        identity cannot express — DESIGN §15 notes the aligned-share
-        follow-up)."""
+        batches now share the same way whenever every row's
+        right-alignment shift ``P - prefix_len`` is a PAGE MULTIPLE:
+        the row's table points at ITS entry's pages starting at tile
+        ``shift/page`` (leading tiles stay null — masked below the
+        row's ``lo``), ref-counted exactly like same-fp rows, with the
+        group-end tile COW-diverged per row when ``P % page != 0``.
+        Prefix entries page-align their buckets at store time
+        (``PrefixCache._build``), so the aligned case is the norm; a
+        group whose shifts are NOT page multiples (a cap-clamped
+        entry) falls back to r09's widened-stack copy, counted loudly
+        in ``eng.kv_prefix_copy_fallback``."""
         eng, reqs = self.eng, self.reqs
         import jax.numpy as jnp
 
         from mlapi_tpu.models.gpt import (
             paged_cow_fn, paged_extend_fn, paged_scatter_fn, sample_fn,
         )
-        from mlapi_tpu.ops.quant import paged_cache_tree
+        from mlapi_tpu.ops.quant import kv_tree_bytes, paged_cache_tree
 
         P, page = self.p_len, self.page
         npp = -(-P // page)
@@ -384,8 +502,30 @@ class BatchRun:
         # PagePoolExhausted happens before any donating device call,
         # so a loud reject can never leave the engine pool bound to
         # consumed buffers.
-        adopt = None
+        adopts: list = []
         srcs, dsts = [], []
+
+        def share_row(i: int, kv, entry_pages, need_adopt,
+                      shift_tiles: int) -> None:
+            """Point row ``i``'s table at an entry's pages (reference
+            already held), COW-diverging the group-end tile when the
+            suffix would write into it."""
+            self.tab[i, shift_tiles:shift_tiles + len(entry_pages)] = (
+                entry_pages
+            )
+            if need_adopt:
+                adopts.append((kv, entry_pages))
+            if P % page:
+                # The group-end page is partially prefix: this row's
+                # suffix will write into it, so diverge it by COW —
+                # one page copied per row, not one cache.
+                own = self.eng.pool.alloc(1)[0]
+                srcs.append(int(entry_pages[-1]))
+                dsts.append(int(own))
+                self.eng.pool.release([entry_pages[-1]])
+                self.tab[i, npp - 1] = own
+
+        mixed_copy = False
         if not self.mixed_prefix:
             # holds=b: every live row's reference is taken atomically
             # with the entry lookup — a concurrent LRU eviction of
@@ -393,21 +533,27 @@ class BatchRun:
             entry_pages, need_adopt = eng.prefix.paged_entry(
                 reqs[0].prefix_fp, reqs[0].prefix_kv, holds=self.b
             )
-            if need_adopt:
-                adopt = (reqs[0].prefix_kv, entry_pages)
             for i in range(self.b):
-                self.tab[i, :npp] = entry_pages
-                if P % page:
-                    # The entry's last page is partially prefix: this
-                    # row's suffix will write into it, so diverge it
-                    # by COW — one page copied per row, not one cache.
-                    own = self.eng.pool.alloc(1)[0]
-                    srcs.append(int(entry_pages[-1]))
-                    dsts.append(int(own))
-                    self.eng.pool.release([entry_pages[-1]])
-                    self.tab[i, npp - 1] = own
+                share_row(
+                    i, reqs[0].prefix_kv, entry_pages,
+                    need_adopt and i == 0, 0,
+                )
+        elif all((P - r.prefix_len) % page == 0 for r in reqs):
+            # Aligned stacked group: each row shares ITS OWN entry's
+            # ref-counted pages at a tile shift — no widened copy.
+            for i, r in enumerate(reqs):
+                entry_pages, need_adopt = eng.prefix.paged_entry(
+                    r.prefix_fp, r.prefix_kv, holds=1
+                )
+                share_row(
+                    i, r.prefix_kv, entry_pages, need_adopt,
+                    (P - r.prefix_len) // page,
+                )
         else:
-            # Copy path: widened per-row stacks into private pages.
+            # Copy fallback: widened per-row stacks into private
+            # pages — sub-page shifts page identity cannot express.
+            eng.kv_prefix_copy_fallback += 1
+            mixed_copy = True
             self._alloc_rows(range(self.b), 0, npp * page)
         # Suffix pages behind the prefix region.
         self._alloc_rows(range(self.b), npp * page, P + self.bucket)
@@ -416,14 +562,18 @@ class BatchRun:
         # forward of the suffix against the shared pages.
         self.cache = paged_cache_tree(eng.pool.layers, self.tab)
         self._tab_dirty = False
-        if self.mixed_prefix:
+        if mixed_copy:
             stack = eng.prefix.stacked(reqs, P, self.b_pad)
+            eng.prefill_adopt_bytes += kv_tree_bytes(stack)
             self.cache = paged_scatter_fn()(
                 self.cache, stack, jnp.asarray(self.tab[:, :npp]),
                 jnp.int32(0),
             )
-        if adopt is not None:
-            kv, entry_pages = adopt
+        for kv, entry_pages in adopts:
+            # Once per entry LIFETIME: the entry's contiguous KV
+            # becomes pool-resident (cache residency, not a per-batch
+            # copy — counted apart from the formation adopt gauge).
+            eng.prefix_adopt_bytes += kv_tree_bytes(kv)
             tab1 = np.zeros((1, len(entry_pages)), np.int32)
             tab1[0] = entry_pages
             self.cache = paged_scatter_fn()(
@@ -459,16 +609,24 @@ class BatchRun:
         one readback round trip per request."""
         eng, reqs, b = self.eng, self.reqs, self.b
         temps, topk, topp = self.temps, self.topk, self.topp
+        # Paged × speculative (r10): the guards LIFT for the common
+        # case. Solo spec needs no realign at all (it hands off at its
+        # own frontier) and the batched handoff realigns as a host
+        # page-table shift when deltas are page multiples (device
+        # row-gather fallback otherwise — `_paged_realign`); the draft
+        # mirrors stay contiguous either way (the draft has no pool).
+        # The DECLINE survives for exactly two paged cases, pinned by
+        # test: strict (tunnel) mode — the spec warm grid compiles
+        # against contiguous caches, so pool-shaped verify programs
+        # would compile mid-batch — and mesh-sharded pools, where the
+        # verify/propose programs are unproven over sharded pool
+        # operands.
+        paged_spec_ok = self.pool is None or (
+            not eng._strict_admit and eng.mesh is None
+        )
         self.spec_eligible = (
             eng.draft_model is not None
-            # Paged batches decline the speculative phases for now:
-            # the spec handoff's per-row cache REALIGN (realign_fn's
-            # roll) and the draft-mirror machinery are contiguous
-            # programs, and rolling a paged row is a repack, not a
-            # table op. Paging targets the many-slot capacity regime;
-            # speculation targets solo-stream latency — a deployment
-            # picks its lever (ROADMAP notes the composition).
-            and self.pool is None
+            and paged_spec_ok
             and b == 1 and self.p_len == 0
             and not reqs[0].cancelled
             and (
@@ -486,7 +644,7 @@ class BatchRun:
         # verify block.
         self.spec_batched = (
             eng.draft_model is not None
-            and self.pool is None  # same decline as spec_eligible
+            and paged_spec_ok
             and b > 1 and self.p_len == 0
             and bool(
                 np.all(temps[:b] <= 0.0)
@@ -551,6 +709,36 @@ class BatchRun:
             self.tok[sel], self.step[sel], self.lo[sel],
         )
         self.keys = self.keys[sel]
+
+    def _grow(self) -> list:
+        """Double the batch along the warmed power-of-two chain; the
+        new rows are dummies (fully masked) until admitted into.
+        Paged growth moves ZERO cache bytes — new rows get null page
+        tables (duplicating row 0's TABLE would alias its live pages)
+        and only the host mirrors double; contiguous growth gathers
+        the cache through the warmed ``_compact_fn`` shape. Shared by
+        one-shot admission and the interleaved-prefill row claim.
+        Returns the freshly-created free rows."""
+        from mlapi_tpu.serving.engine import _compact_fn
+
+        self.chain.invalidate()  # mirrors are about to be rebound
+        sel = np.concatenate(
+            [np.arange(self.b_cur), np.zeros(self.b_cur)]
+        ).astype(np.int32)
+        if self.pool is not None:
+            self.tab = np.vstack([self.tab, np.zeros_like(self.tab)])
+            self._tab_dirty = True
+        else:
+            self.cache = _compact_fn()(self.cache, jnp.asarray(sel))
+            self.eng._warmed_growth.add(
+                (self.b_cur, self.b_cur * 2, self.total)
+            )
+        self._mirrors_take(sel)
+        self.n_pad[self.b_cur:] = self.pos  # mask dummies fully
+        self.temps[self.b_cur:] = 0.0
+        self.b_cur *= 2
+        self.eng.growths += 1
+        return list(range(self.b_cur // 2, self.b_cur))
 
     def _never_admissible(self, r) -> bool:
         """Token budget exceeds the running cache's remaining room —
@@ -620,6 +808,7 @@ class BatchRun:
             self.reqs[0], self.cache, self.pos, self.total, self.bucket,
             self.tok, self.step, self.produced, self.n_pad, self.keys,
             self.spec_hist, self.temps, self.topk, self.topp,
+            ensure=self._spec_ensure if self.pool is not None else None,
         )
         self.sched[0] = self.produced[0]
         if self.produced[0] >= self.reqs[0].n_new:
@@ -632,11 +821,14 @@ class BatchRun:
         loop."""
         self._try_spec()
         if self.spec_batched and not all(self.done):
+            paged = self.pool is not None
             self.cache, self.pos = self.eng.spec.run_batched(
                 self.reqs, self.cache, self.pos, self.total,
                 self.bucket, self.prompt, self.tok, self.step,
                 self.produced, self.done, self.n_pad, self.keys,
                 self.b_pad,
+                ensure=self._spec_ensure if paged else None,
+                paged_realign=self._paged_realign if paged else None,
             )
             self.sched[:] = self.produced
 
@@ -648,7 +840,6 @@ class BatchRun:
         (the loop's compaction policy reads it)."""
         eng, reqs = self.eng, self.reqs
         from mlapi_tpu.models.gpt import admit_scatter_fn, prefill_fn
-        from mlapi_tpu.serving.engine import _compact_fn
 
         with eng._alock:
             candidates = list(eng._admit)
@@ -656,6 +847,8 @@ class BatchRun:
             1 for i, r in enumerate(reqs)
             if not self.done[i] and not r.cancelled
         )
+        if self._pf is not None:
+            n_live += 1  # the interleaved joiner owns its row already
         for cand in candidates:
             if cand.cancelled:
                 self._unstage(cand)  # drop silently
@@ -671,6 +864,21 @@ class BatchRun:
                 self._unstage(cand)
                 with eng._alock:
                     eng._deferred.append(cand)
+                continue
+            bkt = len(cand.row)
+            cp = eng.prompt_buckets[-1]
+            if (
+                self.pool is not None and eng.prefill_interleave
+                and bkt > cp and bkt % cp == 0
+            ):
+                # LONG-PROMPT joiner: its prefill runs as chunked
+                # extend dispatches INTERLEAVED with the running
+                # batch's decode chunks (one prefill chunk per chunk
+                # boundary), so in-flight streams stall by at most one
+                # prefill-chunk dispatch instead of the whole prompt.
+                taken = self._try_start_pf(cand, n_live)
+                if taken:
+                    n_live += 1
                 continue
             if self._never_admissible(cand):
                 # Hand back to the collector for the NEXT batch;
@@ -688,6 +896,8 @@ class BatchRun:
                 self.rows[i] for i, r in enumerate(reqs)
                 if not self.done[i] and not r.cancelled
             }
+            if self._pf is not None:
+                used_rows.add(self._pf["row"])
             free = [
                 j for j in range(self.b_cur) if j not in used_rows
             ]
@@ -707,9 +917,15 @@ class BatchRun:
                 # camping in the staging list where it would block
                 # compaction and draining.
                 b_t = self.b_cur * 2 if grow else self.b_cur
-                if self.pool is not None:
-                    # Paged: growth is a host table op (nothing to
-                    # warm) and the admission scatter is keyed on
+                if self.pool is not None and eng.prefill_page_native:
+                    # Page-native paged admission is ONE program —
+                    # the joiner's direct-to-pages prefill, keyed on
+                    # (bucket, table width) — so that is the whole
+                    # gate (growth stays a host table op).
+                    blocked = (bkt, self.npv) not in eng._warmed_scatter
+                elif self.pool is not None:
+                    # Legacy paged: growth is a host table op (nothing
+                    # to warm) and the admission scatter is keyed on
                     # (bucket, table width) — batch-size-free.
                     blocked = bkt not in eng._warmed_joiner or (
                         not eng._admit_eager
@@ -752,34 +968,7 @@ class BatchRun:
             # an already-admitted joiner from ``_admit``.
             self._unstage(cand)
             if grow:
-                # Batch growth: double along the warmed power-of-two
-                # chain; new rows are dummies until admitted into.
-                sel = np.concatenate(
-                    [np.arange(self.b_cur), np.zeros(self.b_cur)]
-                ).astype(np.int32)
-                if self.pool is not None:
-                    # Paged growth moves ZERO cache bytes: the new
-                    # dummy rows get null page tables (their dead
-                    # writes land in the null page — duplicating row
-                    # 0's TABLE would alias its live pages) and only
-                    # the host mirrors double. O(table), the claim.
-                    self.tab = np.vstack(
-                        [self.tab, np.zeros_like(self.tab)]
-                    )
-                    self._tab_dirty = True
-                else:
-                    self.cache = _compact_fn()(
-                        self.cache, jnp.asarray(sel)
-                    )
-                    eng._warmed_growth.add(
-                        (self.b_cur, self.b_cur * 2, self.total)
-                    )
-                self._mirrors_take(sel)
-                self.n_pad[self.b_cur:] = self.pos  # mask dummies fully
-                self.temps[self.b_cur:] = 0.0
-                self.b_cur *= 2
-                free = list(range(self.b_cur // 2, self.b_cur))
-                eng.growths += 1
+                free = self._grow()
             row = free[0]
             if self.pool is not None:
                 from mlapi_tpu.serving.paged_pool import (
@@ -787,10 +976,15 @@ class BatchRun:
                 )
 
                 # The row may still hold a finished request's pages;
-                # its slots restart at the joiner's region.
+                # its slots restart at the joiner's region. Page-
+                # native rows map only the REAL-token span — the
+                # bucket's pad-slot writes land in the null page.
                 self._release_row(row)
+                lo = self.pos - (
+                    cand.used if eng.prefill_page_native else bkt
+                )
                 try:
-                    self._alloc_rows([row], self.pos - bkt, self.pos)
+                    self._alloc_rows([row], lo, self.pos)
                 except PagePoolExhausted:
                     # Not an error: the pool is momentarily full of
                     # live sequences — hand the joiner to the next
@@ -799,37 +993,75 @@ class BatchRun:
                     with eng._alock:
                         eng._deferred.append(cand)
                     continue
-            first1, mini = prefill_fn(eng.model, bkt)(
-                eng.params, jnp.asarray(cand.row[None]),
-                jnp.asarray(eng._key_data(cand.seed)[None]),
-                jnp.asarray(
-                    np.asarray([cand.temperature], np.float32)
-                ),
-                jnp.asarray(
-                    np.asarray([bkt - cand.used], np.int32)
-                ),
-                jnp.asarray(np.asarray([cand.top_k], np.int32)),
-                jnp.asarray(
-                    np.asarray([cand.top_p], np.float32)
-                ),
-            )
-            if self.pool is not None:
-                from mlapi_tpu.models.gpt import paged_scatter_fn
+            if self.pool is not None and eng.prefill_page_native:
+                # Page-native admission: ONE dispatch prefills the
+                # joiner's bucket straight into its freshly-mapped
+                # pages at virtual offset pos - bkt — the contiguous
+                # mini cache and its adopt scatter are gone (zero
+                # adopt bytes, same as formation).
+                from mlapi_tpu.models.gpt import paged_prefill_fn
+                from mlapi_tpu.ops.quant import paged_cache_tree
 
                 if self._tab_dirty:
                     self._with_tables()
-                self.cache = paged_scatter_fn()(
-                    self.cache, mini,
-                    jnp.asarray(self.tab[row:row + 1]),
+                cache1 = paged_cache_tree(
+                    self.cache, self.tab[row:row + 1]
+                )
+                first1, cache1 = paged_prefill_fn(eng.model, bkt)(
+                    eng.params, cache1, jnp.asarray(cand.row[None]),
                     jnp.int32(self.pos - bkt),
+                    jnp.asarray(eng._key_data(cand.seed)[None]),
+                    jnp.asarray(
+                        np.asarray([cand.temperature], np.float32)
+                    ),
+                    jnp.asarray(
+                        np.asarray([bkt - cand.used], np.int32)
+                    ),
+                    jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                    jnp.asarray(
+                        np.asarray([cand.top_p], np.float32)
+                    ),
+                )
+                self.cache = paged_cache_tree(
+                    cache1, self.tab[:self.b_cur]
                 )
                 eng._warmed_scatter.add((bkt, self.npv))
             else:
-                self.cache = admit_scatter_fn()(
-                    self.cache, mini, jnp.int32(row),
-                    jnp.int32(self.pos - bkt),
+                first1, mini = prefill_fn(eng.model, bkt)(
+                    eng.params, jnp.asarray(cand.row[None]),
+                    jnp.asarray(eng._key_data(cand.seed)[None]),
+                    jnp.asarray(
+                        np.asarray([cand.temperature], np.float32)
+                    ),
+                    jnp.asarray(
+                        np.asarray([bkt - cand.used], np.int32)
+                    ),
+                    jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                    jnp.asarray(
+                        np.asarray([cand.top_p], np.float32)
+                    ),
                 )
-                eng._warmed_scatter.add((bkt, self.total, self.b_cur))
+                if self.pool is not None:
+                    from mlapi_tpu.models.gpt import paged_scatter_fn
+                    from mlapi_tpu.ops.quant import kv_tree_bytes
+
+                    eng.prefill_adopt_bytes += kv_tree_bytes(mini)
+                    if self._tab_dirty:
+                        self._with_tables()
+                    self.cache = paged_scatter_fn()(
+                        self.cache, mini,
+                        jnp.asarray(self.tab[row:row + 1]),
+                        jnp.int32(self.pos - bkt),
+                    )
+                    eng._warmed_scatter.add((bkt, self.npv))
+                else:
+                    self.cache = admit_scatter_fn()(
+                        self.cache, mini, jnp.int32(row),
+                        jnp.int32(self.pos - bkt),
+                    )
+                    eng._warmed_scatter.add(
+                        (bkt, self.total, self.b_cur)
+                    )
             ftok = int(np.asarray(first1)[0])
             self.n_pad[row] = self.pos - cand.used
             self.temps[row] = cand.temperature
@@ -852,6 +1084,222 @@ class BatchRun:
             eng.admitted += 1
         with eng._alock:
             return len(eng._admit)
+
+    # -- interleaved chunked prefill (paged long-prompt joiners) ------
+    #
+    # A long prompt's prefill is ceil(bucket/cp) fixed-width extend
+    # dispatches. Run back-to-back (the r09 formation path) they stall
+    # every in-flight decode stream for the whole prompt. Here they
+    # become SCHEDULABLE UNITS: `_admit_waiting` stages the joiner as
+    # `self._pf`, the chunk loop dispatches ONE prefill chunk per
+    # decode-chunk boundary (`_pf_step`), and when the chunks are done
+    # and `pos` reaches the planned activation point A, `_pf_activate`
+    # installs the joiner with a pure page-table row assignment — the
+    # prompt's K/V were written ONCE, into the joiner's private pages,
+    # while decode kept running. Head-of-line cost to running streams:
+    # exactly one prefill-chunk dispatch per boundary
+    # (`eng.interleave_max_stall` pins it).
+    #
+    # Placement: the prompt lands at virtual slots [A - bucket, A)
+    # where A = pos0 + m*chunk is fixed at admission (m covers the
+    # chunk count, plus decode-only iterations when the prompt would
+    # otherwise start below slot 0). During the window the loop must
+    # advance pos by exactly `chunk` per iteration, so the spec
+    # re-engage and compaction are suppressed while a prefill is
+    # active (one-shot admissions and growth stay allowed — they never
+    # move `pos`). The joiner's row stays a DUMMY (null table) until
+    # activation, so interleaved decode writes for it die in the null
+    # page instead of clobbering prompt pages. All-pad leading chunks
+    # are skipped outright — nothing ever attends them.
+
+    def _try_start_pf(self, cand, n_live: int) -> bool:
+        """Begin an interleaved chunked prefill for ``cand`` (a
+        long-prompt joiner). Returns True ONLY when the window
+        STARTED (the joiner owns a device row and counts against
+        ``max_batch``); every other outcome returns False — either
+        the candidate was handed back to the collector (strict shape
+        miss, a window that can never fit this batch's cache, pool
+        exhaustion) or it stays staged for a later boundary (another
+        prefill active, batch full)."""
+        eng = self.eng
+        from mlapi_tpu.serving.paged_pool import PagePoolExhausted
+
+        if self._pf is not None:
+            return False  # one interleaved prefill at a time
+        if n_live + 1 > eng.max_batch:
+            return False
+        cp = eng.prompt_buckets[-1]
+        bkt, used = len(cand.row), cand.used
+        if eng._strict_admit and (cp, self.npv) not in eng._warmed_extend:
+            self._unstage(cand)
+            with eng._alock:
+                eng._deferred.append(cand)
+            return False
+        # All-pad leading chunks are skipped (nothing attends them):
+        # the dispatched window covers ceil(used/cp) chunks.
+        bkt_eff = -(-used // cp) * cp
+        n_run = bkt_eff // cp
+        # Activation point A: decode advances `chunk` per boundary and
+        # the prompt must END at the activation position (the row
+        # joins the scalar-pos loop there), with its first real chunk
+        # at a non-negative virtual slot — so A covers n_run
+        # boundaries or the catch-up to the prompt's own length,
+        # whichever is later. Chunks dispatch EAGERLY from the first
+        # boundary (their write coordinates depend on A, not on the
+        # current pos); any remaining boundaries are decode-only.
+        m = max(n_run, -(-max(bkt_eff - self.pos, 0) // eng.chunk))
+        A = self.pos + m * eng.chunk
+        if A + (cand.n_new - 1) > self.total:
+            # Can never finish inside this batch's cache window —
+            # the collector forms it into its own batch instead.
+            self._unstage(cand)
+            with eng._alock:
+                eng._deferred.append(cand)
+            return False
+        used_rows = {
+            self.rows[i] for i, r in enumerate(self.reqs)
+            if not self.done[i] and not r.cancelled
+        }
+        free = [j for j in range(self.b_cur) if j not in used_rows]
+        if not free:
+            if self.b_cur >= self.b_max:
+                return False
+            free = self._grow()
+        row = free[0]
+        self._release_row(row)  # a finished request's leftover pages
+        # Private table: the prompt's pages belong to `ptab` until
+        # activation — the batch row stays a null-table dummy, so
+        # interleaved decode writes for it stay in the null page.
+        ptab = np.zeros((1, self.npv), np.int32)
+        lo_tile = (A - used) // self.page
+        hi_tile = -(-A // self.page)
+        try:
+            pages = self.pool.alloc(hi_tile - lo_tile)
+        except PagePoolExhausted:
+            # The pool is momentarily full of live sequences: hand
+            # the joiner to the next batch, pool left consistent.
+            self._unstage(cand)
+            with eng._alock:
+                eng._deferred.append(cand)
+            return False
+        ptab[0, lo_tile:hi_tile] = pages
+        self._unstage(cand)
+        self._pf = {
+            "cand": cand, "row": row, "ptab": ptab, "A": A,
+            "off": A - bkt, "cp": cp, "skip": (bkt - bkt_eff) // cp,
+            "next": 0, "n_run": n_run, "logits": None,
+        }
+        eng.interleaved_prefills += 1
+        eng.prefill_chunk_queue_depth = n_run
+        return True
+
+    def _pf_dispatch_chunk(self) -> None:
+        """Dispatch the next prefill chunk through the joiner's
+        private table (its virtual offset is already batch-virtual,
+        so activation needs no remap)."""
+        from mlapi_tpu.models.gpt import paged_extend_fn
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        eng, pf = self.eng, self._pf
+        cand, cp = pf["cand"], pf["cp"]
+        c0 = (pf["skip"] + pf["next"]) * cp
+        eng.prefill_chunks += 1
+        cache1 = paged_cache_tree(self.cache, pf["ptab"])
+        cache1, pf["logits"] = paged_extend_fn(eng.model, cp)(
+            eng.params, cache1,
+            jnp.asarray(cand.row[None, c0:c0 + cp]),
+            jnp.int32(pf["off"] + c0),
+            jnp.asarray(np.asarray([pf["A"] - cand.used], np.int32)),
+            jnp.int32(0), jnp.int32(0),
+        )
+        self.cache = paged_cache_tree(cache1, self.tab[:self.b_cur])
+        self._tab_dirty = False
+        pf["next"] += 1
+        eng.prefill_chunk_queue_depth = pf["n_run"] - pf["next"]
+        eng._warmed_extend.add((cp, self.npv))
+
+    def _pf_abort(self) -> None:
+        """Drop a cancelled interleaved prefill: its private pages go
+        back; nothing was installed, so no batch state unwinds."""
+        self.pool.release(self._pf["ptab"])
+        self._pf = None
+        self.eng.prefill_chunk_queue_depth = 0
+
+    def _pf_step(self, live: list) -> None:
+        """One scheduling decision at a chunk boundary: dispatch at
+        most ONE prefill chunk before the decode chunk — the bound
+        `eng.interleave_max_stall` records."""
+        eng, pf = self.eng, self._pf
+        if pf["cand"].cancelled:
+            self._pf_abort()
+            return
+        if pf["next"] >= pf["n_run"]:
+            return  # chunks done; waiting for pos to reach A
+        self._pf_dispatch_chunk()
+        if live:
+            self._pf_consec += 1
+            eng.interleave_max_stall = max(
+                eng.interleave_max_stall, self._pf_consec
+            )
+
+    def _pf_activate(self) -> None:
+        """``pos`` reached the planned activation point with every
+        chunk dispatched: sample the first token from the final
+        chunk's logits (stream index 0 — the draw the formation paths
+        make) and install the joiner as a live row. The install is a
+        page-table ROW ASSIGNMENT — zero cache bytes move."""
+        eng, pf = self.eng, self._pf
+        cand, row = pf["cand"], pf["row"]
+        from mlapi_tpu.models.gpt import sample_fn
+
+        self.chain.invalidate()  # mirrors are about to change
+        if cand.cancelled:
+            self._pf_abort()
+            return
+        first = sample_fn(eng.model)(
+            pf["logits"], jnp.asarray(eng._key_data(cand.seed)[None]),
+            jnp.asarray(np.asarray([cand.temperature], np.float32)),
+            jnp.asarray(np.asarray([cand.top_k], np.int32)),
+            jnp.asarray(np.asarray([cand.top_p], np.float32)),
+        )
+        ftok = int(np.asarray(first)[0])
+        self._release_row(row)  # idempotent: eager release may have run
+        self.tab[row] = pf["ptab"][0]
+        self._tab_dirty = True
+        self.n_pad[row] = pf["A"] - cand.used
+        self.temps[row] = cand.temperature
+        self.topk[row] = cand.top_k
+        self.topp[row] = cand.top_p
+        self.keys[row] = eng._key_data(cand.seed)
+        self.tok[row] = ftok
+        self.step[row] = 1
+        self.reqs.append(cand)
+        self.rows.append(row)
+        self.produced.append(1)
+        self.sched.append(1)
+        cand.push({"token_ids": [ftok]})
+        fin = cand.n_new <= 1
+        if fin:
+            cand.push(None)
+        self.done.append(fin)
+        eng.admitted += 1
+        self._pf = None
+        eng.prefill_chunk_queue_depth = 0
+
+    def _pf_flush(self) -> None:
+        """No live decode rows remain, so nothing can stall: run the
+        remaining prefill chunks back-to-back, jump ``pos`` to the
+        activation point (slots in between belong to no one — the
+        joiner's mask starts at its own prompt), and activate."""
+        pf = self._pf
+        self.chain.drain()
+        if pf["cand"].cancelled:
+            self._pf_abort()
+            return
+        while pf["next"] < pf["n_run"]:
+            self._pf_dispatch_chunk()
+        self.pos = pf["A"]
+        self._pf_activate()
 
     # -- resize -------------------------------------------------------
 
@@ -972,6 +1420,13 @@ class BatchRun:
     def run(self) -> None:
         try:
             self._run()
+        except BaseException:
+            if self._pf is not None:
+                # The interleaved joiner was unstaged but never
+                # installed: append it so the engine wrapper's error
+                # delivery reaches it too (it must not hang).
+                self.reqs.append(self._pf["cand"])
+            raise
         finally:
             # Paged: give every page back (shared prefix pages lose
             # one hold per row) and re-bind the engine pool's device
@@ -995,6 +1450,16 @@ class BatchRun:
             chain.tok_dev = self._first
 
         while True:
+            if (
+                self._pf is not None
+                and self._pf["next"] >= self._pf["n_run"]
+                and self.pos >= self._pf["A"]
+            ):
+                # Interleaved prefill complete and the decode frontier
+                # reached its activation point: install the joiner (a
+                # table-row assignment) before this boundary's
+                # admission/scheduling.
+                self._pf_activate()
             pending_n = 0
             if self.admit and eng._admit:
                 pending_n = self._admit_waiting()
@@ -1018,6 +1483,12 @@ class BatchRun:
                         # dispatch frontier was exhausted first.)
                         self.rows[i] = None
             if not live:
+                if self._pf is not None:
+                    # Nothing to stall: finish the interleaved prefill
+                    # back-to-back and activate its row — it becomes
+                    # the batch's only live member.
+                    self._pf_flush()
+                    continue
                 # Every remaining consumer disconnected, finished, or
                 # is fully covered by in-flight chunks: deliver what's
                 # pending and stop scheduling device time.
@@ -1034,6 +1505,9 @@ class BatchRun:
             if (
                 self.spec_hist is not None and self.b_cur == 1
                 and live == [0] and not pending_n
+                # Never during an interleaved prefill: spec rounds
+                # move `pos` off the activation-point plan.
+                and self._pf is None
                 # Cheap frontier-side disqualifiers first: breaking
                 # the dispatch chain (a full drain) is only worth it
                 # when the spec phase could actually run rounds.
@@ -1054,7 +1528,16 @@ class BatchRun:
             if size <= 0:
                 chain.drain()
                 break  # cache exhausted — safety net below
-            self._maybe_shrink(live, pending_n)
+            # An active interleaved prefill suppresses compaction
+            # (its row plan pins device row indices) — fold it into
+            # the pending count the shrink policy already respects.
+            self._maybe_shrink(
+                live, pending_n + (1 if self._pf is not None else 0)
+            )
+            if self._pf is not None:
+                # At most ONE prefill-chunk dispatch ahead of this
+                # boundary's decode chunk — the interleaving bound.
+                self._pf_step(live)
             if self.pool is not None:
                 # Map the chunk's write range to pool pages (and push
                 # any table change to the device mirrors) BEFORE the
@@ -1062,6 +1545,7 @@ class BatchRun:
                 # with the pool metadata still consistent.
                 self._ensure_pages(size, live)
             self._decode_chunk(size, live)
+            self._pf_consec = 0
         chain.drain()
         # Safety net: every waiter MUST get a terminator. The
         # collector/admission only group window-compatible requests,

@@ -136,6 +136,8 @@ class InferenceEngine:
         decode_attn_impl: str | None = None,
         kv_page_size: int | None = None,
         kv_pages: int | None = None,
+        prefill_page_native: bool = True,
+        prefill_interleave: bool = True,
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -323,6 +325,8 @@ class InferenceEngine:
                 fused_batch=fused_batch,
                 kv_page_size=kv_page_size,
                 kv_pages=kv_pages,
+                prefill_page_native=prefill_page_native,
+                prefill_interleave=prefill_interleave,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
@@ -563,6 +567,8 @@ class TextGenerationEngine:
         fused_batch: bool | str = "auto",
         kv_page_size: int | None = None,
         kv_pages: int | None = None,
+        prefill_page_native: bool = True,
+        prefill_interleave: bool = True,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -733,6 +739,22 @@ class TextGenerationEngine:
                 model, page_size=int(kv_page_size),
                 num_pages=int(kv_pages),
             )
+        # Page-native prefill (r10): bucket prefill and admission write
+        # K/V straight into pool pages through the page table — the
+        # contiguous-then-adopt copy (one full extra write of
+        # everything prefill just produced) drops to exactly zero
+        # bytes. False keeps the r09 adopt path (legacy), which is
+        # what makes the `generate.prefill_adopt_bytes` gauge a live
+        # comparison, not a dead assertion. Contiguous engines ignore
+        # both flags.
+        self.prefill_page_native = bool(prefill_page_native)
+        # Chunked-prefill interleaving (r10): a long-prompt joiner's
+        # fixed-width prefill chunks become schedulable units
+        # interleaved one-for-one with the running batch's decode
+        # chunks, so in-flight streams stall by at most ONE
+        # prefill-chunk dispatch instead of the whole prompt
+        # (paged engines only — activation is a page-table install).
+        self.prefill_interleave = bool(prefill_interleave)
         # KV-cache storage format and decode-attention impl, owned by
         # the MODEL (program factories key on them); mirrored here for
         # /metrics and bench.
@@ -787,6 +809,41 @@ class TextGenerationEngine:
         self.fused_calls = 0
         self.fused_spec_calls = 0
         self.fused_batch_calls = 0
+        # Page-native prefill + interleaving observability (r10). All
+        # byte counters are exact dtype/shape arithmetic
+        # (ops/quant.kv_tree_bytes), never wall-clock:
+        # - prefill_adopt_bytes: bytes the legacy contiguous-then-
+        #   adopt formation/admission path re-copied into pool pages
+        #   (MUST read 0 on the page-native path).
+        # - prefix_adopt_bytes: once-per-entry-lifetime prefix KV
+        #   adoption (cache residency, not a per-batch copy).
+        # - kv_prefix_copy_fallback: stacked (cross-prefix) groups
+        #   that could NOT share pages because a region shift was not
+        #   page-aligned (fell back to r09 copy semantics).
+        # - interleaved_prefills / interleave_max_stall /
+        #   prefill_chunk_queue_depth: chunked-prefill interleaving —
+        #   max_stall is the largest run of consecutive prefill-chunk
+        #   dispatches while live decode rows waited (the bound the
+        #   design pins at 1).
+        # - spec_realign_table_ops / spec_realign_repacks: paged
+        #   batched-speculation handoffs realigned as a host table
+        #   shift vs the loud device row-gather fallback.
+        self.prefill_adopt_bytes = 0
+        self.prefix_adopt_bytes = 0
+        self.kv_prefix_copy_fallback = 0
+        self.interleaved_prefills = 0
+        self.interleave_max_stall = 0
+        self.prefill_chunk_queue_depth = 0
+        self.spec_realign_table_ops = 0
+        self.spec_realign_repacks = 0
+        # TTFT / inter-token reservoirs, recorded at the push seam.
+        from mlapi_tpu.serving.requests import LatencyStats
+
+        self.latency = LatencyStats()
+        # (chunk width, table width) pairs whose paged chunked-extend
+        # program is compiled — strict mode gates interleaved
+        # admission on this set.
+        self._warmed_extend: set = set()
         # Host-loop speculation phase: rounds + warmed-shape state
         # live in serving/spec_phase.py.
         self.spec = SpecPhase(self)
@@ -1047,7 +1104,7 @@ class TextGenerationEngine:
         row[-used:] = raw[-used:]
         return GenRequest(
             row, used, n_new, temperature, seed, loop, top_k, top_p,
-            prefix=entry, stream=stream,
+            prefix=entry, stream=stream, stats=self.latency,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -1540,32 +1597,81 @@ class TextGenerationEngine:
         if self.pool is not None:
             # Paged admission: growth and compaction are host-side
             # page-table ops (no device gather to warm), and the
-            # admission scatter is batch-size-independent — one [1, W]
-            # mini lands in one table row whatever the running batch
-            # is. Warm that one scatter per prompt bucket against the
-            # null page and key the warmed set on (bucket, table
-            # width) — the shape pair the paged scatter compiles on.
-            from mlapi_tpu.models.gpt import paged_scatter_fn
+            # admission program is batch-size-independent — one [1, W]
+            # row lands in one table row whatever the running batch
+            # is. Page-native mode warms the joiner's direct-to-pages
+            # prefill (the ONE admission program — prefill and landing
+            # fused); legacy mode warms the adopt scatter it pairs
+            # with the contiguous joiner prefill above. Both key on
+            # (bucket, table width), the shape pair they compile on.
+            # All warm writes go through a null table, i.e. into the
+            # never-read null page — the pool is untouched.
+            from mlapi_tpu.models.gpt import (
+                paged_extend_fn, paged_prefill_fn, paged_scatter_fn,
+                sample_fn,
+            )
             from mlapi_tpu.ops.quant import (
                 paged_cache_tree, paged_pools_of,
             )
 
+            tiers = {
+                min(self.model.max_positions, rb + tier)
+                for rb in self.prompt_buckets
+            }
+            one_key = jnp.asarray(self._key_data(0)[None])
+            zt1 = jnp.asarray(np.zeros((1,), np.float32))
+            zk1 = jnp.asarray(np.zeros((1,), np.int32))
+            op1 = jnp.asarray(np.ones((1,), np.float32))
             for bj in self.prompt_buckets:
-                for total in {
-                    min(self.model.max_positions, rb + tier)
-                    for rb in self.prompt_buckets
-                }:
+                for total in tiers:
                     if bj >= total:
                         continue
                     npv = -(-total // self.pool.page)
                     tab1 = np.zeros((1, npv), np.int32)
                     cache = paged_cache_tree(self.pool.layers, tab1)
-                    cache = paged_scatter_fn()(
-                        cache, self.model.init_cache(1, bj),
-                        jnp.asarray(tab1), jnp.int32(0),
-                    )
+                    if self.prefill_page_native:
+                        row = np.full(
+                            (1, bj), self.tokenizer.pad_id, np.int32
+                        )
+                        _, cache = paged_prefill_fn(self.model, bj)(
+                            self.params, cache, jnp.asarray(row),
+                            jnp.int32(0), one_key, zt1,
+                            jnp.asarray(
+                                np.asarray([max(bj - 1, 0)], np.int32)
+                            ),
+                            zk1, op1,
+                        )
+                    else:
+                        cache = paged_scatter_fn()(
+                            cache, self.model.init_cache(1, bj),
+                            jnp.asarray(tab1), jnp.int32(0),
+                        )
                     self.pool.layers = paged_pools_of(cache)
                     self._warmed_scatter.add((bj, npv))
+                    shapes += 1
+            if self.prefill_interleave:
+                # Interleaved long-prompt admission: the cp-wide paged
+                # extend chunk at [1, npv] plus the standalone sampler
+                # — the two programs an interleaved prefill dispatches.
+                cp = self.prompt_buckets[-1]
+                for total in tiers:
+                    npv = -(-total // self.pool.page)
+                    tab1 = np.zeros((1, npv), np.int32)
+                    cache = paged_cache_tree(self.pool.layers, tab1)
+                    cache, logits = paged_extend_fn(self.model, cp)(
+                        self.params, cache,
+                        jnp.asarray(np.full(
+                            (1, cp), self.tokenizer.pad_id, np.int32
+                        )),
+                        jnp.int32(0),
+                        jnp.asarray(np.asarray([cp - 1], np.int32)),
+                        jnp.int32(0), jnp.int32(0),
+                    )
+                    self.pool.layers = paged_pools_of(cache)
+                    sample_fn(self.model)(
+                        logits, one_key, zt1, zk1, op1
+                    )
+                    self._warmed_extend.add((cp, npv))
                     shapes += 1
             return shapes
         for run_bucket in self.prompt_buckets:

@@ -115,6 +115,20 @@ class PrefixCache:
                 f"{eng.model.max_positions})"
             )
         bucket = min(max(eng._bucket(len(ids)), len(ids)), cap)
+        if eng.pool is not None:
+            # Page-align the prefix bucket AT STORE TIME: region ends
+            # and right-alignment shifts between entries then land on
+            # page boundaries, so stacked (cross-prefix) groups share
+            # ref-counted pages instead of copying widened stacks
+            # (BatchRun._prefill_paged_prefix), and a same-fp batch's
+            # suffix starts on a fresh tile (no COW). A few pad slots
+            # per entry buy pointer sharing per batch. When the model
+            # window can't fit the aligned bucket the entry stays
+            # unaligned — groups containing it fall back to copy
+            # semantics, counted in ``eng.kv_prefix_copy_fallback``.
+            aligned = -(-bucket // eng.pool.page) * eng.pool.page
+            if aligned <= cap:
+                bucket = aligned
         row = np.full((1, bucket), eng.tokenizer.pad_id, np.int32)
         row[0, -len(ids):] = ids
         lo = bucket - len(ids)

@@ -13,6 +13,66 @@ they all speak in these types.
 from __future__ import annotations
 
 import asyncio
+import collections
+import threading
+import time
+
+
+class LatencyStats:
+    """Bounded reservoir of per-request latency samples, recorded at
+    token DELIVERY time (the ``push`` seam every serving path funnels
+    through — chunked, fused, speculative, interleaved): TTFT is
+    submit→first-chunk, inter-token is the per-token share of each
+    chunk gap. One instance per engine; ``/metrics`` and the bench
+    read :meth:`summary`. Thread-safe (pushes come from the decode
+    thread, scrapes from the event loop); bounded so a long-lived
+    server's memory stays flat."""
+
+    def __init__(self, cap: int = 2048):
+        self._ttft_ms: collections.deque = collections.deque(maxlen=cap)
+        self._itl_ms: collections.deque = collections.deque(maxlen=cap)
+        self._lock = threading.Lock()
+
+    def record_first(self, ms: float) -> None:
+        with self._lock:
+            self._ttft_ms.append(ms)
+
+    def record_gap(self, ms_per_token: float) -> None:
+        with self._lock:
+            self._itl_ms.append(ms_per_token)
+
+    @staticmethod
+    def _q(xs: list, q: float) -> float | None:
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    def summary(self) -> dict:
+        """p50/p95 of both series (ms; ``None`` until samples exist)."""
+        with self._lock:
+            t, i = list(self._ttft_ms), list(self._itl_ms)
+        r = lambda v: None if v is None else round(v, 2)  # noqa: E731
+        return {
+            "ttft_p50_ms": r(self._q(t, 0.50)),
+            "ttft_p95_ms": r(self._q(t, 0.95)),
+            "intertoken_p50_ms": r(self._q(i, 0.50)),
+            "intertoken_p95_ms": r(self._q(i, 0.95)),
+        }
+
+
+def _record_push(sink, item) -> None:
+    """Shared delivery-time bookkeeping for GenRequest/_SyncSink: fold
+    this chunk into the engine's latency reservoirs."""
+    if sink.stats is None or not isinstance(item, dict):
+        return
+    now = time.perf_counter()
+    n = len(item.get("token_ids", ())) or 1
+    if sink.t_last is None:
+        sink.stats.record_first((now - sink.t0) * 1e3)
+    else:
+        sink.stats.record_gap((now - sink.t_last) * 1e3 / n)
+    sink.t_last = now
 
 
 class GenRequest:
@@ -24,11 +84,12 @@ class GenRequest:
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
-        "prompt_tokens",
+        "prompt_tokens", "stats", "t0", "t_last",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
-                 top_k=0, top_p=1.0, prefix=None, stream=False):
+                 top_k=0, top_p=1.0, prefix=None, stream=False,
+                 stats: LatencyStats | None = None):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -63,9 +124,15 @@ class GenRequest:
             self.prompt_tokens = used
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
+        # Engine latency reservoirs (None for warmup requests): TTFT
+        # and inter-token samples recorded as chunks are pushed.
+        self.stats = stats
+        self.t0 = time.perf_counter()
+        self.t_last: float | None = None
 
     def push(self, item) -> None:
         """Thread-safe enqueue from the decode thread."""
+        _record_push(self, item)
         self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
 
     def cancel(self) -> None:
@@ -102,11 +169,13 @@ class _SyncSink:
         self.prefix_fp, self.prefix_kv = req.prefix_fp, req.prefix_kv
         self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
         self.stream = req.stream
+        self.stats, self.t0, self.t_last = req.stats, req.t0, None
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
 
     def push(self, item) -> None:
+        _record_push(self, item)
         if isinstance(item, Exception):
             self.error = item
         elif item is not None:

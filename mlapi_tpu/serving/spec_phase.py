@@ -30,7 +30,8 @@ class SpecPhase:
         self.warmed: set = set()
 
     def run_solo(self, r, cache, pos, total, bucket, tok, step,
-                    produced, n_pad, keys, history, temps, topk, topp):
+                    produced, n_pad, keys, history, temps, topk, topp,
+                    ensure=None):
         """Run speculative rounds for a single request against the
         engine's live target cache; returns ``(cache, pos)`` for
         the normal decode loop to resume from. Mutates the host
@@ -47,7 +48,14 @@ class SpecPhase:
 
         Each round is TWO device dispatches (scan-propose + verify)
         regardless of k — through the tunneled attach this, not the
-        acceptance rate, is what sets the wall-clock win."""
+        acceptance rate, is what sets the wall-clock win.
+
+        ``ensure`` (paged targets): ``cache = ensure(cache, lo, hi)``
+        maps virtual slots ``[lo, hi)`` to pool pages before each
+        verify block writes them — the phase's stand-in for the chunk
+        loop's boundary allocation. The DRAFT cache stays contiguous
+        (the draft has no pool), so the draft-side programs are
+        untouched by paging."""
         eng = self.eng
         from mlapi_tpu.models.gpt import (
             decode_chunk_fn, extend_chunk_fn, prefill_fn,
@@ -132,6 +140,9 @@ class SpecPhase:
             # (temp 0) argmax inside the same program; sampled rows
             # draw from the draft's warped distribution at the
             # DRAFT-tagged per-token streams.
+            if ensure is not None:
+                # The verify block writes [t_upto, t_upto + k + 1).
+                cache = ensure(cache, t_upto, t_upto + k + 1)
             step0 = int(produced[0])
             d_cache, props, q_probs = propose_fn(
                 eng.draft_model, len(d_pend), k, sampled
@@ -187,7 +198,8 @@ class SpecPhase:
 
     def run_batched(self, reqs, cache, pos, total, bucket,
                             prompt, tok, step, produced, done, n_pad,
-                            keys, b_cur):
+                            keys, b_cur, ensure=None,
+                            paged_realign=None):
         """Speculative rounds for a WHOLE freshly-formed greedy batch:
         every row drafts k proposals and verifies them in one block
         per round, advancing by its OWN acceptance length (the
@@ -205,6 +217,12 @@ class SpecPhase:
         synchronized. Engages only at batch FORMATION; after a
         handoff the batch stays on the chunk loop (library twin with
         the full algebra: ``ops.speculative.speculative_generate_batched``).
+
+        Paged targets pass ``ensure`` (per-round page mapping — see
+        :meth:`run_solo`) and ``paged_realign(cache, delta, top)``,
+        which replaces ``realign_fn``'s byte roll: a host page-table
+        shift when every delta is a page multiple, the counted
+        device row-gather rewrite otherwise (DESIGN §16).
         """
         eng = self.eng
         from mlapi_tpu.models.gpt import prefill_fn, realign_fn
@@ -272,6 +290,12 @@ class SpecPhase:
             props = np.asarray(props)
             d_upto += n_in + k - 1
 
+            if ensure is not None:
+                # Every row's verify block writes
+                # [t_upto_b, t_upto_b + k + 1).
+                cache = ensure(
+                    cache, int(t_upto.min()), int(t_upto.max()) + k + 1
+                )
             block = np.concatenate(
                 [np.asarray(tok[:b_cur], np.int32)[:, None], props],
                 axis=1,
@@ -318,7 +342,10 @@ class SpecPhase:
         top = int(t_upto.max())
         if int(t_upto.min()) < top:
             delta = (top - t_upto).astype(np.int32)
-            cache = realign_fn()(cache, jnp.asarray(delta))
+            if paged_realign is not None:
+                cache = paged_realign(cache, delta, top)
+            else:
+                cache = realign_fn()(cache, jnp.asarray(delta))
             n_pad += delta  # in place: the chunk loop's mirror
         return cache, top
 

@@ -299,16 +299,19 @@ def test_prefix_hit_shares_pages_not_copies(gpt_params):
 
 
 def test_cow_divergence_after_shared_prefix(gpt_params):
-    # page 12 does NOT divide the 64-slot prefix bucket: the suffix's
-    # first tokens land mid-page, so every row must diverge the shared
-    # tail page by COPY-ON-WRITE — and the shared pages must come out
-    # unscathed (the first suffix replays identically afterwards).
+    # Entries page-align their buckets at store time (r10), so COW
+    # only arises when the model window cannot FIT the aligned bucket:
+    # a 135-token prefix hits cap 143 (aligned would be 144), stays
+    # unaligned, and the suffix's first tokens land mid-page — every
+    # row must diverge the shared tail page by COPY-ON-WRITE, and the
+    # shared pages must come out unscathed (the first suffix replays
+    # identically afterwards).
     model = _model()
     cont = _engine(model, gpt_params, paged=False)
     paged = _engine(model, gpt_params, paged=True, kv_page_size=12)
-    pre = "You are a helpful bot."
+    pre = "b" * 135
     outs = {}
-    for sfx in (" alpha", " a very different beta"):
+    for sfx in (" alpha", " other beta"):
         a = cont.generate_text(sfx, max_new_tokens=8, prefix=pre)
         b = paged.generate_text(sfx, max_new_tokens=8, prefix=pre)
         assert a["token_ids"] == b["token_ids"], sfx
@@ -508,13 +511,16 @@ def test_paged_churn_no_leaks(gpt_params):
     """Soak the page lifecycle: many sequential batches across plain,
     prefix-shared, COW-diverging, and OOM-rejected traffic — the pool
     must end with only entry page sets held and a clean free list
-    (every alloc matched by a release)."""
+    (every alloc matched by a release). The prefix is cap-clamped
+    (135 tokens: aligned 144 > cap 143) so it stays UNALIGNED at page
+    12 and every suffix batch still exercises the COW divergence —
+    store-time alignment (r10) removes it for alignable entries."""
     model = _model()
     eng = _engine(model, gpt_params, paged=True, kv_page_size=12)
-    pre = "You are a helpful bot."
+    pre = "b" * 135
     for i in range(6):
         eng.generate_text(f"plain {i}", max_new_tokens=10)
-        eng.generate_text(f" suffix {i}", max_new_tokens=6, prefix=pre)
+        eng.generate_text(f" sfx {i}", max_new_tokens=6, prefix=pre)
     entry_pages = eng.pool.entry_pages(pre)
     assert entry_pages is not None
     # Only the entry's own holds remain.
