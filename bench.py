@@ -489,6 +489,120 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _extend_report(ck: str, env: dict) -> dict:
+    """Subprocess (BENCH_GEN_EXTEND=1): einsum vs flash-EXTEND on the
+    SAME checkpoint — the multi-token half of the kernel story
+    (chunked long-prompt prefill + a speculative verify span), per
+    the variance rule:
+
+    - **Modeled bytes/chunk — exact dtype arithmetic, asserted.**
+      ``engine.extend_bytes_per_chunk()`` must equal the closed-form
+      layer arithmetic for every (impl, format) cell, the int8 flash
+      chunk read must clear the committed 2D/(D+4) factor below the
+      full-precision read, and the einsum int8 cell must demonstrably
+      NOT realize it (storage + materialized operand). Byte counts
+      compare across days; wall-clock does not.
+    - **Throughput — interleaved, report-only.** einsum and flash
+      engines prefill the same long prompt (2 fixed-width extend
+      chunks each) and serve a draft==target speculative request
+      (verify spans through ``extend_core``) inside ONE window;
+      their token streams are asserted IDENTICAL.
+    """
+    src = f"""
+import json, time
+import dataclasses
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+# prompt_buckets=(16, 64) makes the chunked-prefill width
+# (prompt_buckets[-1]) 64, so the 100-token prompt below rounds to a
+# 128-wide bucket served as TWO 64-token extend chunks, with decode
+# room left in the model's 256-position window. The modeled-bytes
+# block is a different shape on purpose: it uses the engine's
+# DEFAULT bucket/tier accounting (64-bucket + 32-token tier = a
+# 96-slot cache), the same config decode_bytes_per_step commits to.
+kw = dict(tokenizer=tok, chunk=8, fused_single=False,
+          prompt_buckets=(16, 64))
+engs = {{}}
+for impl in ("einsum", "flash"):
+    for fmt in ("none", "int8"):
+        m = dataclasses.replace(model, kv_quant=fmt,
+                                decode_attn_impl=impl)
+        engs[impl + "/" + fmt] = TextGenerationEngine(m, params, **kw)
+
+# --- modeled bytes/chunk: exact closed form, asserted ---------------
+cfg = meta.config["model_kwargs"]
+layers, h, d = cfg["num_layers"], cfg["num_heads"], (
+    cfg["hidden_size"] // cfg["num_heads"])
+total = 64 + 32  # largest bucket + default token tier
+f32 = layers * 2 * total * h * d * 4
+int8 = layers * 2 * (total * h * d + total * h * 4)
+report = {{}}
+for key, eng in engs.items():
+    b = eng.extend_bytes_per_chunk()
+    report[key.replace("/", "_") + "_extend_bytes_per_chunk"] = b
+assert report["flash_none_extend_bytes_per_chunk"] == f32
+assert report["flash_int8_extend_bytes_per_chunk"] == int8
+assert report["einsum_none_extend_bytes_per_chunk"] == f32
+assert report["einsum_int8_extend_bytes_per_chunk"] == f32 + int8
+ratio = f32 / int8
+assert abs(ratio - (4 * d) / (d + 4)) < 1e-9  # f32 cache: 4D/(D+4)
+report["flash_chunk_read_ratio_none_over_int8"] = round(ratio, 3)
+report["extend_bytes_asserted"] = True
+
+# --- interleaved chunked prefill + spec verify, streams pinned ------
+N = 8
+long_p = "x" * 100  # -> [128] bucket, two 64-token extend chunks
+spec = {{}}
+for impl in ("einsum", "flash"):
+    m = dataclasses.replace(model, decode_attn_impl=impl)
+    spec[impl] = TextGenerationEngine(
+        m, params, draft=(m, params), spec_k=4, **kw)
+for eng in list(engs.values()) + list(spec.values()):  # compile off the clock
+    eng.generate_text(long_p, max_new_tokens=N)
+toks = {{k: 0 for k in engs}}
+secs = {{k: 0.0 for k in engs}}
+for _ in range(3):  # interleaved rounds
+    for key, eng in engs.items():
+        t0 = time.perf_counter()
+        out = eng.generate_text(long_p, max_new_tokens=N)
+        secs[key] += time.perf_counter() - t0
+        toks[key] += len(out["token_ids"])
+for key in engs:
+    report[key.replace("/", "_") + "_chunked_tokens_per_s"] = round(
+        toks[key] / secs[key], 1)
+streams = {{k: engs[k].generate_text(long_p, max_new_tokens=N)
+           ["token_ids"] for k in engs}}
+assert streams["flash/none"] == streams["einsum/none"]
+assert streams["flash/int8"] == streams["einsum/int8"]
+s_out = {{k: spec[k].generate_text("verify spans", max_new_tokens=16)
+         ["token_ids"] for k in spec}}
+assert s_out["flash"] == s_out["einsum"]
+assert spec["flash"].spec_rounds > 0  # verify spans actually ran
+report["spec_verify_rounds_flash"] = spec["flash"].spec_rounds
+report["streams_cross_impl_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"extend_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def _paged_report(ck: str, env: dict) -> dict:
     """Subprocess: paged vs contiguous KV allocation on the SAME
     checkpoint. Two claim classes, per the variance-bound rule:
@@ -909,6 +1023,12 @@ def bench_generate() -> None:
             # arithmetic, asserted in-subprocess) + interleaved
             # throughput with token-identity asserted.
             kv_extras.update(_paged_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_EXTEND") == "1":
+            # einsum vs flash-EXTEND (chunked prefill + spec verify
+            # spans), interleaved in one window + modeled bytes/chunk
+            # per config (exact dtype arithmetic asserted; streams
+            # asserted identical across impls).
+            kv_extras.update(_extend_report(ck, server_env))
         if os.environ.get("BENCH_GEN_PREFILL") == "1":
             # Page-native prefill (adopt bytes 0 vs legacy, exact
             # arithmetic asserted) + chunked-prefill interleaving:

@@ -72,14 +72,16 @@ class GptLM:
     # (prefill_fn, decode_chunk_fn, generate_tier_fn, ...) keys on the
     # cache format for free.
     kv_quant: str = "none"
-    # Decode-step attention: "einsum" (the reference oracle — one
-    # [B,1,H,D] x [B,L,H,D] einsum over the dequantized cache) or
-    # "flash" (the Pallas split-K flash-decode kernel,
-    # ops/pallas/decode_attention.py, which reads int8 cache tiles
+    # Cache-read attention: "einsum" (the reference oracle — one
+    # [B,U,H,D] x [B,L,H,D] einsum over the dequantized cache) or
+    # "flash" (the Pallas split-K kernels,
+    # ops/pallas/decode_attention.py, which read int8 cache tiles
     # in-kernel — the 2x HBM saving reaches the READ, not just
     # storage). A MODEL field like kv_quant, so every cached program
-    # factory keys on the decode impl for free. Single-token decode
-    # steps only; block extends (extend_core) stay einsum.
+    # factory keys on the impl for free. "flash" covers BOTH span
+    # widths: single-token decode steps take the flash-decode kernel
+    # and multi-token blocks (extend_core — chunked prefill,
+    # admission, speculative verify) its U-token flash-extend twin.
     decode_attn_impl: str = "einsum"
 
     def __post_init__(self):
@@ -338,6 +340,12 @@ class GptLM:
         ``(cache, last_logits [B, V])`` — or, with ``all_logits=True``
         (speculative-decoding verification), logits at EVERY block
         position ``[B, U, V]``.
+
+        Under ``decode_attn_impl="flash"`` the block attends through
+        the U-token flash-extend kernel (``cached_attend`` routes on
+        the query width), so chunked prefill, admission mini-prefills
+        and speculative verify read the cache at its stored byte
+        format — the einsum read stays the oracle.
         """
         from mlapi_tpu.ops.quant import kv_cache_seq_len
 
@@ -358,7 +366,8 @@ class GptLM:
             def attend(q, k_new, v_new, *, _n=n):
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos0, mask,
-                    cdt, hd,
+                    cdt, hd, impl=self.decode_attn_impl,
+                    mesh=self.mesh,
                 )
                 return out
 
@@ -661,13 +670,16 @@ def cached_attend(
       einsum attends — the full-precision operand materializes
       between the dequant and the einsum, so the int8 format saves
       storage but not read traffic.
-    - ``"flash"``: single-token queries route to the Pallas split-K
-      flash-decode kernel (``ops/pallas/decode_attention``), which
-      reads the STORED tiles — int8 payload + scales dequantized per
-      tile in registers — so int8 is what crosses HBM on the read.
-      Multi-token blocks (``extend_core``) keep the einsum path
-      (block prefill is MXU-bound; the kernel is a decode
-      bandwidth lever).
+    - ``"flash"``: the Pallas split-K kernels
+      (``ops/pallas/decode_attention``) read the STORED tiles — int8
+      payload + scales dequantized per tile in registers — so int8
+      is what crosses HBM on the read. Single-token queries take the
+      flash-decode kernel; multi-token blocks (``extend_core``:
+      chunked prefill, admission mini-prefills, prefix suffixes,
+      speculative verify) take its U-token flash-extend twin, whose
+      ``[B, U, L]`` mask (``extend_positions_and_mask``) carries the
+      causal intra-span structure — every token the server processes
+      reads the cache at its stored byte format.
 
     PAGED cache layers (``ops/quant.kv_is_paged_layer``: pool +
     page-table) route through the same two impls: the einsum path
@@ -693,12 +705,15 @@ def cached_attend(
 
     expand = expand or (lambda t: t)
     new_layer = kv_cache_append(cache_layer, k_new, v_new, pos, cdt)
-    if impl == "flash" and q.shape[1] == 1:
+    if impl == "flash":
         from mlapi_tpu.ops.pallas import (
             decode_attention, decode_attention_tp,
+            extend_attention, extend_attention_tp,
             paged_decode_attention, paged_decode_attention_tp,
+            paged_extend_attention, paged_extend_attention_tp,
         )
 
+        u = q.shape[1]
         paged = kv_is_paged_layer(new_layer)
         if kv_is_quantized_layer(new_layer):
             k = {"q": new_layer["k_q"], "scale": new_layer["k_scale"]}
@@ -707,7 +722,13 @@ def cached_attend(
         else:
             k, v = new_layer["k"], new_layer["v"]
             kvh = new_layer["k"].shape[2]
-        mask2 = valid[:, 0, 0, :].astype(jnp.float32)
+        # Single-token steps carry a [B, 1, 1, L] validity; extends a
+        # [B, 1, U, L] one. Both collapse the same way: drop the
+        # broadcast head axis, keep one mask row per query row.
+        if u == 1:
+            mask2 = valid[:, 0, 0, :].astype(jnp.float32)  # [B, L]
+        else:
+            mask2 = valid[:, 0].astype(jnp.float32)        # [B, U, L]
         scale = 1.0 / head_dim**0.5
         # Interpret ONLY on CPU (the CI backend). On TPU the
         # compiled kernel runs; any other accelerator attempts a
@@ -725,22 +746,32 @@ def cached_attend(
         use_tp = tp > 1 and kvh % tp == 0 and q.shape[2] % tp == 0
         if paged:
             table = new_layer["table"]
+            fn_tp = (
+                paged_decode_attention_tp if u == 1
+                else paged_extend_attention_tp
+            )
+            fn = (
+                paged_decode_attention if u == 1
+                else paged_extend_attention
+            )
             if use_tp:
-                ctx = paged_decode_attention_tp(
+                ctx = fn_tp(
                     mesh, q, k, v, table, mask2, scale=scale,
                     interpret=interp,
                 )
             else:
-                ctx = paged_decode_attention(
+                ctx = fn(
                     q, k, v, table, mask2, scale=scale,
                     interpret=interp,
                 )
         elif use_tp:
-            ctx = decode_attention_tp(
+            fn_tp = decode_attention_tp if u == 1 else extend_attention_tp
+            ctx = fn_tp(
                 mesh, q, k, v, mask2, scale=scale, interpret=interp,
             )
         else:
-            ctx = decode_attention(
+            fn = decode_attention if u == 1 else extend_attention
+            ctx = fn(
                 q, k, v, mask2, scale=scale, interpret=interp,
             )
         return ctx, new_layer

@@ -105,9 +105,10 @@ class LlamaLM:
     # ("none" | "int8"); composes with GQA (the int8 payload shrinks
     # the ALREADY-grouped [B, L, KVH, D] cache a further ~2x).
     kv_quant: str = "none"
-    # Decode-step attention — same contract as
-    # ``GptLM.decode_attn_impl`` ("einsum" | "flash"). The flash
-    # kernel is GQA-native: scales and payload index per KV head,
+    # Cache-read attention — same contract as
+    # ``GptLM.decode_attn_impl`` ("einsum" | "flash"; "flash" covers
+    # single-token decode AND multi-token extend spans). The flash
+    # kernels are GQA-native: scales and payload index per KV head,
     # queries grouped in-register — the repeated K/V tensor the
     # einsum path broadcasts (``_repeat_kv``) never exists.
     decode_attn_impl: str = "einsum"
@@ -369,7 +370,10 @@ class LlamaLM:
                     prefix_len, prefix_lo, all_logits: bool = False):
         """Fused block forward against an existing cache — same
         contract as ``GptLM.extend_core`` (rotary positions per row,
-        GQA kv broadcast via the shared ``cached_attend``)."""
+        GQA kv broadcast via the shared ``cached_attend``; under
+        ``decode_attn_impl="flash"`` the block reads the cache through
+        the GQA-native flash-extend kernel, where the repeated K/V
+        tensor the einsum path broadcasts never exists)."""
         from mlapi_tpu.models.gpt import (
             cached_attend, extend_positions_and_mask,
         )
@@ -391,6 +395,7 @@ class LlamaLM:
                 out, new_cache[f"layer_{_n}"] = cached_attend(
                     cache[f"layer_{_n}"], q, k_new, v_new, pos0, mask,
                     cdt, self.head_dim, expand=self._repeat_kv,
+                    impl=self.decode_attn_impl, mesh=self.mesh,
                 )
                 return out
 

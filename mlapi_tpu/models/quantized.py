@@ -68,6 +68,11 @@ class QuantizedModel:
 
     def extend_core(self, params, cache, token_ids, pos0, n_pad,
                     prefix_len, prefix_lo, all_logits: bool = False):
+        # The inner model's decode_attn_impl/mesh route the block's
+        # cache read (einsum oracle or the flash-extend kernel), so
+        # int8 WEIGHTS and a kernel-native int8 CACHE read compose in
+        # one program: weights dequantize here, cache tiles dequantize
+        # inside the kernel — neither knows about the other.
         return self.inner.extend_core(
             self._deq(params), cache, token_ids, pos0, n_pad,
             prefix_len, prefix_lo, all_logits,

@@ -12,13 +12,22 @@ hand control of VMEM/MXU beats the XLA default:
   path: single-query attention over the stored KV cache, int8
   payload + scale tiles dequantized per tile in registers — int8 is
   what crosses HBM on the decode read.
+- ``extend_attention`` — flash-extend, the U-token-query twin: every
+  multi-token span (chunked prefill, admission mini-prefills,
+  speculative verify) streams the stored cache through the same
+  split-K grid, so the byte saving covers every token the server
+  processes, not just decode steps.
 """
 
 from mlapi_tpu.ops.pallas.decode_attention import (
     decode_attention,
     decode_attention_tp,
+    extend_attention,
+    extend_attention_tp,
     paged_decode_attention,
     paged_decode_attention_tp,
+    paged_extend_attention,
+    paged_extend_attention_tp,
 )
 from mlapi_tpu.ops.pallas.flash_attention import (
     flash_attention,
@@ -28,8 +37,12 @@ from mlapi_tpu.ops.pallas.flash_attention import (
 __all__ = [
     "decode_attention",
     "decode_attention_tp",
+    "extend_attention",
+    "extend_attention_tp",
     "paged_decode_attention",
     "paged_decode_attention_tp",
+    "paged_extend_attention",
+    "paged_extend_attention_tp",
     "flash_attention",
     "flash_attention_with_lse",
 ]

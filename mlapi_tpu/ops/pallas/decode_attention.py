@@ -1,5 +1,6 @@
-"""Fused flash-decode kernel: split-K single-query attention that
-reads the KV cache — int8 payload included — in-kernel.
+"""Fused flash-decode/flash-extend kernels: split-K attention that
+reads the KV cache — int8 payload included — in-kernel, for
+single-token decode steps AND multi-token extend spans.
 
 Why decode gets its own kernel: the serving hot path is the decode
 step, and it is memory-bound, not compute-bound. Every generated
@@ -51,6 +52,28 @@ Dead-tile DMA note: the BlockSpec copy of a skipped tile still
 happens (the predicate gates compute, not the pipelined copy), so the
 byte win of skipping is bounded; the format win (int8 vs full
 precision) applies to every tile.
+
+**Flash-extend (the U-token variant).** Every multi-token attention
+span the server runs — chunked prefill blocks, admission
+mini-prefills, shared-prefix suffixes, speculative verify blocks —
+is the SAME computation with a Q tile of U rows instead of one:
+still bandwidth-bound (U is a chunk width or ``k+1``, tiny next to
+the cache length), still a read of the whole stored cache per
+dispatch. :func:`extend_attention` / :func:`paged_extend_attention`
+keep the decode kernels' grid ``(B, L/block_k)`` (paged: ``(B, NP)``
+with the same scalar-prefetched table index map), ride a
+``[B, U, L]`` key mask — ``extend_positions_and_mask`` already
+encodes the causal intra-span structure (query ``u`` sees cache
+slots ``<= pos0 + u``), so the kernel again needs no position
+algebra — and emit per-tile partials for all ``U x H`` query rows,
+merged by the SAME pure-jnp log-sum-exp stage 2. Rows are laid out
+``[KVH, U, group]``-flat so each KV head's whole query group is one
+contiguous slice per program (one k-tile load serves U·group rows),
+and the post-merge transpose back to ``[B, U, H, D]`` touches a tiny
+f32 tensor. With this kernel the int8 read saving (and GQA's
+KV-width read) covers EVERY token the server processes, not just
+decode steps — the einsum extend path materialized a full-precision,
+query-head-width cache operand per chunk.
 
 ``interpret=True`` runs the Pallas interpreter (CPU CI). In interpret
 mode the grid lowers to plain traced JAX, so the kernel composes with
@@ -152,6 +175,81 @@ def _decode_kernel(
             l_ref[0, 0, rows, :] = l
 
 
+def _extend_kernel(
+    q_ref, *refs, scale, kv_heads, group, u, quantized,
+):
+    """One (batch row, k-tile) program of the U-token extend grid:
+    partial ``(acc, m, l)`` for ALL ``U x H`` query rows against this
+    tile. The decode kernel's body with a Q tile of U rows: the
+    per-KV-head loop is unchanged, each KV head's tile is loaded once
+    and serves its whole query group across all U span positions
+    (``U * group`` rows per 2D dot — still one small matmul against
+    one streamed tile). Rows land ``[KVH, U, group]``-flat in the
+    partials so each head's slice is contiguous; the caller transposes
+    back after the merge. ``mask_ref`` carries a PER-QUERY-ROW
+    ``[U, block_k]`` mask — the causal intra-span structure (span
+    position ``u`` attends cache slots ``<= pos0 + u``) arrives
+    encoded in it, exactly as pads/prefixes/windows do."""
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, mask_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        k_ref, v_ref, mask_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    keep = mask_ref[0]  # [U, block_k]
+    # A tile dead for EVERY span position skips its dots (leading
+    # tiles of a mostly-empty cache, pad holes spanning the tile).
+    live = jnp.any(keep > 0)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        acc_ref[0, 0] = jnp.zeros_like(acc_ref[0, 0])
+        m_ref[0, 0] = jnp.full_like(m_ref[0, 0], _NEG)
+        l_ref[0, 0] = jnp.zeros_like(l_ref[0, 0])
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # [U, H, D]
+        if quantized:
+            k = k_ref[0].astype(q.dtype) * ks_ref[0].astype(q.dtype)
+            v = v_ref[0].astype(q.dtype) * vs_ref[0].astype(q.dtype)
+        else:
+            k = k_ref[0]  # [block_k, KVH, D]
+            v = v_ref[0]
+        # Per-row mask penalties, repeated group-wise to match the
+        # u-major [U * group] row layout of each KV head's dot.
+        nkeep = jnp.repeat((1.0 - keep) * _NEG, group, axis=0)
+        keep_g = jnp.repeat(keep, group, axis=0)  # [U*group, block_k]
+
+        for j in range(kv_heads):
+            qj = q[:, j * group:(j + 1) * group, :].reshape(
+                u * group, -1
+            )  # [U*group, D], row = u*group + g
+            s = (
+                jax.lax.dot_general(
+                    qj, k[:, j, :],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [U*group, block_k]
+            s = s + nkeep
+            m = jnp.max(s, axis=-1, keepdims=True)
+            # exp(NEG - NEG) == 1 on fully-masked rows; * keep zeroes
+            # them (no NaN for span positions with no valid key —
+            # all-pad query rows exist in ragged chunks).
+            p = jnp.exp(s - m) * keep_g
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            acc = jax.lax.dot_general(
+                p.astype(v.dtype), v[:, j, :],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [U*group, D]
+            rows = slice(j * u * group, (j + 1) * u * group)
+            acc_ref[0, 0, rows, :] = acc
+            m_ref[0, 0, rows, :] = m
+            l_ref[0, 0, rows, :] = l
+
+
 def _fit_block(requested: int, length: int) -> int:
     """Largest halving of ``requested`` that divides ``length``. Any
     dividing block >= 8 (the f32 sublane) is kept — a small legal
@@ -220,9 +318,20 @@ def decode_attention(
         raise ValueError("k and v must share one cache format")
     b, one, h, d = q.shape
     if one != 1:
-        raise ValueError(
-            f"decode_attention is single-query (q [B, 1, H, D]); got "
-            f"{q.shape} — block extends take the einsum path"
+        # U-token dispatch (r11): block extends no longer fall to the
+        # einsum path — they are the same bandwidth-bound read with a
+        # taller Q tile. The only thing the kernel genuinely cannot
+        # tile is a span whose mask lacks the per-query-row (causal
+        # intra-span) structure, so that stays a loud error.
+        if mask.ndim != 3 or mask.shape[:2] != (b, one):
+            raise ValueError(
+                f"multi-token q {q.shape} needs a per-query-row "
+                f"[B, U, L] mask (got {mask.shape}): a [B, L] decode "
+                "mask cannot express the causal intra-span structure"
+            )
+        return extend_attention(
+            q, k, v, mask, scale=scale, block_k=block_k,
+            interpret=interpret,
         )
     lk, kvh = kq.shape[1], kq.shape[2]
     if kq.shape != vq.shape or kq.shape[3] != d:
@@ -277,19 +386,129 @@ def decode_attention(
     return _splitk_merge(acc, m, l, q.dtype)
 
 
-def _splitk_merge(acc, m, l, dtype):
+def _splitk_merge_rows(acc, m, l):
     """Split-K reduction: merge the per-tile (acc, m, l) triples with
-    the log-sum-exp algebra. All-dead rows (l == 0 everywhere) come
-    out exactly zero — but a decode step always has >= 1 valid key
-    (the token it just wrote). Shared verbatim by the contiguous and
-    paged kernels: the page table changes WHERE a tile's bytes live,
+    the log-sum-exp algebra, per row. All-dead rows (l == 0
+    everywhere) come out exactly zero — a decode step always has
+    >= 1 valid key (the token it just wrote); an extend span's
+    all-pad query rows come out zero and are never read. Shared
+    verbatim by the contiguous and paged kernels AND by the decode
+    and extend row layouts: the page table changes WHERE a tile's
+    bytes live and the Q-tile height changes how many rows merge —
     never the merge arithmetic."""
-    m_max = jnp.max(m, axis=1)                       # [B, H, 1]
-    alpha = jnp.exp(m - m_max[:, None])              # [B, nk, H, 1]
-    l_tot = jnp.sum(alpha * l, axis=1)               # [B, H, 1]
-    acc_tot = jnp.sum(alpha * acc, axis=1)           # [B, H, D]
-    out = acc_tot / jnp.maximum(l_tot, 1e-30)
+    m_max = jnp.max(m, axis=1)                       # [B, R, 1]
+    alpha = jnp.exp(m - m_max[:, None])              # [B, nk, R, 1]
+    l_tot = jnp.sum(alpha * l, axis=1)               # [B, R, 1]
+    acc_tot = jnp.sum(alpha * acc, axis=1)           # [B, R, D]
+    return acc_tot / jnp.maximum(l_tot, 1e-30)
+
+
+def _splitk_merge(acc, m, l, dtype):
+    """Decode-layout stage 2: rows ARE the query heads."""
+    out = _splitk_merge_rows(acc, m, l)
     return out.astype(dtype)[:, None]                # [B, 1, H, D]
+
+
+def _splitk_merge_extend(acc, m, l, dtype, u, kvh, group):
+    """Extend-layout stage 2: rows are ``[KVH, U, group]``-flat (each
+    KV head's query group contiguous per program); un-flatten back to
+    the caller's ``[B, U, H, D]`` — a transpose of a tiny f32 tensor,
+    noise next to the cache read the kernel just did."""
+    out = _splitk_merge_rows(acc, m, l)              # [B, KVH*U*g, D]
+    b, _, d = out.shape
+    out = out.reshape(b, kvh, u, group, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, u, kvh * group, d).astype(dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def extend_attention(
+    q,
+    k,
+    v,
+    mask,
+    *,
+    scale=None,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Flash-extend: U-token-query split-K attention over a stored KV
+    cache — the multi-token twin of :func:`decode_attention`.
+
+    ``q``: ``[B, U, H, D]``; ``k``/``v``: ``[B, L, KVH, D]`` arrays
+    (any float dtype) or int8 ``{"q", "scale"}`` pairs; ``mask``:
+    binary ``[B, U, L]`` over keys PER SPAN POSITION (build it with
+    ``models.gpt.extend_positions_and_mask`` — its causal intra-span
+    structure is what lets U positions attend correctly inside one
+    program). Returns ``[B, U, H, D]`` in ``q.dtype``.
+
+    Same grid, same per-tile int8 in-register dequant, same GQA
+    grouping, same log-sum-exp merge as the decode kernel — the Q
+    tile just carries U rows, so chunked prefill / admission /
+    speculative-verify spans stream the cache at its STORED byte
+    format, like decode steps do.
+    """
+    kq, ks = _unpack(k)
+    vq, vs = _unpack(v)
+    quantized = ks is not None
+    if quantized != (vs is not None):
+        raise ValueError("k and v must share one cache format")
+    b, u, h, d = q.shape
+    lk, kvh = kq.shape[1], kq.shape[2]
+    if kq.shape != vq.shape or kq.shape[3] != d:
+        raise ValueError(
+            f"cache shapes disagree with q: k {kq.shape}, v {vq.shape}, "
+            f"q {q.shape}"
+        )
+    if mask.shape != (b, u, lk):
+        raise ValueError(
+            f"extend mask {mask.shape} must be [B, U, L] = "
+            f"[{b}, {u}, {lk}] (per-span-position key validity)"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    group = h // kvh
+    scale = (1.0 / d**0.5) if scale is None else scale
+    bk = _fit_block(block_k, lk)
+    nk = lk // bk
+    rows = kvh * u * group  # the [KVH, U, group]-flat partial layout
+
+    maskf = mask.astype(jnp.float32)  # [B, U, L]
+
+    q_spec = pl.BlockSpec((1, u, h, d), lambda bi, ki: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, bk, kvh, d), lambda bi, ki: (bi, ki, 0, 0))
+    sc_spec = pl.BlockSpec((1, bk, kvh, 1), lambda bi, ki: (bi, ki, 0, 0))
+    mask_spec = pl.BlockSpec((1, u, bk), lambda bi, ki: (bi, 0, ki))
+    part_spec = pl.BlockSpec((1, 1, rows, d), lambda bi, ki: (bi, ki, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, rows, 1), lambda bi, ki: (bi, ki, 0, 0))
+
+    if quantized:
+        operands = (q, kq, ks, vq, vs, maskf)
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
+    else:
+        operands = (q, kq, vq, maskf)
+        in_specs = [q_spec, kv_spec, kv_spec, mask_spec]
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _extend_kernel, scale=scale, kv_heads=kvh, group=group,
+            u=u, quantized=quantized,
+        ),
+        grid=(b, nk),
+        in_specs=in_specs,
+        out_specs=[part_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nk, rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nk, rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nk, rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+
+    return _splitk_merge_extend(acc, m, l, q.dtype, u, kvh, group)
 
 
 def _paged_kernel(table_ref, q_ref, *refs, scale, kv_heads, group,
@@ -347,9 +566,15 @@ def paged_decode_attention(
         raise ValueError("k and v must share one cache format")
     b, one, h, d = q.shape
     if one != 1:
-        raise ValueError(
-            f"paged_decode_attention is single-query (q [B, 1, H, D]); "
-            f"got {q.shape}"
+        # U-token dispatch (r11) — the paged twin of the extend
+        # dispatch in :func:`decode_attention`.
+        if mask.ndim != 3 or mask.shape[:2] != (b, one):
+            raise ValueError(
+                f"multi-token q {q.shape} needs a per-query-row "
+                f"[B, U, NP*page] mask (got {mask.shape})"
+            )
+        return paged_extend_attention(
+            q, k, v, table, mask, scale=scale, interpret=interpret,
         )
     page, kvh = kq.shape[1], kq.shape[2]
     np_tiles = table.shape[1]
@@ -413,6 +638,116 @@ def paged_decode_attention(
     )(table, q, *operands)
 
     return _splitk_merge(acc, m, l, q.dtype)
+
+
+def _paged_extend_kernel(table_ref, q_ref, *refs, scale, kv_heads,
+                         group, u, quantized):
+    """The paged extend grid's kernel body IS the contiguous extend
+    body — the scalar-prefetched table is consumed by the BlockSpec
+    index maps, exactly as in the decode pair."""
+    del table_ref
+    _extend_kernel(
+        q_ref, *refs, scale=scale, kv_heads=kv_heads, group=group,
+        u=u, quantized=quantized,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_extend_attention(
+    q,
+    k,
+    v,
+    table,
+    mask,
+    *,
+    scale=None,
+    interpret: bool = False,
+):
+    """Page-table flash-extend: U-token split-K attention whose
+    k-tiles are POOL PAGES selected per program by the scalar-
+    prefetched page table — :func:`paged_decode_attention` with a Q
+    tile of U rows. A span may START mid-page and CROSS page
+    boundaries freely: the ``[B, U, NP*page]`` virtual-slot mask
+    (``extend_positions_and_mask`` over the virtual layout) carries
+    all of that, the same way paging is invisible to the decode
+    kernel's slot algebra.
+
+    ``q``: ``[B, U, H, D]``; ``k``/``v``: ``[P, page, KVH, D]`` pool
+    arrays or int8 ``{"q", "scale"}`` pool pairs; ``table``: int32
+    ``[B, NP]``. Returns ``[B, U, H, D]`` in ``q.dtype``.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    kq, ks = _unpack(k)
+    vq, vs = _unpack(v)
+    quantized = ks is not None
+    if quantized != (vs is not None):
+        raise ValueError("k and v must share one cache format")
+    b, u, h, d = q.shape
+    page, kvh = kq.shape[1], kq.shape[2]
+    np_tiles = table.shape[1]
+    if kq.shape != vq.shape or kq.shape[3] != d:
+        raise ValueError(
+            f"pool shapes disagree with q: k {kq.shape}, v {vq.shape}, "
+            f"q {q.shape}"
+        )
+    if mask.shape != (b, u, np_tiles * page):
+        raise ValueError(
+            f"extend mask {mask.shape} must cover the virtual layout "
+            f"[{b}, {u}, {np_tiles * page}]"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    group = h // kvh
+    scale = (1.0 / d**0.5) if scale is None else scale
+    rows = kvh * u * group
+
+    maskf = mask.astype(jnp.float32)  # [B, U, NP*page]
+
+    q_spec = pl.BlockSpec((1, u, h, d), lambda bi, ki, t: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, page, kvh, d), lambda bi, ki, t: (t[bi, ki], 0, 0, 0)
+    )
+    sc_spec = pl.BlockSpec(
+        (1, page, kvh, 1), lambda bi, ki, t: (t[bi, ki], 0, 0, 0)
+    )
+    mask_spec = pl.BlockSpec((1, u, page), lambda bi, ki, t: (bi, 0, ki))
+    part_spec = pl.BlockSpec(
+        (1, 1, rows, d), lambda bi, ki, t: (bi, ki, 0, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, rows, 1), lambda bi, ki, t: (bi, ki, 0, 0)
+    )
+
+    if quantized:
+        operands = (kq, ks, vq, vs, maskf)
+        in_specs = [kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
+    else:
+        operands = (kq, vq, maskf)
+        in_specs = [kv_spec, kv_spec, mask_spec]
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _paged_extend_kernel, scale=scale, kv_heads=kvh,
+            group=group, u=u, quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, np_tiles),
+            in_specs=[q_spec, *in_specs],
+            out_specs=[part_spec, row_spec, row_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, np_tiles, rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, np_tiles, rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, np_tiles, rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(table, q, *operands)
+
+    return _splitk_merge_extend(acc, m, l, q.dtype, u, kvh, group)
 
 
 def _head_sharded_call(mesh, fn, q, k, v, head_axis_specs, extras):
@@ -493,6 +828,52 @@ def paged_decode_attention_tp(
     return _head_sharded_call(
         mesh,
         lambda q_, k_, v_, t_, m_: paged_decode_attention(
+            q_, k_, v_, t_, m_, scale=scale, interpret=interpret,
+        ),
+        q, k, v,
+        (P(None, None, axis, None), P(None, None, axis, None)),
+        (table, mask),
+    )
+
+
+def extend_attention_tp(
+    mesh, q, k, v, mask, *, scale=None, block_k: int = 512,
+    interpret: bool = False, axis: str = "model",
+):
+    """:func:`extend_attention` under model-axis TP — the extend leg
+    of :func:`_head_sharded_call`. Sharding is identical to the
+    decode wrapper's (q ``[B, U, H, D]`` and the cache operands
+    head-sharded over ``axis``, the ``[B, U, L]`` mask replicated):
+    the Q tile's extra rows change nothing about head independence —
+    every shard computes full per-head softmaxes for its own query
+    group across all U span positions. This is what lets speculative
+    verify and chunked prefill run kernel-native over MESH-SHARDED
+    caches (the last paged x spec decline's mesh half)."""
+    P = jax.sharding.PartitionSpec
+    return _head_sharded_call(
+        mesh,
+        lambda q_, k_, v_, m_: extend_attention(
+            q_, k_, v_, m_, scale=scale, block_k=block_k,
+            interpret=interpret,
+        ),
+        q, k, v,
+        (P(None, None, axis, None), P(None, None, axis, None)),
+        (mask,),
+    )
+
+
+def paged_extend_attention_tp(
+    mesh, q, k, v, table, mask, *, scale=None, interpret: bool = False,
+    axis: str = "model",
+):
+    """:func:`paged_extend_attention` under model-axis TP: pools
+    shard on their head axis, the table and the ``[B, U, NP*page]``
+    mask replicate — the composition the mesh-sharded-pool
+    speculative-verify path dispatches."""
+    P = jax.sharding.PartitionSpec
+    return _head_sharded_call(
+        mesh,
+        lambda q_, k_, v_, t_, m_: paged_extend_attention(
             q_, k_, v_, t_, m_, scale=scale, interpret=interpret,
         ),
         q, k, v,

@@ -239,13 +239,14 @@ def kv_quantize(x):
 def kv_dequantize(q, scale, dtype):
     """Traced inverse: int8 payload x broadcast scale → ``dtype``.
     WHERE this expansion happens decides whether the full-precision
-    tensor crosses HBM — on the einsum decode path (``kv_cache_kv``)
+    tensor crosses HBM — on the einsum read path (``kv_cache_kv``)
     the dequantized operand materializes at the read seam, so int8
-    saves storage but not read traffic there; only the flash-decode
-    kernel (``ops/pallas/decode_attention``), which runs this exact
-    arithmetic per tile in registers, keeps int8 on the bus for the
-    read. See :func:`maybe_dequant_kv` for the full three-way
-    policy."""
+    saves storage but not read traffic there; only the flash
+    decode/extend kernels (``ops/pallas/decode_attention``), which
+    run this exact arithmetic per tile in registers, keep int8 on
+    the bus for the read — since r11 that covers every cache-reading
+    span (decode steps AND multi-token extends), not just decode.
+    See :func:`maybe_dequant_kv` for the full three-way policy."""
     return q.astype(dtype) * scale.astype(dtype)
 
 
@@ -463,18 +464,24 @@ def maybe_dequant_kv(x, dtype=None):
        convert+multiply feeding the first tile load. These shapes are
        MXU-bound (O(L²) FLOPs over O(L) bytes), so teaching them an
        int8 tile path would complicate every kernel for a read that
-       isn't the bottleneck.
-    2. **Decode, ``decode_attn_impl="flash"``
+       isn't the bottleneck. (These kernels attend a LIVE full
+       sequence; cache-backed spans are leg 2's.)
+    2. **Cache reads, ``decode_attn_impl="flash"``
        (``ops/pallas/decode_attention``)**: dequantize PER TILE
        IN-KERNEL — int8 payload + scale tiles DMA to VMEM and expand
-       in registers. Decode is bandwidth-bound (O(L) FLOPs over O(L)
-       bytes), so the byte format of the read IS the lever: this is
-       the only leg where int8 crosses HBM on the attention read.
-    3. **Decode, ``decode_attn_impl="einsum"`` (``kv_cache_kv``)**:
-       dequantize at the read seam feeding the decode einsum — the
-       reference oracle. The full-precision operand materializes
-       between the dequant and the einsum, so this leg realizes the
-       int8 saving in storage only.
+       in registers. Cache reads are bandwidth-bound (O(U·L) FLOPs
+       over O(L) bytes at small U), so the byte format of the read
+       IS the lever: this is the only leg where int8 crosses HBM on
+       the attention read. Since r11 this leg covers single-token
+       decode steps AND multi-token extend spans (chunked prefill,
+       admission, speculative verify) — flash-extend is the same
+       tile path with a U-row Q tile.
+    3. **Cache reads, ``decode_attn_impl="einsum"``
+       (``kv_cache_kv``)**: dequantize at the read seam feeding the
+       decode/extend einsum — the reference oracle. The
+       full-precision operand materializes between the dequant and
+       the einsum, so this leg realizes the int8 saving in storage
+       only.
 
     Anything that is neither an array nor a quant pair is rejected
     loudly."""

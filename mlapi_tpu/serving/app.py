@@ -660,6 +660,13 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["gauges"]["generate.decode_bytes_per_step"] = (
                 engine.decode_bytes_per_step()
             )
+            # Same accounting for one multi-token extend chunk's read
+            # (chunked prefill / admission / speculative verify): the
+            # int8 flash saving applies to every token the server
+            # processes, amortized per chunk instead of per step.
+            snap["gauges"]["generate.extend_bytes_per_chunk"] = (
+                engine.extend_bytes_per_chunk()
+            )
             if getattr(engine, "pool", None) is not None:
                 # Paged KV pool observability: capacity headroom
                 # (total vs in_use), how much of the live footprint is

@@ -609,24 +609,23 @@ class BatchRun:
         one readback round trip per request."""
         eng, reqs, b = self.eng, self.reqs, self.b
         temps, topk, topp = self.temps, self.topk, self.topp
-        # Paged × speculative (r10): the guards LIFT for the common
-        # case. Solo spec needs no realign at all (it hands off at its
-        # own frontier) and the batched handoff realigns as a host
-        # page-table shift when deltas are page multiples (device
-        # row-gather fallback otherwise — `_paged_realign`); the draft
-        # mirrors stay contiguous either way (the draft has no pool).
-        # The DECLINE survives for exactly two paged cases, pinned by
-        # test: strict (tunnel) mode — the spec warm grid compiles
-        # against contiguous caches, so pool-shaped verify programs
-        # would compile mid-batch — and mesh-sharded pools, where the
-        # verify/propose programs are unproven over sharded pool
-        # operands.
-        paged_spec_ok = self.pool is None or (
-            not eng._strict_admit and eng.mesh is None
-        )
+        # Paged × speculative, fully lifted (r11). r10 lifted the
+        # common case (solo spec needs no realign; the batched handoff
+        # realigns as a host table shift or the counted row-gather)
+        # but kept two declines. Both are gone:
+        # - strict (tunnel) mode: the spec warm grid now compiles the
+        #   POOL-SHAPED verify/realign programs for paged engines
+        #   (SpecPhase.warm branches on eng.pool), so the phase's own
+        #   warmed-key gate admits paged batches without a mid-batch
+        #   compile;
+        # - mesh-sharded pools: flash-extend gave `_head_sharded_call`
+        #   an extend leg, so pool-shaped verify blocks run per shard
+        #   under an explicit shard_map (einsum verifies partition as
+        #   plain GSPMD gather+einsum) — pinned end-to-end by
+        #   tests/test_prefill_paged_native.py's former decline pins,
+        #   rewritten as passing stream-identity tests.
         self.spec_eligible = (
             eng.draft_model is not None
-            and paged_spec_ok
             and b == 1 and self.p_len == 0
             and not reqs[0].cancelled
             and (
@@ -644,7 +643,6 @@ class BatchRun:
         # verify block.
         self.spec_batched = (
             eng.draft_model is not None
-            and paged_spec_ok
             and b > 1 and self.p_len == 0
             and bool(
                 np.all(temps[:b] <= 0.0)

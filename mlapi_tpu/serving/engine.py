@@ -1000,6 +1000,29 @@ class TextGenerationEngine:
         )
         return self._decode_step_bytes
 
+    def extend_bytes_per_chunk(self) -> int:
+        """Modeled HBM bytes ONE multi-token extend chunk's attention
+        read moves per slot at the default bucket/tier config —
+        ``decode_bytes_per_step``'s accounting applied to the OTHER
+        half of the token pipeline (``/metrics`` gauge
+        ``generate.extend_bytes_per_chunk``). The read model is
+        EXACTLY the decode one, by construction: an extend dispatch
+        streams the same stored cache (flash — the U-row Q tile rides
+        into each program, so a tile is still read once) or
+        materializes the same full-precision query-head-width operand
+        (einsum — ``kv_cache_kv``'s dequant and the GQA broadcast
+        don't depend on the query width), so the int8 flash saving
+        2D/(D+4) (1.94x at bf16 D=128) carries over verbatim. What
+        differs is AMORTIZATION: a chunk pays this read once for its
+        whole U-token span, where the decode loop pays
+        ``decode_bytes_per_step`` per token — which is why chunked
+        prefill, admission mini-prefills and speculative verify were
+        worth making kernel-native at all (every server token now
+        reads the cache at its stored byte format). Same
+        ``jax.eval_shape`` host arithmetic: exact, deterministic, no
+        device work."""
+        return self.decode_bytes_per_step()
+
     # -- paged-pool accounting (state lives in serving/paged_pool.py) -----
     @property
     def kv_pages_total(self) -> int:

@@ -349,13 +349,46 @@ class SpecPhase:
             n_pad += delta  # in place: the chunk loop's mirror
         return cache, top
 
+    def _target_cache(self, bsz: int, total: int):
+        """A target-side cache pytree of the shape the phase's verify
+        programs will ACTUALLY take for a ``bsz``-row batch at tier
+        ``total``: contiguous for contiguous engines; for paged
+        engines the pool leaves + a null ``[bsz, npv]`` table — the
+        exact operand shapes ``BatchRun`` dispatches, which is what
+        makes the warmed keys honest for paged batches (the r10
+        strict-mode decline existed because this used to warm
+        contiguous shapes a paged batch never dispatches). Null-table
+        warm writes land in the never-read null page, so the pool is
+        untouched; callers must hand the donated result back through
+        :meth:`_rebind_pool`."""
+        eng = self.eng
+        if eng.pool is None:
+            return eng.model.init_cache(bsz, total)
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        npv = -(-total // eng.pool.page)
+        return paged_cache_tree(
+            eng.pool.layers, np.zeros((bsz, npv), np.int32)
+        )
+
+    def _rebind_pool(self, cache) -> None:
+        """Donating warm programs consumed the pool's device arrays;
+        re-bind them from the returned cache (no-op contiguous)."""
+        if self.eng.pool is not None:
+            from mlapi_tpu.ops.quant import paged_pools_of
+
+            self.eng.pool.layers = paged_pools_of(cache)
+
     def warm(self) -> int:
         """Compile the speculative-phase programs (draft prefill, the
         scanned propose for both pending widths, the verify block —
         greedy argmax and, under ``spec_sample``, the sampled
         acceptance-rejection variant — and the replay-remainder step)
         for every prompt bucket at the default cache tier, off the
-        request path."""
+        request path. PAGED engines warm the POOL-SHAPED target
+        programs (verify blocks over pool leaves + tables, and the
+        sub-page realign repack) — the missing piece that kept
+        strict-admit mode declining paged speculation (r10 → r11)."""
         eng = self.eng
         from mlapi_tpu.models.gpt import (
             decode_chunk_fn, extend_chunk_fn, prefill_fn,
@@ -403,13 +436,14 @@ class SpecPhase:
                 jnp.int32(0), jnp.int32(0),
             )
             block = np.zeros((1, k + 1), np.int32)
-            verify_fn(eng.model, k + 1)(
-                eng.params, eng.model.init_cache(1, total),
+            wcache, _ = verify_fn(eng.model, k + 1)(
+                eng.params, self._target_cache(1, total),
                 jnp.asarray(block), jnp.int32(bucket), npj,
             )
+            self._rebind_pool(wcache)
             if eng.spec_sample:
-                sample_verify_fn(eng.model, k + 1)(
-                    eng.params, eng.model.init_cache(1, total),
+                wcache, _ = sample_verify_fn(eng.model, k + 1)(
+                    eng.params, self._target_cache(1, total),
                     jnp.int32(0),
                     jnp.asarray(np.zeros((k,), np.int32)),
                     jnp.int32(bucket), npj,
@@ -417,6 +451,7 @@ class SpecPhase:
                              1.0 / eng.model.vocab_size, np.float32),
                     key1, o1, z0, o1, jnp.int32(0), jnp.int32(k),
                 )
+                self._rebind_pool(wcache)
             if bucket + eng.chunk <= total:
                 # Re-engagement replays history in chunk-wide blocks.
                 extend_chunk_fn(eng.draft_model, eng.chunk, total)(
@@ -465,15 +500,28 @@ class SpecPhase:
                     jnp.asarray(np.full((bsz,), bucket, np.int32)),
                     np_b, keys_b, ztb, zbb, obb, zbb,
                 )
-                verify_fn(eng.model, k + 1)(
-                    eng.params, eng.model.init_cache(bsz, bt),
+                wcache, _ = verify_fn(eng.model, k + 1)(
+                    eng.params, self._target_cache(bsz, bt),
                     jnp.asarray(np.zeros((bsz, k + 1), np.int32)),
                     jnp.asarray(np.full((bsz,), bucket, np.int32)),
                     np_b,
                 )
-                realign_fn()(
-                    eng.model.init_cache(bsz, bt), zbb,
-                )
+                self._rebind_pool(wcache)
+                if eng.pool is None:
+                    realign_fn()(
+                        eng.model.init_cache(bsz, bt), zbb,
+                    )
+                else:
+                    # The paged handoff's page-aligned case is a host
+                    # table op (nothing to compile); warm the counted
+                    # sub-page device repack so a strict-mode batch
+                    # never pays its compile mid-phase.
+                    from mlapi_tpu.models.gpt import paged_realign_fn
+
+                    wcache = paged_realign_fn()(
+                        self._target_cache(bsz, bt), zbb,
+                    )
+                    self._rebind_pool(wcache)
                 self.warmed.add((bucket, bt, bsz, "batched"))
                 shapes += 1
                 bsz *= 2

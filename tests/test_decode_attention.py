@@ -152,7 +152,11 @@ def test_kernel_awkward_length_single_block_fallback():
 def test_kernel_rejects_bad_operands():
     q, k, v = _rows(jnp.float32, H)
     mask = jnp.ones((B, L), jnp.float32)
-    with pytest.raises(ValueError, match="single-query"):
+    # r11: a multi-token q no longer errors outright — it dispatches
+    # to the flash-extend kernel — but a single-query [B, L] mask
+    # cannot express the intra-span causality, so THAT stays loud
+    # (the U-token parity grid lives in test_extend_attention.py).
+    with pytest.raises(ValueError, match="per-query-row"):
         decode_attention(
             jnp.concatenate([q, q], axis=1), k, v, mask, interpret=True
         )

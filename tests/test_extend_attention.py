@@ -1,0 +1,475 @@
+"""Flash-extend: the U-token-query split-K kernels
+(`ops/pallas/decode_attention.extend_attention` /
+`paged_extend_attention`) and their routing through ``extend_core``.
+
+The contracts these tests pin (ISSUE 6 acceptance):
+
+- **Parity, cell by cell**: interpret-mode extend kernel output
+  matches the einsum oracle across {MHA, GQA} x {f32, bf16} x
+  {kv_quant none, int8} x {plain, ragged-pad, prefix-shift, paged}
+  x U in {2, 7, block-multiple}, to <= 1e-5 (f32) / <= 2e-2 (bf16)
+  max-abs — the causal intra-span mask rows included.
+- **Streams, end to end**: greedy token streams are IDENTICAL
+  einsum-vs-flash through every multi-token span the server runs —
+  chunked long-prompt prefill (contiguous AND paged),
+  admission-during-an-interleaved-window, and batched-speculation
+  verify — for gpt-MHA and llama-GQA.
+- **Bytes, exactly**: ``engine.extend_bytes_per_chunk()`` equals the
+  closed-form dtype arithmetic for every (impl, format) pair — the
+  int8 flash chunk read clears 2D/(D+4) (1.94x at bf16 D=128) below
+  the full-precision read, from arithmetic, never timing — and
+  exports on ``/metrics``.
+- **The old guard is gone, loudly**: a multi-token q through
+  ``decode_attention`` dispatches to the extend kernel when the mask
+  carries the per-query-row structure, and raises (not silently
+  mis-attends) when it cannot.
+"""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.models.gpt import extend_positions_and_mask
+from mlapi_tpu.ops.attention import NEG
+from mlapi_tpu.ops.pallas import (
+    decode_attention,
+    extend_attention,
+    paged_extend_attention,
+)
+from mlapi_tpu.ops.quant import kv_dequantize, kv_quantize
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+B, L, H, D = 2, 32, 4, 8
+BLOCK_K = 8  # so U = 8 is the block-multiple cell
+PAGE = 8
+
+
+def _einsum_oracle(q, k, v, mask):
+    """The extend einsum read (``gpt.cached_attend``'s math over a
+    ``[B, U, L]`` mask), GQA broadcast included."""
+    group = q.shape[2] // k.shape[2]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    s = (
+        jnp.einsum(
+            "buhd,bkhd->bhuk", q, k, preferred_element_type=jnp.float32
+        )
+        / q.shape[-1] ** 0.5
+    )
+    s = jnp.where(mask[:, None, :, :], s, NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum(
+        "bhuk,bkhd->buhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _rows(dtype, kvh, u):
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(B, u, H, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, L, kvh, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, L, kvh, D)), dtype)
+    return q, k, v
+
+
+def _mask(case, u):
+    """One [B, U, L] extend mask per semantics cell, built with the
+    REAL helper (`extend_positions_and_mask`) so the causal
+    intra-span structure and the pad/prefix algebra are the
+    production ones. All cells vary per row."""
+    if case == "plain":
+        _, m = extend_positions_and_mask(
+            L, u, jnp.asarray([4, 10]), jnp.zeros((B,), jnp.int32)
+        )
+    elif case == "ragged_pad":
+        # Rows at desynchronized offsets with different pad holes —
+        # the batched-spec verify layout; row 0's first span
+        # positions land inside its own pad hole (all-dead mask
+        # rows, the einsum path's uniform-garbage cell).
+        _, m = extend_positions_and_mask(
+            L, u, jnp.asarray([2, 13]), jnp.asarray([5, 1], jnp.int32)
+        )
+    else:
+        assert case in ("prefix_shift", "paged")
+        # Shared prefix region [lo_b, 12) ahead of per-row pads.
+        _, m = extend_positions_and_mask(
+            L, u, jnp.asarray([14, 17]), jnp.asarray([2, 0], jnp.int32),
+            prefix_len=jnp.int32(12), prefix_lo=jnp.asarray([0, 3]),
+        )
+    return m[:, 0]  # [B, U, L]
+
+
+def _paged_layout(x):
+    """Scatter a contiguous [B, L, kvh, D] array into a PERMUTED page
+    pool + table (page 0 reserved null): the kernel must follow the
+    table, not the contiguous order."""
+    kvh, d = x.shape[2], x.shape[3]
+    npv = L // PAGE
+    perm = np.random.default_rng(3).permutation(B * npv)
+    pool = np.zeros((B * npv + 1, PAGE, kvh, d), np.asarray(x).dtype)
+    table = np.zeros((B, npv), np.int32)
+    blocks = np.asarray(x).reshape(B, npv, PAGE, kvh, d)
+    for b in range(B):
+        for i in range(npv):
+            pid = int(perm[b * npv + i]) + 1
+            pool[pid] = blocks[b, i]
+            table[b, i] = pid
+    return jnp.asarray(pool), jnp.asarray(table)
+
+
+@pytest.mark.parametrize("kvh", [H, H // 2], ids=["mha", "gqa"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+@pytest.mark.parametrize(
+    "case", ["plain", "ragged_pad", "prefix_shift", "paged"]
+)
+def test_extend_kernel_matches_einsum_oracle(kvh, dtype, fmt, case):
+    """The full parity grid; U values (2, 7, block-multiple) share
+    one cell to bound the suite's compile count."""
+    for u in (2, 7, BLOCK_K):
+        q, k, v = _rows(dtype, kvh, u)
+        mask = _mask(case, u)
+        if fmt == "int8":
+            kq, ks = kv_quantize(k)
+            vq, vs = kv_quantize(v)
+            # Oracle reads the SAME int8 values through
+            # kv_dequantize — kernel math isolated from quant error.
+            kk = {"q": kq, "scale": ks}
+            vv = {"q": vq, "scale": vs}
+            ref = _einsum_oracle(
+                q, kv_dequantize(kq, ks, dtype),
+                kv_dequantize(vq, vs, dtype), mask,
+            )
+        else:
+            kk, vv = k, v
+            ref = _einsum_oracle(q, k, v, mask)
+        if case == "paged":
+            if fmt == "int8":
+                pk, table = _paged_layout(kk["q"])
+                psk, _ = _paged_layout(
+                    jnp.broadcast_to(kk["scale"], k.shape[:3] + (1,))
+                )
+                pv, _ = _paged_layout(vv["q"])
+                psv, _ = _paged_layout(
+                    jnp.broadcast_to(vv["scale"], v.shape[:3] + (1,))
+                )
+                got = paged_extend_attention(
+                    q, {"q": pk, "scale": psk}, {"q": pv, "scale": psv},
+                    table, mask.astype(jnp.float32), interpret=True,
+                )
+            else:
+                pk, table = _paged_layout(k)
+                pv, _ = _paged_layout(v)
+                got = paged_extend_attention(
+                    q, pk, pv, table, mask.astype(jnp.float32),
+                    interpret=True,
+                )
+        else:
+            got = extend_attention(
+                q, kk, vv, mask.astype(jnp.float32), interpret=True,
+                block_k=BLOCK_K,
+            )
+        tol = 1e-5 if dtype == jnp.float32 else 2e-2
+        # All-dead span rows come out exactly 0 from the kernel and
+        # as uniform-average garbage from the softmax oracle — both
+        # are never read; compare only live rows.
+        live = np.asarray(jnp.any(mask, axis=-1))  # [B, U]
+        diff = np.abs(
+            np.asarray(got, np.float32) - np.asarray(ref, np.float32)
+        )[live].max()
+        assert diff <= tol, (case, fmt, u, diff)
+
+
+def test_multi_token_dispatch_and_loud_reject():
+    """`decode_attention` with a U-token q dispatches to the extend
+    kernel when the mask carries per-query-row structure — the old
+    'block extends take the einsum path' guard is GONE — and raises
+    loudly when it cannot (a [B, L] decode mask has no intra-span
+    causality to tile)."""
+    u = 4
+    q, k, v = _rows(jnp.float32, H, u)
+    mask = _mask("plain", u)
+    ref = _einsum_oracle(q, k, v, mask)
+    got = decode_attention(
+        q, k, v, mask.astype(jnp.float32), interpret=True,
+        block_k=BLOCK_K,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5
+    )
+    with pytest.raises(ValueError, match="per-query-row"):
+        decode_attention(
+            q, k, v, jnp.ones((B, L), jnp.float32), interpret=True
+        )
+    with pytest.raises(ValueError, match="must be"):
+        extend_attention(
+            q, k, v, jnp.ones((B, u, L - 1), jnp.float32),
+            interpret=True,
+        )
+
+
+def test_extend_kernel_awkward_length_single_block_fallback():
+    """Cache lengths that defeat power-of-two blocking fall back to
+    one whole-L block and stay exact — the only 'cannot tile' case,
+    handled inside `_fit_block`, never a silent einsum."""
+    u = 3
+    q, k, v = _rows(jnp.float32, H, u)
+    lk = 29  # prime: no block divides it
+    _, m = extend_positions_and_mask(
+        lk, u, jnp.asarray([4, 10]), jnp.zeros((B,), jnp.int32)
+    )
+    mask = m[:, 0]
+    ref = _einsum_oracle(q, k[:, :lk], v[:, :lk], mask)
+    got = extend_attention(
+        q, k[:, :lk], v[:, :lk], mask.astype(jnp.float32),
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5
+    )
+
+
+# --- end-to-end streams ------------------------------------------------
+
+GPT_CFG = dict(
+    vocab_size=260, hidden_size=32, num_layers=2, num_heads=4,
+    max_positions=320, compute_dtype="float32",
+)
+LLAMA_CFG = dict(
+    vocab_size=260, hidden_size=32, num_layers=2, num_heads=4,
+    num_kv_heads=2, max_positions=320, compute_dtype="float32",
+)
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("chunk", 2)
+    kw.setdefault("fused_single", False)
+    return TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(), **kw
+    )
+
+
+async def _collect(req) -> list[int]:
+    out: list[int] = []
+    while True:
+        item = await req.queue.get()
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        out.extend(item["token_ids"])
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    return get_model("gpt_lm", **GPT_CFG).init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return get_model("llama_lm", **LLAMA_CFG).init(jax.random.key(0))
+
+
+@pytest.mark.parametrize("kind,fmt", [
+    ("gpt_lm", "int8"), ("llama_lm", "none"),
+], ids=["gpt-int8", "llama-gqa"])
+def test_chunked_prefill_and_prefix_stream_matches_einsum(
+    kind, fmt, gpt_params, llama_params
+):
+    """A 100-token prompt (two 64-wide extend chunks) and a
+    shared-prefix suffix prefill emit token-identical greedy streams
+    einsum-vs-flash — the extend kernel rides `extend_core`'s mask
+    semantics through the whole engine path. (Sized to the budget:
+    cp = 64 via prompt_buckets keeps the compiled extend programs and
+    interpret-mode tiles small.)"""
+    cfg = GPT_CFG if kind == "gpt_lm" else LLAMA_CFG
+    params = gpt_params if kind == "gpt_lm" else llama_params
+    m = get_model(kind, **cfg, kv_quant=fmt)
+    engs = {
+        impl: _engine(
+            dataclasses.replace(m, decode_attn_impl=impl), params,
+            prompt_buckets=(16, 64), chunk=4,
+        )
+        for impl in ("einsum", "flash")
+    }
+    long_p = "x" * 100  # -> [128] bucket, two 64-token chunks
+    a = engs["einsum"].generate_text(long_p, max_new_tokens=4)
+    b = engs["flash"].generate_text(long_p, max_new_tokens=4)
+    assert a["token_ids"] == b["token_ids"], (kind, fmt)
+    assert engs["flash"].prefill_chunks >= 2  # it actually chunked
+    prefix = "the quick brown fox "
+    pa = engs["einsum"].generate_text(
+        "tail", prefix=prefix, max_new_tokens=4
+    )
+    pb = engs["flash"].generate_text(
+        "tail", prefix=prefix, max_new_tokens=4
+    )
+    assert pa["token_ids"] == pb["token_ids"], (kind, fmt)
+
+
+def test_paged_chunked_prefill_stream_matches_einsum(gpt_params):
+    """The page-native chunked prefill (`paged_extend_fn` →
+    `extend_core`) under flash reads pool pages in place via the
+    U-token page-table kernel — streams pinned to the paged einsum
+    engine, every page returned."""
+    m = get_model("gpt_lm", **GPT_CFG, kv_quant="int8")
+    engs = {
+        impl: _engine(
+            dataclasses.replace(m, decode_attn_impl=impl), gpt_params,
+            kv_page_size=8, prompt_buckets=(16, 64), chunk=4,
+        )
+        for impl in ("einsum", "flash")
+    }
+    long_p = "y" * 100
+    a = engs["einsum"].generate_text(long_p, max_new_tokens=4)
+    b = engs["flash"].generate_text(long_p, max_new_tokens=4)
+    assert a["token_ids"] == b["token_ids"]
+    assert engs["flash"].prefill_chunks >= 2
+    assert engs["flash"].prefill_adopt_bytes == 0  # still page-native
+    assert engs["flash"].kv_pages_in_use == 0
+
+
+@pytest.mark.anyio
+async def test_admission_during_window_stream_matches_einsum(
+    gpt_params,
+):
+    """The interleaved-prefill window (long-prompt joiner's chunks =
+    admission mini-prefills through `paged_extend_fn`) with a short
+    one-shot admission DURING it: every stream identical
+    einsum-vs-flash, the stall bound intact under the kernel. Sized
+    to the budget: the running stream starts at bucket 64, so the
+    activation catch-up (and with it the interpret-mode decode-step
+    count) is half the joiner's prompt, not all of it."""
+    m = get_model("gpt_lm", **GPT_CFG)
+    outs = {}
+    for impl in ("einsum", "flash"):
+        eng = _engine(
+            dataclasses.replace(m, decode_attn_impl=impl), gpt_params,
+            kv_page_size=8, max_wait_ms=0.0,
+            prompt_buckets=(16, 64), chunk=8,
+        )
+        await eng.start()
+        try:
+            r1 = await eng.submit(
+                "h" * 60, max_new_tokens=80, stream=True
+            )
+            head = await r1.queue.get()
+            assert not isinstance(head, Exception)
+            r2 = await eng.submit("x" * 100, max_new_tokens=6)
+            r3 = await eng.submit("yo", max_new_tokens=4)
+            outs[impl] = await asyncio.gather(
+                _collect(r1), _collect(r2), _collect(r3)
+            )
+            outs[impl][0] = head["token_ids"] + outs[impl][0]
+            assert eng.interleaved_prefills == 1
+            assert eng.interleave_max_stall == 1
+            assert eng.admitted >= 2
+        finally:
+            await eng.stop()
+    assert outs["flash"] == outs["einsum"]
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.mark.anyio
+async def test_batched_spec_verify_stream_matches_einsum(gpt_params):
+    """Batched speculation's verify spans (per-row desynchronized
+    positions — `extend_core` with a [B] pos0 vector) run through the
+    flash-extend kernel: streams identical to the einsum engine,
+    rounds actually verified on both."""
+    m = get_model("gpt_lm", **GPT_CFG)
+    outs = {}
+    rounds = {}
+    for impl in ("einsum", "flash"):
+        mi = dataclasses.replace(m, decode_attn_impl=impl)
+        eng = _engine(
+            mi, gpt_params, draft=(mi, gpt_params), spec_k=3,
+            max_wait_ms=2000.0,
+        )
+        await eng.start()
+        try:
+            r1 = await eng.submit("aaaa", max_new_tokens=9)
+            r2 = await eng.submit("bbbb", max_new_tokens=4)
+            outs[impl] = await asyncio.gather(
+                _collect(r1), _collect(r2)
+            )
+            rounds[impl] = eng.spec_rounds
+        finally:
+            await eng.stop()
+    assert outs["flash"] == outs["einsum"]
+    assert rounds["flash"] > 0 and rounds["einsum"] > 0
+
+
+# --- the byte model ----------------------------------------------------
+
+
+def test_extend_bytes_per_chunk_closed_form():
+    """Every (impl, format) pair's modeled chunk read equals the
+    dtype arithmetic — identical by construction to the per-step
+    decode read (the operand/storage asymmetry doesn't depend on the
+    query width), amortized per chunk — and the int8 flash chunk
+    read clears 2D/(D+4) = 1.94x at bf16 D=128."""
+    small = dict(
+        vocab_size=260, hidden_size=256, num_layers=2, num_heads=2,
+        max_positions=320, compute_dtype="bfloat16",
+    )
+    model = get_model("gpt_lm", **small)
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def eng(impl, fmt):
+        m = dataclasses.replace(
+            model, kv_quant=fmt, decode_attn_impl=impl
+        )
+        return TextGenerationEngine(m, params, tokenizer=tok, chunk=8)
+
+    layers, h, d = small["num_layers"], 2, 128
+    total = 160  # bucket 128 + default tier 32
+    bf16 = layers * 2 * total * h * d * 2
+    int8 = layers * 2 * (total * h * d + total * h * 4)
+    assert eng("flash", "none").extend_bytes_per_chunk() == bf16
+    assert eng("flash", "int8").extend_bytes_per_chunk() == int8
+    assert eng("einsum", "none").extend_bytes_per_chunk() == bf16
+    assert eng("einsum", "int8").extend_bytes_per_chunk() == bf16 + int8
+    assert bf16 / int8 == pytest.approx((2 * d) / (d + 4))
+    assert bf16 / int8 >= 1.9
+    # The documented identity: one extend chunk reads what one decode
+    # step reads — paid once per U-token span instead of per token.
+    e = eng("flash", "int8")
+    assert e.extend_bytes_per_chunk() == e.decode_bytes_per_step()
+
+
+@pytest.mark.anyio
+async def test_metrics_exports_extend_bytes(gpt_params):
+    import httpx
+
+    from mlapi_tpu.serving import build_app
+
+    m = get_model("gpt_lm", **GPT_CFG, kv_quant="int8")
+    eng = _engine(
+        dataclasses.replace(m, decode_attn_impl="flash"), gpt_params
+    )
+    app = build_app(eng)
+    await app.startup()
+    try:
+        transport = httpx.ASGITransport(app=app)
+        async with httpx.AsyncClient(
+            transport=transport, base_url="http://test"
+        ) as c:
+            snap = (await c.get("/metrics")).json()
+        assert (
+            snap["gauges"]["generate.extend_bytes_per_chunk"]
+            == eng.extend_bytes_per_chunk()
+        )
+    finally:
+        await app.shutdown()

@@ -26,9 +26,11 @@ The contracts these tests pin:
 - **Paged × speculative**: solo and batched speculation engage on
   paged batches (streams pinned to the plain engine), the batched
   handoff realigns as a host page-table shift when deltas are page
-  multiples and as the counted device row-gather otherwise, and the
-  decline survives exactly for strict-admit mode and mesh-sharded
-  pools.
+  multiples and as the counted device row-gather otherwise, and —
+  since r11 — the last two declines are LIFTED: strict-admit mode
+  (the spec warm grid compiles pool-shaped programs) and
+  mesh-sharded pools (flash-extend's ``shard_map`` leg), both pinned
+  as passing end-to-end stream-identity tests.
 """
 
 import asyncio
@@ -445,28 +447,65 @@ async def test_batched_spec_paged_realign(spec_models, page, counter):
     assert spec.kv_pages_in_use == 0
 
 
-def test_paged_spec_decline_cases_pinned(spec_models):
-    """The decline fallback survives for exactly the cases the table
-    op does not cover: strict-admit mode (the spec warm grid compiles
-    contiguous cache shapes) and mesh-sharded pools. Output stays
-    correct — just served without speculation."""
+def test_paged_spec_strict_admit_engages(spec_models):
+    """FORMER DECLINE PIN, now a passing end-to-end test (r11): in
+    strict (tunnel) mode the spec warm grid compiles POOL-SHAPED
+    verify/realign programs for paged engines (``SpecPhase.warm``
+    branches on ``eng.pool``), so paged batches speculate without a
+    mid-batch compile — and an engine whose paged shapes were NOT
+    warmed still declines safely inside the phase (the warmed-key
+    gate, unchanged)."""
     target, tp, draft, dp = spec_models
     plain = _engine(target, tp, kv_page_size=None)
     ref = plain.generate_text("declined", max_new_tokens=8)
 
-    strict = _engine(target, tp, draft=(draft, dp))
+    strict = _engine(
+        target, tp, draft=(draft, dp), prompt_buckets=(16,),
+        max_batch=2,
+    )
+    pages_before = strict.kv_pages_in_use
+    shapes = strict.spec.warm()
+    assert shapes >= 2  # solo + one batched size, paged-shaped
+    # Null-table warm writes die in the null page: pool untouched.
+    assert strict.kv_pages_in_use == pages_before
     strict._strict_admit = True
     out = strict.generate_text("declined", max_new_tokens=8)
     assert out["token_ids"] == ref["token_ids"]
-    assert strict.spec_rounds == 0
+    assert strict.spec_rounds > 0  # the decline is gone
+
+    # Unwarmed strict engine: the phase's own gate still declines —
+    # correct output, no speculation, no mid-batch compile.
+    cold = _engine(target, tp, draft=(draft, dp))
+    cold._strict_admit = True
+    out = cold.generate_text("declined", max_new_tokens=8)
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.spec_rounds == 0
+
+
+def test_paged_spec_mesh_sharded_pool_engages(spec_models):
+    """FORMER DECLINE PIN, now a passing end-to-end test (r11): spec
+    over a MESH-SHARDED pool. The einsum verify partitions as a plain
+    GSPMD gather+einsum; the flash verify routes through the
+    flash-extend ``shard_map`` leg (``extend_attention_tp`` /
+    ``paged_extend_attention_tp``) so the opaque kernel runs per head
+    shard. Streams pinned to the draft-less contiguous engine for
+    BOTH impls."""
+    import dataclasses
 
     from mlapi_tpu.parallel import create_mesh
 
+    target, tp, draft, dp = spec_models
+    plain = _engine(target, tp, kv_page_size=None)
+    ref = plain.generate_text("declined", max_new_tokens=8)
     mesh = create_mesh((1, 2), devices=jax.devices()[:2])
-    meshed = _engine(target, tp, draft=(draft, dp), mesh=mesh)
-    out = meshed.generate_text("declined", max_new_tokens=8)
-    assert out["token_ids"] == ref["token_ids"]
-    assert meshed.spec_rounds == 0
+    for impl in ("einsum", "flash"):
+        t_i = dataclasses.replace(target, decode_attn_impl=impl)
+        d_i = dataclasses.replace(draft, decode_attn_impl=impl)
+        meshed = _engine(t_i, tp, draft=(d_i, dp), mesh=mesh)
+        out = meshed.generate_text("declined", max_new_tokens=8)
+        assert out["token_ids"] == ref["token_ids"], impl
+        assert meshed.spec_rounds > 0, impl  # the decline is gone
+        assert meshed.kv_pages_in_use == 0
 
 
 # --- observability ------------------------------------------------------
