@@ -997,6 +997,21 @@ def bench_generate() -> None:
                 for k, v in after.get("gauges", {}).items()
                 if k.startswith("generate.kv_page")
             }
+            # Robustness block (r12): the shed/deadline/brownout/fault
+            # counters under this load — all zero on a healthy
+            # un-deadlined run, which is itself the claim (the layer
+            # costs nothing when nothing fails).
+            pool_g.update({
+                k.removeprefix("generate."): v
+                for k, v in after.get("counters", {}).items()
+                if k.startswith((
+                    "generate.shed_", "generate.deadline_expired_",
+                    "generate.brownout_", "generate.faults_injected",
+                ))
+            })
+            pool_g["draining"] = after.get("gauges", {}).get(
+                "generate.draining", 0
+            )
             return (single, batched, mixed_r, shorts_alone, shorts_holb,
                     admitted, kv_slot, pool_g)
 

@@ -171,6 +171,11 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--spec-sample"]
     if getattr(args, "fused_batch", "auto") != "auto":
         cmd += ["--fused-batch", args.fused_batch]
+    if getattr(args, "default_deadline_ms", None) is not None:
+        cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
+    if not getattr(args, "admission_control", True):
+        cmd += ["--no-admission-control"]
+    cmd += ["--drain-timeout-s", str(getattr(args, "drain_timeout_s", 10.0))]
     # systemd/docker stop the supervisor with SIGTERM; without a
     # handler the finally below never runs and the workers are
     # orphaned still bound to the port (SO_REUSEPORT would then let a
@@ -223,10 +228,16 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # SIGTERM fan-out, then wait out the workers' DRAIN budget
+        # (plus startup/teardown slack) before escalating to SIGKILL —
+        # the supervisor must never cut a drain short that it also
+        # configured.
         for c in children:
             if c is not None and c.poll() is None:
                 c.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
+        deadline = (
+            time.time() + getattr(args, "drain_timeout_s", 10.0) + 5.0
+        )
         for c in children:
             if c is None:
                 continue
@@ -348,6 +359,36 @@ def main(argv=None) -> None:
              "locally — measured both ways), 'on'/'off' force it",
     )
     parser.add_argument(
+        "--default-deadline-ms", type=float, default=None,
+        help="end-to-end wall-clock budget applied to requests that "
+             "name no deadline_ms of their own: expiry at any "
+             "dispatch boundary (queue wait, prefill chunk, decode "
+             "chunk, spec round) ends the request with a "
+             "deadline_exceeded terminal frame (504 unary). Default: "
+             "no deadline",
+    )
+    parser.add_argument(
+        "--admission-control", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="SLO-aware admission: estimate queue-wait + TTFT from "
+             "the live p95 reservoirs and shed deadlined requests "
+             "that cannot finish in time at the door (503 + computed "
+             "retry-after); sustained queue pressure engages the "
+             "brownout ladder (clamp max_new_tokens, suppress "
+             "speculation, evict idle prefix pages) before shedding. "
+             "--no-admission-control disables the estimate and the "
+             "ladder (deadlines still enforce)",
+    )
+    parser.add_argument(
+        "--drain-timeout-s", type=float, default=10.0,
+        help="graceful-drain budget on shutdown (SIGTERM/SIGINT): new "
+             "admissions shed 503 and /healthz reports \"draining\" "
+             "while in-flight streams run to completion; streams "
+             "still live after the budget are cancelled with proper "
+             "terminal frames. The --workers supervisor waits this "
+             "long after SIGTERM before SIGKILL",
+    )
+    parser.add_argument(
         "--mesh-shape", default=None,
         help="serve sharded over a (data, model) device mesh, e.g. "
              "'1,4' or '2,4' — params follow the model's declared TP "
@@ -455,11 +496,56 @@ def main(argv=None) -> None:
             args.fused_batch
         ],
     )
-    app = build_app(engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms)
+    app = build_app(
+        engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        default_deadline_ms=args.default_deadline_ms,
+        drain_timeout_s=args.drain_timeout_s,
+        admission_control=args.admission_control,
+    )
     server = Server(app, host=args.host, port=args.port,
                     reuse_port=is_worker)
+
+    async def _serve_until_signalled():
+        # SIGTERM (systemd/docker stop, the --workers supervisor) and
+        # SIGINT take the GRACEFUL path: stop accepting, run the
+        # app's shutdown hooks — which drain in-flight streams under
+        # --drain-timeout-s before the hard stop — then exit. Without
+        # this, SIGTERM killed the process mid-decode and every live
+        # stream ended as a dropped connection.
+        import signal as _signal
+
+        loop = asyncio.get_running_loop()
+        stop_ev = asyncio.Event()
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_ev.set)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main thread / platforms without support
+        await server.start()
+        await stop_ev.wait()
+        _log.info(
+            "shutdown signal: draining (budget %.1fs)",
+            args.drain_timeout_s,
+        )
+        # Drain with the LISTENER STILL OPEN: for the whole budget the
+        # load balancer's /healthz polls see "draining" and late
+        # arrivals shed 503 + retry-after — not connection-refused.
+        # Closing first would make both unreachable and (on runtimes
+        # whose wait_closed waits out open handlers) let a long stream
+        # outlive the budget into the supervisor's SIGKILL.
+        target = app.state.get("batcher") or engine
+        drain = getattr(target, "drain", None)
+        if drain is not None:
+            try:
+                await drain(args.drain_timeout_s)
+            except Exception:
+                _log.exception("drain failed; hard stop follows")
+        # Already drained, so the shutdown hook's own drain() returns
+        # immediately — this closes the listener and stops the engine.
+        await server.stop()
+
     try:
-        asyncio.run(server.serve_forever())
+        asyncio.run(_serve_until_signalled())
     except KeyboardInterrupt:
         pass
 

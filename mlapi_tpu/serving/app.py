@@ -36,14 +36,34 @@ from mlapi_tpu.serving.asgi import (
     StreamingResponse,
     json_response,
 )
+from mlapi_tpu.serving import faults
 from mlapi_tpu.serving.batcher import MicroBatcher, OverloadedError
 from mlapi_tpu.serving.engine import InferenceEngine
+from mlapi_tpu.serving.requests import DeadlineExceeded, DrainCancelled
 from mlapi_tpu.utils.logging import get_logger
 from mlapi_tpu.utils.metrics import MetricsRegistry
 
 _log = get_logger("serving.app")
 
 MAX_ECHO_RECORDS = 1000
+
+
+def _validate_deadline_ms(value) -> None:
+    """Shared /predict + /generate schema check: 0 would silently
+    mean "no deadline" and a negative one would burn a queue slot
+    just to 504 on the first batch."""
+    if value is not None and value <= 0:
+        raise HTTPError(
+            422,
+            [
+                {
+                    "type": "value_error",
+                    "loc": ["deadline_ms"],
+                    "msg": "must be > 0 (omit for no deadline)",
+                    "input": value,
+                }
+            ],
+        )
 
 
 def _overloaded_http(e: OverloadedError) -> HTTPError:
@@ -57,17 +77,40 @@ def _overloaded_http(e: OverloadedError) -> HTTPError:
     )
 
 
+def _terminal_http(e: Exception) -> HTTPError | None:
+    """Map an in-band terminal error frame to its HTTP shape on the
+    UNARY paths (streams carry the same information as their last
+    NDJSON frame): deadline expiry → 504, drain-cancel and pool
+    exhaustion → 503 (retry against a live/looser replica). Anything
+    else stays a 500 via the generic handler."""
+    if isinstance(e, DeadlineExceeded):
+        return HTTPError(504, str(e))
+    if isinstance(e, DrainCancelled):
+        return HTTPError(503, str(e), headers={"retry-after": "5"})
+    from mlapi_tpu.serving.paged_pool import PagePoolExhausted
+
+    if isinstance(e, PagePoolExhausted):
+        return HTTPError(503, str(e), headers={"retry-after": "1"})
+    return None
+
+
 def feature_schema(feature_names) -> type[pydantic.BaseModel]:
     """Build the request schema from the model's feature names — for
     Iris this reproduces the reference's ``IrisSpecies``
     (``main.py:10-14``): four required floats, numeric strings
     coerced. Models without named features (e.g. 784-pixel MNIST)
-    take ``{"features": [..784 floats..]}`` instead."""
+    take ``{"features": [..784 floats..]}`` instead. Every variant
+    carries the optional ``deadline_ms`` wall-clock budget (r12)."""
     if feature_names:
         return pydantic.create_model(
-            "Features", **{name: (float, ...) for name in feature_names}
+            "Features",
+            **{name: (float, ...) for name in feature_names},
+            deadline_ms=(float | None, None),
         )
-    return pydantic.create_model("Features", features=(list[float], ...))
+    return pydantic.create_model(
+        "Features", features=(list[float], ...),
+        deadline_ms=(float | None, None),
+    )
 
 
 def build_app(
@@ -77,11 +120,15 @@ def build_app(
     max_wait_ms: float = 0.2,
     max_queue: int | None = None,
     registry: MetricsRegistry | None = None,
+    default_deadline_ms: float | None = None,
+    drain_timeout_s: float = 10.0,
+    admission_control: bool = True,
 ) -> App:
     app = App(title="mlapi-tpu")
     registry = registry or MetricsRegistry()
     app.state["engine"] = engine
     app.state["metrics"] = registry
+    app.state["drain_timeout_s"] = float(drain_timeout_s)
 
     if engine.kind == "generative":
         batcher = None
@@ -91,10 +138,14 @@ def build_app(
             engine.max_queue = max_queue
         if max_batch is not None:
             engine.max_batch = min(max_batch, engine.max_batch)
+        engine.default_deadline_ms = default_deadline_ms
+        engine.admission_control = bool(admission_control)
+        engine.drain_timeout_s = float(drain_timeout_s)
         _install_generate(app, engine)
     else:
         batcher = MicroBatcher(
             engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            default_deadline_ms=default_deadline_ms,
             **({"max_queue": max_queue} if max_queue is not None else {}),
         )
         app.state["batcher"] = batcher
@@ -102,6 +153,10 @@ def build_app(
 
     @app.on_startup
     async def _start():
+        # Fault-injection points arm from $MLAPI_FAULTS (chaos drills
+        # against a real server); a no-op — zero per-seam overhead —
+        # when unset.
+        faults.arm_from_env()
         # Warm the compiled shapes off the request path, then start
         # the collector. No request ever sees an XLA compile.
         await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
@@ -113,9 +168,17 @@ def build_app(
 
     @app.on_shutdown
     async def _stop():
+        # Graceful drain first (new admissions shed 503 + retry-after
+        # and /healthz flips to "draining" the moment this hook runs;
+        # in-flight streams get the budget to finish, then proper
+        # terminal frames), THEN the hard stop.
+        budget = app.state["drain_timeout_s"]
         if batcher is not None:
+            await batcher.drain(budget)
             await batcher.stop()
         elif hasattr(engine, "stop"):
+            if hasattr(engine, "drain"):
+                await engine.drain(budget)
             await engine.stop()
 
     _install_common(app, engine, registry, batcher)
@@ -126,7 +189,10 @@ def build_app(
 def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
     """The classification surface: ``POST /predict``."""
     if engine.kind == "text":
-        schema = pydantic.create_model("TextRequest", text=(str, ...))
+        schema = pydantic.create_model(
+            "TextRequest", text=(str, ...),
+            deadline_ms=(float | None, None),
+        )
     else:
         schema = feature_schema(engine.feature_names)
     order = engine.feature_names
@@ -160,10 +226,15 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
                     }
                 ],
             )
+        _validate_deadline_ms(features.deadline_ms)
         try:
-            label, prob = await batcher.submit(row)
+            label, prob = await batcher.submit(
+                row, deadline_ms=features.deadline_ms
+            )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
+        except DeadlineExceeded as e:
+            raise HTTPError(504, str(e)) from None
         # Hot path: hand-assembled JSON from the per-label pre-escaped
         # bytes — skips json.dumps (with its default-fn machinery) on
         # every request. %.10g is plenty for a softmax probability.
@@ -191,6 +262,11 @@ def _install_generate(app: App, engine) -> None:
         seed=(int, 0),
         stream=(bool, False),
         stop=(str | list[str] | None, None),
+        # End-to-end wall-clock budget (ms, measured from submit):
+        # expiry at any dispatch boundary ends the stream with a
+        # deadline_exceeded terminal frame / 504; infeasible budgets
+        # shed 503 at the door (server default when omitted).
+        deadline_ms=(float | None, None),
         # Shared-prefix KV caching: the effective prompt is
         # prefix + text, but the prefix's forward pass is computed
         # once and its KV reused by every request that names it.
@@ -280,6 +356,7 @@ def _install_generate(app: App, engine) -> None:
                     }
                 ],
             )
+        _validate_deadline_ms(req.deadline_ms)
         stops = _norm_stops(req.stop)
         try:
             gen = await engine.submit(
@@ -295,6 +372,7 @@ def _install_generate(app: App, engine) -> None:
                 # plain requests let the decode loop chain dispatches
                 # and sync once.
                 stream=bool(req.stream) or bool(stops),
+                deadline_ms=req.deadline_ms,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
@@ -321,10 +399,17 @@ def _install_generate(app: App, engine) -> None:
                     while True:
                         item = await gen.queue.get()
                         if isinstance(item, Exception):
+                            # The stream's TERMINAL ERROR FRAME:
+                            # machine-readable ``code`` for the errors
+                            # clients route on (deadline_exceeded,
+                            # draining) — the status line is long gone,
+                            # so the frame IS the status.
                             finished = True
-                            yield json.dumps(
-                                {"error": str(item)}
-                            ).encode() + b"\n"
+                            frame = {"error": str(item)}
+                            code = getattr(item, "code", None)
+                            if code:
+                                frame["code"] = code
+                            yield json.dumps(frame).encode() + b"\n"
                             return
                         if item is None:
                             finished = True
@@ -385,6 +470,9 @@ def _install_generate(app: App, engine) -> None:
             while True:
                 item = await gen.queue.get()
                 if isinstance(item, Exception):
+                    http = _terminal_http(item)
+                    if http is not None:
+                        raise http from None
                     raise item
                 if item is None:
                     break
@@ -547,8 +635,14 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
 
         import jax
 
+        draining = bool(
+            getattr(engine, "draining", False)
+            or (batcher is not None and batcher.draining)
+        )
         return {
-            "status": "ok",
+            # "draining" the moment shutdown begins: the load balancer
+            # stops routing here while in-flight streams finish.
+            "status": "draining" if draining else "ok",
             "model": type(engine.model).__name__,
             "classes": list(engine.vocab.labels),
             "checkpoint": engine.meta,
@@ -568,12 +662,19 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["batcher.requests"] = batcher.requests
             snap["counters"]["batcher.timeouts"] = batcher.timeouts
             snap["counters"]["batcher.rejected"] = batcher.rejected
+            snap["counters"]["batcher.shed_draining"] = (
+                batcher.shed_draining
+            )
+            snap["counters"]["batcher.deadline_expired"] = (
+                batcher.deadline_expired
+            )
             # Gauges: the overload early-warning signals — queue depth
             # and in-flight batches are the first things to move when
             # offered load exceeds capacity.
             snap.setdefault("gauges", {})
             snap["gauges"]["batcher.queue_depth"] = batcher.queue_depth
             snap["gauges"]["batcher.inflight"] = batcher.inflight
+            snap["gauges"]["batcher.draining"] = int(batcher.draining)
         elif engine.kind == "generative":
             snap["counters"]["generate.requests"] = engine.requests
             snap["counters"]["generate.batch_calls"] = engine.batch_calls
@@ -631,7 +732,41 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.spec_realign_repacks"] = (
                 engine.spec_realign_repacks
             )
+            # Robustness layer (r12): what was shed at the door
+            # (queue-full / infeasible deadline / draining), what
+            # expired at which lifecycle stage, which brownout levers
+            # engaged, and how many armed faults fired — the overload
+            # POST-MORTEM block: these counters say WHY requests
+            # failed, the gauges above say when it started.
+            snap["counters"]["generate.shed_queue_full"] = (
+                engine.shed_queue_full
+            )
+            snap["counters"]["generate.shed_deadline_infeasible"] = (
+                engine.shed_deadline_infeasible
+            )
+            snap["counters"]["generate.shed_draining"] = (
+                engine.shed_draining
+            )
+            snap["counters"]["generate.deadline_expired_queued"] = (
+                engine.deadline_expired_queued
+            )
+            snap["counters"]["generate.deadline_expired_prefill"] = (
+                engine.deadline_expired_prefill
+            )
+            snap["counters"]["generate.deadline_expired_decode"] = (
+                engine.deadline_expired_decode
+            )
+            snap["counters"]["generate.brownout_spec_suppressed"] = (
+                engine.brownout_spec_suppressed
+            )
+            snap["counters"]["generate.brownout_tokens_clamped"] = (
+                engine.brownout_tokens_clamped
+            )
+            snap["counters"]["generate.faults_injected"] = (
+                engine.faults_injected
+            )
             snap.setdefault("gauges", {})
+            snap["gauges"]["generate.draining"] = int(engine.draining)
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
             # Chunked-prefill interleaving: chunks still queued for
             # the in-progress long-prompt joiner (0 when idle), and

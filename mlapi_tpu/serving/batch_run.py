@@ -51,6 +51,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from mlapi_tpu.serving import faults
 from mlapi_tpu.serving.dispatch import DispatchChain
 from mlapi_tpu.utils.logging import get_logger
 
@@ -86,6 +87,12 @@ class BatchRun:
         self.eng = eng
         self.reqs = reqs  # the engine's list object: admission appends
         self.admit = admit
+        # Brownout spec suppression is counted ONCE per batch run: the
+        # lever is consulted at formation AND at every chunk boundary,
+        # and per-call counting would inflate "suppressed engagements"
+        # by the chunk count (a 20-chunk suppressed stream is one
+        # blocked engagement, not twenty).
+        self._spec_supp_counted = False
 
         self.bucket = max(len(r.row) for r in reqs)
         n_new_max = max(r.n_new for r in reqs)
@@ -159,9 +166,30 @@ class BatchRun:
         except BaseException:
             # Formation failed (incl. a loud PagePoolExhausted before
             # any dispatch): give every held page back — the wrapper
-            # delivers the error to the waiters.
-            self._paged_cleanup(write_back=False)
+            # delivers the error to the waiters. write_back matters
+            # (r12): a failure AFTER the prefill dispatch succeeded
+            # (e.g. a fault in the first-token push) leaves the pool's
+            # device arrays consumed by donation and the LIVE ones on
+            # ``self.cache`` — skipping the re-bind here poisoned
+            # every subsequent batch with deleted-buffer errors. The
+            # cleanup's own guard skips write-back when no cache
+            # exists yet.
+            self._paged_cleanup()
             raise
+
+    def _spec_brownout(self) -> bool:
+        """Once-per-run counting wrapper around the brownout spec
+        lever: suppression is re-decided at every consultation (the
+        queue may drain mid-batch, lifting it), but
+        ``brownout_spec_suppressed`` ticks at most once per batch run
+        — one suppressed engagement, however many chunk boundaries
+        re-confirm it."""
+        if self.eng._brownout_level() < 1:
+            return False
+        if not self._spec_supp_counted:
+            self._spec_supp_counted = True
+            self.eng.brownout_spec_suppressed += 1
+        return True
 
     # -- formation ----------------------------------------------------
 
@@ -213,6 +241,9 @@ class BatchRun:
             n_pad_j = jnp.asarray(self.n_pad)
             logits = None
             for c0 in range(0, bucket, cp):
+                faults.fire("prefill_chunk")
+                for r in reqs:
+                    eng._expire_if_due(r, "prefill")
                 eng.prefill_chunks += 1
                 self.cache, logits = extend_chunk_fn(
                     eng.model, cp, total
@@ -426,6 +457,9 @@ class BatchRun:
             n_pad_j = jnp.asarray(self.n_pad)
             logits = None
             for c0 in range(0, bucket, cp):
+                faults.fire("prefill_chunk")
+                for r in self.reqs:
+                    eng._expire_if_due(r, "prefill")
                 eng.prefill_chunks += 1
                 self.cache, logits = paged_extend_fn(eng.model, cp)(
                     eng.params, self.cache,
@@ -632,6 +666,7 @@ class BatchRun:
                 (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
                 or (eng.spec_sample and temps[0] > 0.0)
             )
+            and not self._spec_brownout()  # brownout lever (counted)
         )
         # BATCHED speculation: a freshly-formed all-greedy batch
         # speculates as a whole — per-row acceptance lengths
@@ -662,6 +697,7 @@ class BatchRun:
                 or (self.bucket, self.total, self.b_pad, "batched")
                 in eng.spec.warmed
             )
+            and not self._spec_brownout()  # brownout lever (counted)
         )
         # step[row]: the row's NEXT sampling-stream index — its own
         # produced-token count, NOT a batch-global counter, so a row
@@ -848,6 +884,11 @@ class BatchRun:
         if self._pf is not None:
             n_live += 1  # the interleaved joiner owns its row already
         for cand in candidates:
+            if eng._expire_if_due(cand, "queued"):
+                # Its deadline passed while staged: terminal frame
+                # pushed; never spend a prefill on it.
+                self._unstage(cand)
+                continue
             if cand.cancelled:
                 self._unstage(cand)  # drop silently
                 continue
@@ -991,76 +1032,126 @@ class BatchRun:
                     with eng._alock:
                         eng._deferred.append(cand)
                     continue
-            if self.pool is not None and eng.prefill_page_native:
-                # Page-native admission: ONE dispatch prefills the
-                # joiner's bucket straight into its freshly-mapped
-                # pages at virtual offset pos - bkt — the contiguous
-                # mini cache and its adopt scatter are gone (zero
-                # adopt bytes, same as formation).
-                from mlapi_tpu.models.gpt import paged_prefill_fn
-                from mlapi_tpu.ops.quant import paged_cache_tree
+            # True once a call that DONATES the batch cache has been
+            # entered: past that point a failure may have consumed the
+            # live buffers, and joiner-only recovery would hand every
+            # later chunk deleted buffers — the poisoning class the
+            # formation cleanup fix addresses. Such failures go
+            # batch-fatal instead (run()'s cleanup returns the pages
+            # and the wrapper delivers the error to every waiter).
+            donating = False
+            try:
+                # Injection point: the admission INSTALL — after the
+                # joiner's pages are allocated, before its prefill/
+                # scatter dispatch. The except below is the r12
+                # leak-window fix this point exists to pin.
+                faults.fire("table_install")
+                if self.pool is not None and eng.prefill_page_native:
+                    # Page-native admission: ONE dispatch prefills the
+                    # joiner's bucket straight into its freshly-mapped
+                    # pages at virtual offset pos - bkt — the
+                    # contiguous mini cache and its adopt scatter are
+                    # gone (zero adopt bytes, same as formation).
+                    from mlapi_tpu.models.gpt import paged_prefill_fn
+                    from mlapi_tpu.ops.quant import paged_cache_tree
 
-                if self._tab_dirty:
-                    self._with_tables()
-                cache1 = paged_cache_tree(
-                    self.cache, self.tab[row:row + 1]
-                )
-                first1, cache1 = paged_prefill_fn(eng.model, bkt)(
-                    eng.params, cache1, jnp.asarray(cand.row[None]),
-                    jnp.int32(self.pos - bkt),
-                    jnp.asarray(eng._key_data(cand.seed)[None]),
-                    jnp.asarray(
-                        np.asarray([cand.temperature], np.float32)
-                    ),
-                    jnp.asarray(
-                        np.asarray([bkt - cand.used], np.int32)
-                    ),
-                    jnp.asarray(np.asarray([cand.top_k], np.int32)),
-                    jnp.asarray(
-                        np.asarray([cand.top_p], np.float32)
-                    ),
-                )
-                self.cache = paged_cache_tree(
-                    cache1, self.tab[:self.b_cur]
-                )
-                eng._warmed_scatter.add((bkt, self.npv))
-            else:
-                first1, mini = prefill_fn(eng.model, bkt)(
-                    eng.params, jnp.asarray(cand.row[None]),
-                    jnp.asarray(eng._key_data(cand.seed)[None]),
-                    jnp.asarray(
-                        np.asarray([cand.temperature], np.float32)
-                    ),
-                    jnp.asarray(
-                        np.asarray([bkt - cand.used], np.int32)
-                    ),
-                    jnp.asarray(np.asarray([cand.top_k], np.int32)),
-                    jnp.asarray(
-                        np.asarray([cand.top_p], np.float32)
-                    ),
-                )
-                if self.pool is not None:
-                    from mlapi_tpu.models.gpt import paged_scatter_fn
-                    from mlapi_tpu.ops.quant import kv_tree_bytes
-
-                    eng.prefill_adopt_bytes += kv_tree_bytes(mini)
                     if self._tab_dirty:
                         self._with_tables()
-                    self.cache = paged_scatter_fn()(
-                        self.cache, mini,
-                        jnp.asarray(self.tab[row:row + 1]),
+                    cache1 = paged_cache_tree(
+                        self.cache, self.tab[row:row + 1]
+                    )
+                    donating = True  # paged_prefill_fn donates cache1
+                    first1, cache1 = paged_prefill_fn(eng.model, bkt)(
+                        eng.params, cache1, jnp.asarray(cand.row[None]),
                         jnp.int32(self.pos - bkt),
+                        jnp.asarray(eng._key_data(cand.seed)[None]),
+                        jnp.asarray(
+                            np.asarray([cand.temperature], np.float32)
+                        ),
+                        jnp.asarray(
+                            np.asarray([bkt - cand.used], np.int32)
+                        ),
+                        jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                        jnp.asarray(
+                            np.asarray([cand.top_p], np.float32)
+                        ),
+                    )
+                    self.cache = paged_cache_tree(
+                        cache1, self.tab[:self.b_cur]
                     )
                     eng._warmed_scatter.add((bkt, self.npv))
                 else:
-                    self.cache = admit_scatter_fn()(
-                        self.cache, mini, jnp.int32(row),
-                        jnp.int32(self.pos - bkt),
+                    first1, mini = prefill_fn(eng.model, bkt)(
+                        eng.params, jnp.asarray(cand.row[None]),
+                        jnp.asarray(eng._key_data(cand.seed)[None]),
+                        jnp.asarray(
+                            np.asarray([cand.temperature], np.float32)
+                        ),
+                        jnp.asarray(
+                            np.asarray([bkt - cand.used], np.int32)
+                        ),
+                        jnp.asarray(np.asarray([cand.top_k], np.int32)),
+                        jnp.asarray(
+                            np.asarray([cand.top_p], np.float32)
+                        ),
                     )
-                    eng._warmed_scatter.add(
-                        (bkt, self.total, self.b_cur)
-                    )
-            ftok = int(np.asarray(first1)[0])
+                    if self.pool is not None:
+                        from mlapi_tpu.models.gpt import paged_scatter_fn
+                        from mlapi_tpu.ops.quant import kv_tree_bytes
+
+                        eng.prefill_adopt_bytes += kv_tree_bytes(mini)
+                        if self._tab_dirty:
+                            self._with_tables()
+                        donating = True  # scatter donates self.cache
+                        self.cache = paged_scatter_fn()(
+                            self.cache, mini,
+                            jnp.asarray(self.tab[row:row + 1]),
+                            jnp.int32(self.pos - bkt),
+                        )
+                        eng._warmed_scatter.add((bkt, self.npv))
+                    else:
+                        donating = True  # scatter donates self.cache
+                        self.cache = admit_scatter_fn()(
+                            self.cache, mini, jnp.int32(row),
+                            jnp.int32(self.pos - bkt),
+                        )
+                        eng._warmed_scatter.add(
+                            (bkt, self.total, self.b_cur)
+                        )
+                ftok = int(np.asarray(first1)[0])
+            except Exception as e:  # noqa: BLE001 — joiner-only failure
+                if donating:
+                    # The donating dispatch itself failed: the batch
+                    # cache may be bound to donation-consumed buffers,
+                    # so continuing the batch would poison every later
+                    # chunk. Batch-fatal — run()'s cleanup path.
+                    raise
+                # THE r12 mid-admission leak-window fix. A failure
+                # between the joiner's page allocation and its install
+                # (alloc-then-raise) used to propagate and kill the
+                # WHOLE running batch; the joiner's freshly-mapped
+                # pages were only returned by the batch teardown it
+                # caused. Scope the blast radius to the joiner: give
+                # its pages back (``kv_pages_in_use`` returns to its
+                # pre-admission value — the row was released before
+                # the alloc, so its table holds ONLY this admission's
+                # pages), deliver the error as the joiner's terminal
+                # frame (503-mapped for PagePoolExhausted), and let
+                # the running batch stream on, token-identical — its
+                # mirrors and cache were not yet touched for the
+                # joiner.
+                _log.warning(
+                    "admission of joiner failed (%s); running batch "
+                    "continues", e,
+                )
+                if self.pool is not None:
+                    self._release_row(row)
+                try:
+                    cand.push(e)
+                except Exception:
+                    pass
+                cand.cancel()
+                continue
             self.n_pad[row] = self.pos - cand.used
             self.temps[row] = cand.temperature
             self.topk[row] = cand.top_k
@@ -1201,6 +1292,7 @@ class BatchRun:
         eng, pf = self.eng, self._pf
         cand, cp = pf["cand"], pf["cp"]
         c0 = (pf["skip"] + pf["next"]) * cp
+        faults.fire("prefill_chunk")
         eng.prefill_chunks += 1
         cache1 = paged_cache_tree(self.cache, pf["ptab"])
         cache1, pf["logits"] = paged_extend_fn(eng.model, cp)(
@@ -1228,6 +1320,10 @@ class BatchRun:
         most ONE prefill chunk before the decode chunk — the bound
         `eng.interleave_max_stall` records."""
         eng, pf = self.eng, self._pf
+        # A joiner whose deadline passed mid-prefill aborts its window
+        # (terminal frame pushed; private pages go back) before the
+        # next chunk spends device time on it.
+        eng._expire_if_due(pf["cand"], "prefill")
         if pf["cand"].cancelled:
             self._pf_abort()
             return
@@ -1254,6 +1350,11 @@ class BatchRun:
         if cand.cancelled:
             self._pf_abort()
             return
+        # Injection point: the activation-time table-row install (a
+        # raise here is batch-fatal by design — run()'s except path
+        # appends the staged joiner so every waiter gets its frame,
+        # and the finally releases the private pages).
+        faults.fire("table_install")
         first = sample_fn(eng.model)(
             pf["logits"], jnp.asarray(eng._key_data(cand.seed)[None]),
             jnp.asarray(np.asarray([cand.temperature], np.float32)),
@@ -1378,6 +1479,7 @@ class BatchRun:
         eng = self.eng
         from mlapi_tpu.models.gpt import decode_chunk_fn
 
+        faults.fire("decode")
         eng.chunk_calls += 1
         toks, self.cache, last_tok = decode_chunk_fn(eng.model, size)(
             eng.params, self.cache,
@@ -1458,6 +1560,13 @@ class BatchRun:
                 # table-row assignment) before this boundary's
                 # admission/scheduling.
                 self._pf_activate()
+            # Deadline sweep at the chunk boundary: an expired row
+            # gets its terminal DeadlineExceeded frame and cancels
+            # exactly like a disconnect — it leaves ``live`` below,
+            # and the paged eager sweep releases its pages.
+            for i, r in enumerate(reqs):
+                if not self.done[i]:
+                    eng._expire_if_due(r, "decode")
             pending_n = 0
             if self.admit and eng._admit:
                 pending_n = self._admit_waiting()
@@ -1511,6 +1620,11 @@ class BatchRun:
                 # when the spec phase could actually run rounds.
                 and reqs[0].n_new - self.sched[0] > 1
                 and self.pos + 1 + eng.spec_k + 1 <= self.total
+                # Brownout: under queue pressure speculation's extra
+                # device work is the wrong trade — last in the chain
+                # so the counter only ticks when it actually blocked
+                # an engagement.
+                and not self._spec_brownout()
             ):
                 chain.invalidate()
                 self._try_spec()

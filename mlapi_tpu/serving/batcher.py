@@ -106,8 +106,12 @@ class OverloadedError(Exception):
     where it would time out after adding to the overload. Raised by
     both engines' ``submit``; the app converts it to HTTP."""
 
-    def __init__(self, what: str, retry_after_s: float = 1.0):
-        super().__init__(f"{what} queue full")
+    def __init__(self, what: str, retry_after_s: float = 1.0,
+                 detail: str | None = None):
+        # ``detail`` overrides the classic queue-full message for the
+        # other shed reasons (draining, infeasible deadline) that ride
+        # the same 503 + Retry-After path.
+        super().__init__(detail or f"{what} queue full")
         self.retry_after_s = retry_after_s
 
 
@@ -123,13 +127,28 @@ class MicroBatcher:
         max_queue: int = 8192,
         max_inflight: int = 16,
         dispatch_timeout_s: float = 30.0,
+        default_deadline_ms: float | None = None,
     ):
         self.engine = engine
         self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
         self.max_wait_s = max_wait_ms / 1e3
         self.max_inflight = max_inflight
         self.dispatch_timeout_s = dispatch_timeout_s
+        # Wall-clock budget applied when a request names none (None =
+        # no deadline): classification's one dispatch boundary is the
+        # queue→batch handoff, where expired entries fail with
+        # DeadlineExceeded (504) instead of burning device time.
+        self.default_deadline_ms = default_deadline_ms
+        # Graceful drain: submit sheds while True; in-flight batches
+        # finish (their resolvers set results), the queue empties.
+        self.draining = False
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        # True while the collect loop holds popped rows it has not
+        # yet dispatched (the straggler window): those rows are in
+        # neither the queue nor ``inflight``, and drain() must treat
+        # the window as live work or it can declare the batcher idle
+        # with a batch still forming.
+        self._collecting = False
         self._inflight: asyncio.Semaphore | None = None
         self._task: asyncio.Task | None = None
         self._resolvers: set[asyncio.Task] = set()
@@ -140,6 +159,8 @@ class MicroBatcher:
         self.timeouts = 0
         self.rejected = 0
         self.inflight = 0
+        self.shed_draining = 0
+        self.deadline_expired = 0
 
     @property
     def queue_depth(self) -> int:
@@ -167,11 +188,40 @@ class MicroBatcher:
             await asyncio.gather(*list(self._resolvers), return_exceptions=True)
         self._pool.close()  # release idle dispatch workers
         while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
+            _, fut, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("batcher stopped"))
 
-    async def submit(self, row: np.ndarray) -> tuple[str, float]:
+    async def drain(self, timeout_s: float = 10.0) -> None:
+        """Graceful drain: shed new submits (503 + retry-after), let
+        queued and in-flight batches finish inside the budget; when
+        the budget runs out, anything still QUEUED sheds with the
+        same documented 503 + retry-after (``stop()`` would fail it
+        with an opaque RuntimeError → 500), while dispatched batches
+        are left to resolve — late but clean."""
+        self.draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, timeout_s)
+        while loop.time() < deadline:
+            if (
+                self._queue.empty()
+                and self.inflight == 0
+                and not self._collecting
+            ):
+                return
+            await asyncio.sleep(0.05)
+        while not self._queue.empty():
+            _, fut, _ = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(OverloadedError(
+                    "predict", retry_after_s=5.0,
+                    detail="drain budget exhausted: retry against "
+                           "another replica",
+                ))
+
+    async def submit(
+        self, row: np.ndarray, *, deadline_ms: float | None = None
+    ) -> tuple[str, float]:
         """Queue one feature row; resolves to (label, probability).
 
         Raises :class:`OverloadedError` immediately when the queue is
@@ -180,9 +230,24 @@ class MicroBatcher:
         queued request eventually times out anyway."""
         if self._task is None:
             raise RuntimeError("batcher not started")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        loop = asyncio.get_running_loop()
+        if self.draining:
+            self.shed_draining += 1
+            self.rejected += 1
+            raise OverloadedError(
+                "predict", retry_after_s=5.0,
+                detail="server draining: retry against another replica",
+            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (
+            loop.time() + deadline_ms / 1e3 if deadline_ms else None
+        )
+        fut: asyncio.Future = loop.create_future()
         try:
-            self._queue.put_nowait((np.asarray(row, np.float32), fut))
+            self._queue.put_nowait(
+                (np.asarray(row, np.float32), fut, deadline)
+            )
         except asyncio.QueueFull:
             self.rejected += 1
             raise OverloadedError("predict") from None
@@ -209,6 +274,10 @@ class MicroBatcher:
             rows = []
             try:
                 rows.append(await self._queue.get())
+                # No await between the pop resuming and this flag, so
+                # drain() can never observe the popped row in neither
+                # the queue nor the collection window.
+                self._collecting = True
                 if self.max_wait_s > 0:
                     deadline = loop.time() + self.max_wait_s
                     while len(rows) < self.max_batch:
@@ -234,17 +303,43 @@ class MicroBatcher:
                 # popped are no longer in the queue, so stop()'s drain
                 # can't see them — fail their futures here or their
                 # submit() callers hang forever.
-                for _, fut in rows:
+                self._collecting = False
+                for _, fut, _ in rows:
                     if not fut.done():
                         fut.set_exception(RuntimeError("batcher stopped"))
                 raise
 
-            batch = np.stack([r for r, _ in rows])
-            futures = [f for _, f in rows]
+            # Deadline check at the ONE dispatch boundary this path
+            # owns (queue → device batch): entries whose wall-clock
+            # budget passed while queued fail with DeadlineExceeded
+            # (504) instead of occupying batch rows.
+            now = loop.time()
+            expired = [
+                f for _, f, d in rows if d is not None and now > d
+            ]
+            if expired:
+                from mlapi_tpu.serving.requests import DeadlineExceeded
+
+                self.deadline_expired += len(expired)
+                for f in expired:
+                    if not f.done():
+                        f.set_exception(DeadlineExceeded("queued"))
+                rows = [
+                    rf for rf in rows
+                    if rf[2] is None or now <= rf[2]
+                ]
+                if not rows:
+                    self._inflight.release()
+                    self._collecting = False
+                    continue
+
+            batch = np.stack([r for r, _, _ in rows])
+            futures = [f for _, f, _ in rows]
             # Fire the batch without awaiting its completion: up to
             # max_inflight device round trips overlap, while this loop
             # goes straight back to collecting the next batch.
             self.inflight += 1
+            self._collecting = False  # rows now covered by inflight
             work = self._dispatch_thread(loop, batch)
             resolver = asyncio.create_task(self._resolve(work, futures))
             self._resolvers.add(resolver)

@@ -31,18 +31,26 @@ from __future__ import annotations
 import asyncio
 import bisect
 import functools
+import time
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mlapi_tpu.serving import faults
 from mlapi_tpu.serving.fused_single import FusedSinglePath
 from mlapi_tpu.serving.prefix import PrefixCache
 
 # Request-side data types live in serving/requests.py; re-exported
 # because the engine API and the test suite name them from this module.
-from mlapi_tpu.serving.requests import GenRequest, _PrefixEntry, _SyncSink
+from mlapi_tpu.serving.requests import (
+    DeadlineExceeded,
+    DrainCancelled,
+    GenRequest,
+    _PrefixEntry,
+    _SyncSink,
+)
 from mlapi_tpu.serving.spec_phase import SpecPhase
 
 from mlapi_tpu.utils.logging import get_logger
@@ -836,6 +844,56 @@ class TextGenerationEngine:
         self.prefill_chunk_queue_depth = 0
         self.spec_realign_table_ops = 0
         self.spec_realign_repacks = 0
+        # Robustness layer (r12). Deadlines: every request may carry a
+        # wall-clock budget (``deadline_ms``; this engine default
+        # applies when the request names none); each dispatch boundary
+        # checks expiry via ``_expire_if_due`` and cancels the row the
+        # way client disconnects already do, after a terminal
+        # DeadlineExceeded frame. ``None`` default = no deadline — the
+        # pre-r12 stream bytes, untouched.
+        self.default_deadline_ms: float | None = None
+        # SLO-aware admission control: before enqueueing, a deadlined
+        # request's feasibility is estimated from the LatencyStats p95
+        # reservoirs and the current queue depth
+        # (``admission_estimate_ms``); infeasible requests shed 503 +
+        # computed retry-after at the door instead of occupying a slot
+        # and timing out later. Sustained queue pressure engages the
+        # counted brownout ladder (clamp n_new to the default tier,
+        # suppress speculation, evict idle prefix page sets) before
+        # shedding.
+        self.admission_control = True
+        # Graceful drain: ``drain()`` flips this, sheds new admissions
+        # (503 + retry-after), lets in-flight streams finish inside
+        # the budget, then cancels leftovers with DrainCancelled
+        # terminal frames.
+        self.draining = False
+        # The reqs list of the batch the decode thread is currently
+        # running (None between batches) — what drain() must wait out
+        # or cancel. Written by the decode thread, read from the loop.
+        self._running: list | None = None
+        # The reqs list the collector has claimed but not yet finished
+        # running (None otherwise) — the straggler-collection window
+        # plus the executor handoff, during which those requests are
+        # in neither the queue, the staging lists, nor _running.
+        # drain() must treat this window as in-flight work (idle
+        # check + budget-exhausted cancellation) or it can declare
+        # the engine idle with a batch still forming.
+        self._forming: list | None = None
+        # The collector's window-incompatible leftovers, kept between
+        # its iterations: claimed off the queue but in neither the
+        # staging lists nor a formed batch. An engine attribute — not
+        # a collector local — so drain()'s budget-exhausted sweep can
+        # deliver their DrainCancelled frames too.
+        self._carry: list = []
+        # Robustness counters (exported on /metrics + bench snapshot).
+        self.shed_queue_full = 0
+        self.shed_deadline_infeasible = 0
+        self.shed_draining = 0
+        self.deadline_expired_queued = 0
+        self.deadline_expired_prefill = 0
+        self.deadline_expired_decode = 0
+        self.brownout_spec_suppressed = 0
+        self.brownout_tokens_clamped = 0
         # TTFT / inter-token reservoirs, recorded at the push seam.
         from mlapi_tpu.serving.requests import LatencyStats
 
@@ -860,6 +918,129 @@ class TextGenerationEngine:
         base = self._queue.qsize() if self._queue is not None else 0
         with self._alock:
             return base + len(self._admit) + len(self._deferred)
+
+    # -- robustness: deadlines, admission control, brownout ---------------
+
+    def _expire_if_due(self, r, stage: str) -> bool:
+        """THE deadline check, called at every dispatch boundary the
+        scheduler owns (collector pop, formation, admission staging,
+        prefill chunks, decode chunks, spec rounds). An expired
+        request gets its terminal :class:`DeadlineExceeded` frame and
+        is cancelled exactly the way a client disconnect is — the
+        existing cancellation path frees the decode row and releases
+        its pages through the refcount machinery. Returns True when
+        this call expired the request. Deadline-less requests cost one
+        attribute read."""
+        d = getattr(r, "deadline", None)
+        if d is None or r.cancelled or time.perf_counter() < d:
+            return False
+        counter = f"deadline_expired_{stage}"
+        setattr(self, counter, getattr(self, counter) + 1)
+        try:
+            r.push(DeadlineExceeded(stage))
+        except Exception:  # a dead consumer loop must not mask others
+            pass
+        r.cancel()
+        return True
+
+    def admission_estimate_ms(self) -> float:
+        """Estimated queue-wait + TTFT for a request submitted NOW,
+        from the r10 LatencyStats p95 reservoirs and the live queue
+        depths: each ``max_batch``-worth of backlog ahead costs about
+        one batch turnaround (p95 TTFT + the default token budget at
+        the p95 inter-token rate), and the request then pays its own
+        p95 TTFT. Returns 0 until traffic has populated the
+        reservoirs — a cold server never sheds on a guess."""
+        s = self.latency.summary()
+        ttft = s["ttft_p95_ms"] or 0.0
+        itl = s["intertoken_p50_ms"] or 0.0
+        batch_ms = ttft + self.default_max_new_tokens * itl
+        backlog = (
+            self.queue_depth + self.prefill_chunk_queue_depth
+        ) / max(1, self.max_batch)
+        return backlog * batch_ms + ttft
+
+    def _brownout_level(self) -> int:
+        """Queue pressure → brownout rung: 0 normal, 1 at >= 50% of
+        ``max_queue`` (clamp token budgets, suppress speculation), 2
+        at >= 75% (additionally evict idle prefix page sets). The
+        levers degrade work per request BEFORE the queue-full shed
+        fires — Snap ML's degrade-per-tier, not fall-over-globally."""
+        if not self.admission_control:
+            return 0
+        q = self.queue_depth
+        if q * 4 >= self.max_queue * 3:
+            return 2
+        if q * 2 >= self.max_queue:
+            return 1
+        return 0
+
+    async def drain(self, timeout_s: float | None = None) -> None:
+        """Graceful drain: stop admitting (submit sheds 503 +
+        retry-after, ``/healthz`` reports draining), let in-flight
+        streams run to completion inside ``timeout_s``, then cancel
+        whatever remains with proper :class:`DrainCancelled` terminal
+        frames so no consumer ever hangs on a half-dead stream.
+        Idempotent; ``stop()`` afterwards is still the caller's job
+        (the app's shutdown hook does both)."""
+        self.draining = True
+        if timeout_s is None:
+            timeout_s = getattr(self, "drain_timeout_s", 10.0)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, float(timeout_s))
+        while loop.time() < deadline:
+            with self._alock:
+                backlog = len(self._admit) + len(self._deferred)
+            queued = (
+                self._queue is not None and not self._queue.empty()
+            )
+            if (
+                not queued
+                and not backlog
+                and not self._carry
+                and self._running is None
+                and self._forming is None
+            ):
+                return
+            await asyncio.sleep(0.05)
+        # Budget exhausted: terminal frames for everything still in
+        # flight or queued, then cancel the rows (pages come back via
+        # the cancellation path; stop() handles the collector task).
+        leftovers: list = []
+        if self._queue is not None:
+            while not self._queue.empty():
+                leftovers.append(self._queue.get_nowait())
+        with self._alock:
+            leftovers += self._admit + self._deferred
+            self._admit.clear()
+            self._deferred.clear()
+        # The collector's carry list: claimed off the queue but in
+        # neither the queue, the staging lists, nor a formed batch.
+        # Cancel-only (no clear) — the collector owns the list and
+        # drops cancelled rows at its next formation.
+        leftovers += list(self._carry)
+        running = self._running
+        if running is not None:
+            leftovers += list(running)
+        forming = self._forming
+        if forming is not None:
+            # May overlap ``running`` (the collector keeps its claim
+            # until the batch finishes); the ``cancelled`` guard below
+            # makes the second visit a no-op.
+            leftovers += list(forming)
+        for r in leftovers:
+            if getattr(r, "cancelled", False):
+                continue
+            try:
+                r.push(DrainCancelled())
+            except Exception:
+                pass
+            r.cancel()
+        # Give the decode thread a moment to notice the cancels and
+        # finish the batch — bounded, never a hang.
+        grace = loop.time() + 2.0
+        while self._running is not None and loop.time() < grace:
+            await asyncio.sleep(0.05)
 
     @property
     def _admit_eager(self) -> bool:
@@ -1048,6 +1229,12 @@ class TextGenerationEngine:
         one page instead of (tier - t) slots."""
         return self.pool.page_bytes if self.pool is not None else 0
 
+    @property
+    def faults_injected(self) -> int:
+        """Armed-fault fires since the harness was last armed (0 when
+        disarmed) — state lives in ``serving/faults.py``."""
+        return faults.injected_count()
+
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property
     def prefix_hits(self) -> int:
@@ -1064,7 +1251,8 @@ class TextGenerationEngine:
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
                 prefix: str | None = None,
-                stream: bool = False) -> GenRequest:
+                stream: bool = False,
+                deadline_ms: float | None = None) -> GenRequest:
         entry = None
         raw = None
         if prefix:
@@ -1128,6 +1316,7 @@ class TextGenerationEngine:
         return GenRequest(
             row, used, n_new, temperature, seed, loop, top_k, top_p,
             prefix=entry, stream=stream, stats=self.latency,
+            deadline_ms=deadline_ms,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -1179,6 +1368,17 @@ class TextGenerationEngine:
         from mlapi_tpu.serving.batch_run import BatchRun
 
         try:
+            self._running = reqs
+            # Requests whose deadline passed during the queue wait
+            # never reach the device: terminal frame now, row never
+            # formed. In place — admission appends to this list object
+            # and error delivery iterates it.
+            alive = [
+                r for r in reqs if not self._expire_if_due(r, "queued")
+            ]
+            if not alive:
+                return
+            reqs[:] = alive
             self.batch_calls += 1
             if fused_ok and self.fused_single:
                 if (
@@ -1200,6 +1400,8 @@ class TextGenerationEngine:
                     r.push(e)
                 except Exception:  # a dead loop must not mask others
                     pass
+        finally:
+            self._running = None
 
     # -- asyncio batcher ---------------------------------------------------
     async def start(self) -> None:
@@ -1216,6 +1418,12 @@ class TextGenerationEngine:
                 await self._task
             except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                # A collector that died on its own (e.g. an injected
+                # fault) already delivered its waiters' error frames
+                # in its finally; stop() must still complete so
+                # start() can bring up a fresh collector.
+                _log.warning("collector had died: %r", e)
             self._task = None
         if self._queue is not None:
             while not self._queue.empty():
@@ -1266,7 +1474,11 @@ class TextGenerationEngine:
 
     async def _collect_loop(self) -> None:
         loop = asyncio.get_running_loop()
-        carry: list = []  # window-incompatible leftovers, served next
+        # self._carry (window-incompatible leftovers, served next) is
+        # initialized in __init__ and cleared in the finally below —
+        # no reset here, so items seeded between start() and the first
+        # iteration (or left by a crashed predecessor, already pushed
+        # terminal frames) can never be silently dropped.
         reqs: list = []
         get = None  # in-flight queue pop (outer so the finally sees it)
         try:
@@ -1279,13 +1491,16 @@ class TextGenerationEngine:
                 # them blindly would truncate the long ones and pad
                 # the device batch past the warmed grid).
                 with self._alock:
-                    carry = self._deferred + self._admit + carry
+                    self._carry = (
+                        self._deferred + self._admit + self._carry
+                    )
                     self._deferred.clear()
                     self._admit.clear()
-                if carry:
-                    reqs = [carry[0]]
+                if self._carry:
+                    reqs = [self._carry[0]]
+                    self._forming = reqs
                     rest: list = []
-                    for r in carry[1:]:
+                    for r in self._carry[1:]:
                         if (
                             len(reqs) < self.max_batch
                             and self._compatible(reqs, r)
@@ -1293,10 +1508,19 @@ class TextGenerationEngine:
                             reqs.append(r)
                         else:
                             rest.append(r)
-                    carry = rest
+                    self._carry = rest
                 else:
                     reqs = [await self._queue.get()]
-                    carry = []
+                    # No await between the pop resuming and this
+                    # assignment, so drain() can never observe the
+                    # claimed request in neither the queue nor here.
+                    self._forming = reqs
+                    self._carry = []
+                    # A fault here kills the COLLECTOR between
+                    # claiming a request and serving it — the finally
+                    # below must still deliver terminal frames to
+                    # everything claimed, queued, or staged.
+                    faults.fire("collector_pop")
                 if self.max_wait_s > 0:
                     deadline = loop.time() + self.max_wait_s
                     while len(reqs) < self.max_batch:
@@ -1328,7 +1552,7 @@ class TextGenerationEngine:
                         if self._compatible(reqs, nxt):
                             reqs.append(nxt)
                         else:
-                            carry.append(nxt)
+                            self._carry.append(nxt)
                             break  # keep the window short; serve it next
                 else:
                     while (
@@ -1339,7 +1563,7 @@ class TextGenerationEngine:
                         if self._compatible(reqs, nxt):
                             reqs.append(nxt)
                         else:
-                            carry.append(nxt)
+                            self._carry.append(nxt)
                             break
                 # One batch decodes at a time (single device stream).
                 # While it runs, keep draining arrivals into the
@@ -1392,7 +1616,9 @@ class TextGenerationEngine:
                         get = None
                 await fut
                 reqs = []
+                self._forming = None
         finally:
+            self._forming = None
             # Cancellation (stop()) or a collector crash must not
             # strand waiters — neither those already popped off the
             # queue NOR those still queued or awaiting admission (a
@@ -1413,11 +1639,12 @@ class TextGenerationEngine:
                 queued += self._admit + self._deferred
                 self._admit.clear()
                 self._deferred.clear()
-            for r in (*reqs, *carry, *queued):
+            for r in (*reqs, *self._carry, *queued):
                 try:
                     r.push(err)
                 except Exception:
                     pass
+            self._carry = []
 
     async def submit(
         self,
@@ -1430,10 +1657,19 @@ class TextGenerationEngine:
         top_p: float = 1.0,
         prefix: str | None = None,
         stream: bool = False,
+        deadline_ms: float | None = None,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
-        sentinel (exceptions are delivered in-band)."""
+        sentinel (exceptions are delivered in-band).
+
+        ``deadline_ms`` is the request's end-to-end wall-clock budget
+        (engine default when ``None``; see ``default_deadline_ms``).
+        A deadlined request the admission estimate says cannot finish
+        in time sheds HERE — 503 + computed retry-after — instead of
+        occupying a queue slot and timing out mid-decode."""
+        from mlapi_tpu.serving.batcher import OverloadedError
+
         if self._task is None:
             raise RuntimeError("generation engine not started")
         if self._task.done():
@@ -1445,7 +1681,51 @@ class TextGenerationEngine:
             raise RuntimeError(
                 f"generation collector died: {exc!r}"
             ) from exc
+        if self.draining:
+            # Drain window: new admissions go elsewhere; retry-after
+            # hints how long the restart (drain budget) takes.
+            self.shed_draining += 1
+            self.rejected += 1
+            raise OverloadedError(
+                "generate",
+                retry_after_s=getattr(self, "drain_timeout_s", 10.0),
+                detail="server draining: retry against another replica",
+            )
         n_new = int(max_new_tokens or self.default_max_new_tokens)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        level = self._brownout_level()
+        if level >= 1 and n_new > self.default_max_new_tokens:
+            # Brownout lever 1: clamp oversized budgets to the default
+            # tier — bounded work per admitted request under pressure.
+            n_new = self.default_max_new_tokens
+            self.brownout_tokens_clamped += 1
+        if level >= 2 and self.pool is not None:
+            # Brownout lever 3: proactively evict an idle (LRU,
+            # unreferenced) prefix page set so live sequences keep
+            # allocating instead of hitting PagePoolExhausted.
+            self.pool.evict_idle(1)
+        if (
+            self.admission_control
+            and deadline_ms is not None
+            and deadline_ms > 0
+        ):
+            est = self.admission_estimate_ms()
+            if est > deadline_ms:
+                # Infeasible: it would expire in the queue anyway —
+                # shed now, and tell the client when the backlog
+                # should have cleared.
+                self.shed_deadline_infeasible += 1
+                self.rejected += 1
+                raise OverloadedError(
+                    "generate",
+                    retry_after_s=max(1.0, (est - deadline_ms) / 1e3),
+                    detail=(
+                        f"deadline infeasible: estimated queue wait + "
+                        f"TTFT {est:.0f} ms exceeds the {deadline_ms:.0f} "
+                        f"ms budget"
+                    ),
+                )
         # Encode OFF the event loop: a first-use prefix runs a device
         # prefill (and possibly an XLA compile) inside _encode — on
         # the loop thread that would freeze every stream and timer in
@@ -1456,15 +1736,28 @@ class TextGenerationEngine:
             lambda: self._encode(
                 text, n_new, float(temperature), int(seed), loop,
                 int(top_k), float(top_p), prefix=prefix,
-                stream=bool(stream),
+                stream=bool(stream), deadline_ms=deadline_ms,
             ),
         )
+        if self.draining or self._task is None or self._task.done():
+            # Drain (or a full stop) may have COMPLETED during the
+            # encode executor await: this request passed the front-door
+            # check but was invisible to drain's idle sweep (not yet
+            # queued, staged, or running), so enqueueing now would land
+            # it in a queue no collector will ever pop — a stream with
+            # no terminal frame. Shed exactly like the front door.
+            self.shed_draining += 1
+            self.rejected += 1
+            raise OverloadedError(
+                "generate",
+                retry_after_s=getattr(self, "drain_timeout_s", 10.0),
+                detail="server draining: retry against another replica",
+            )
         try:
             self._queue.put_nowait(req)
         except asyncio.QueueFull:
-            from mlapi_tpu.serving.batcher import OverloadedError
-
             self.rejected += 1
+            self.shed_queue_full += 1
             raise OverloadedError("generate", retry_after_s=2.0) from None
         self.requests += 1
         return req
@@ -1480,6 +1773,7 @@ class TextGenerationEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         prefix: str | None = None,
+        deadline_ms: float | None = None,
     ) -> dict:
         """One prompt → generated continuation (text + ids), through
         the same ``_run_batch`` the batcher uses — including its
@@ -1488,9 +1782,12 @@ class TextGenerationEngine:
         the chunked programs (e.g. when reproducing a chunked-path
         decode bug)."""
         n_new = int(max_new_tokens or self.default_max_new_tokens)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
         req = self._encode(
             text, n_new, float(temperature), int(seed), None,
             int(top_k), float(top_p), prefix=prefix,
+            deadline_ms=deadline_ms,
         )
         out_ids: list[int] = []
         sink = _SyncSink(req, out_ids)

@@ -1,0 +1,246 @@
+"""Deterministic fault injection for the serving engine.
+
+The serving lifecycle funnels every kind of device work through a
+handful of seams — pool page allocation, table-row install, prefill
+chunk dispatch, decode chunk dispatch, speculative verify, the
+collector's queue pop, the per-request stream push. This module puts
+a NAMED injection point at each of those seams so tests (and chaos
+drills) can force the failure modes the engine's invariants must
+survive — ``PagePoolExhausted`` mid-admission, a slow dispatch inside
+a drain window, a killed collector — without hacking private state,
+then assert the conservation invariants: page refcounts return to
+baseline, no orphan table rows, every stream ends in a well-formed
+terminal frame, and the engine serves fresh work afterward.
+
+Design constraints, in order:
+
+- **Zero overhead when disarmed.** ``fire()`` is one module-global
+  bool check; nothing is parsed, counted, or locked until a spec is
+  armed. The production hot path pays a predictable ~100 ns per seam.
+- **Deterministic.** Triggers are CALL COUNTS (``after=N`` skips the
+  first N calls then fires; ``every=N`` fires each Nth call), never
+  randomness or wall-clock — the same traffic hits the same fault at
+  the same dispatch, every run.
+- **Seam-native exceptions.** A point may hand ``fire()`` the
+  exception its seam raises for real (``pool_alloc`` raises
+  ``PagePoolExhausted``), so armed faults exercise the EXACT handler
+  paths production failures take; everywhere else an
+  :class:`InjectedFault` makes the provenance unmistakable.
+
+Arming, by env or explicitly::
+
+    MLAPI_FAULTS="pool_alloc:after=3:raise,decode:every=5:delay=0.05"
+
+Grammar: comma-separated clauses, each ``point[:trigger]*[:action]``.
+Actions: ``raise`` (default) or ``delay=<seconds>``. Triggers:
+``after=N`` (skip N calls, then due) or ``every=N`` (due on each Nth
+call) — at most one of the two per clause — plus ``times=M`` (fire at
+most M times; defaults to 1 for ``raise`` — one shot — and unlimited
+for ``delay``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+POINTS = (
+    "pool_alloc",      # PagePool.alloc — before pages leave the free list
+    "table_install",   # admission/pf-activation table-row install
+    "prefill_chunk",   # each prefill-chunk dispatch (formation + interleaved)
+    "decode",          # each decode-chunk dispatch
+    "spec_verify",     # each speculative verify block (solo + batched)
+    "collector_pop",   # the collector claiming a queued request
+    "stream_push",     # a token chunk entering a request's queue
+)
+
+ENV_VAR = "MLAPI_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """The generic armed-point failure (``action=raise`` at a seam
+    with no native exception)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point!r}")
+        self.point = point
+
+
+class _Rule:
+    __slots__ = (
+        "point", "action", "delay_s", "after", "every", "times",
+        "calls", "fired",
+    )
+
+    def __init__(self, point: str, action: str, delay_s: float,
+                 after: int | None, every: int | None,
+                 times: int | None):
+        self.point = point
+        self.action = action       # "raise" | "delay"
+        self.delay_s = delay_s
+        self.after = after
+        self.every = every
+        self.times = times         # None = unlimited
+        self.calls = 0
+        self.fired = 0
+
+    def due(self) -> bool:
+        """Call-count trigger decision (caller holds the lock and has
+        already bumped ``calls``)."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.every is not None:
+            return self.calls % self.every == 0
+        if self.after is not None:
+            return self.calls > self.after
+        return True
+
+
+# Module-global armed state: ONE bool gates the hot path; the rule
+# table and counters exist only while armed. The lock serializes
+# decode-thread fires against event-loop arms/reads.
+armed = False
+_rules: dict[str, _Rule] = {}
+_lock = threading.Lock()
+_injected = 0
+
+
+def parse(spec: str) -> dict[str, _Rule]:
+    """Parse an ``MLAPI_FAULTS`` spec string; loud on unknown points
+    or malformed clauses (a typo'd chaos drill must not silently test
+    nothing)."""
+    rules: dict[str, _Rule] = {}
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        fields = clause.split(":")
+        point = fields[0].strip()
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {', '.join(POINTS)}"
+            )
+        action = None
+        delay_s = 0.0
+        after = every = times = None
+        for f in fields[1:]:
+            f = f.strip()
+            if f == "raise":
+                action = "raise"
+            elif f.startswith("delay="):
+                action = "delay"
+                delay_s = float(f[len("delay="):])
+                if delay_s < 0:
+                    raise ValueError(f"negative delay in {clause!r}")
+            elif f.startswith("after="):
+                after = int(f[len("after="):])
+            elif f.startswith("every="):
+                every = int(f[len("every="):])
+                if every < 1:
+                    raise ValueError(f"every must be >= 1 in {clause!r}")
+            elif f.startswith("times="):
+                times = int(f[len("times="):])
+            else:
+                raise ValueError(
+                    f"bad fault field {f!r} in {clause!r} (want raise, "
+                    f"delay=S, after=N, every=N, or times=N)"
+                )
+        if after is not None and every is not None:
+            raise ValueError(
+                f"both after= and every= in {clause!r}: pick one — "
+                f"due() honors a single trigger, and a clause that "
+                f"silently ignored one would fire on a schedule the "
+                f"operator did not write"
+            )
+        if point in rules:
+            raise ValueError(
+                f"duplicate fault point {point!r}: one clause per "
+                f"point (a silently-dropped clause would test less "
+                f"than the operator wrote)"
+            )
+        if action is None:
+            action = "raise"
+        if times is None and action == "raise":
+            # An unbounded raise would keep killing the recovery path
+            # the test is trying to observe; one shot is the useful
+            # default (delay stays unlimited — it only slows).
+            times = 1
+        rules[point] = _Rule(point, action, delay_s, after, every, times)
+    return rules
+
+
+def arm(spec: str | None = None) -> None:
+    """Install a fault spec (replaces any armed one). ``None`` reads
+    ``$MLAPI_FAULTS``; an empty spec disarms."""
+    global armed, _injected
+    if spec is None:
+        spec = os.environ.get(ENV_VAR, "")
+    rules = parse(spec)
+    with _lock:
+        _rules.clear()
+        _rules.update(rules)
+        _injected = 0
+        armed = bool(rules)
+
+
+def arm_from_env() -> bool:
+    """Arm from ``$MLAPI_FAULTS`` if set (server startup hook); no-op
+    — and no disarm — when the variable is absent."""
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        return False
+    arm(spec)
+    return True
+
+
+def disarm() -> None:
+    global armed, _injected
+    with _lock:
+        _rules.clear()
+        _injected = 0
+        armed = False
+
+
+def injected_count() -> int:
+    """Faults actually fired under the CURRENT arming (0 when
+    disarmed) — the ``/metrics`` counter
+    ``generate.faults_injected``."""
+    with _lock:
+        return _injected
+
+
+@contextlib.contextmanager
+def active(spec: str):
+    """Test-scoped arming: ``with faults.active("decode:raise"): ...``
+    — always disarms, even when the injected fault propagates."""
+    arm(spec)
+    try:
+        yield
+    finally:
+        disarm()
+
+
+def fire(point: str, exc: BaseException | None = None) -> None:
+    """The seam call. Disarmed: one bool check, return. Armed: bump
+    the point's call count; when its trigger is due, sleep
+    (``delay``) or raise (``exc`` if the seam passed its native
+    exception, else :class:`InjectedFault`)."""
+    if not armed:
+        return
+    with _lock:
+        rule = _rules.get(point)
+        if rule is None:
+            return
+        rule.calls += 1
+        if not rule.due():
+            return
+        rule.fired += 1
+        global _injected
+        _injected += 1
+        action, delay_s = rule.action, rule.delay_s
+    if action == "delay":
+        time.sleep(delay_s)
+        return
+    raise exc if exc is not None else InjectedFault(point)

@@ -67,8 +67,9 @@ class FusedSinglePath:
         floor through a tunneled attach. Returns ``False`` to fall
         through to the chunked path: streaming consumers, prefix rows,
         long (chunked-prefill) prompts, budgets past ``fused_max_new``,
-        unwarmed shapes in strict mode, and batches with staged
-        joiners all decode chunked exactly as before. The emitted
+        deadlined requests, unwarmed shapes in strict mode, and
+        batches with staged joiners all decode chunked exactly as
+        before. The emitted
         stream is byte-identical to the chunked path (same pads, same
         per-token PRNG stream indices; greedy speculation is
         argmax-exact), so which path served a request is invisible in
@@ -82,6 +83,12 @@ class FusedSinglePath:
         path entirely.
         """
         eng = self.eng
+        # A deadlined request needs the chunked path's per-boundary
+        # expiry checks — one fused run is one uninterruptible device
+        # program with no boundary to check at, so a blown budget
+        # would still return 200 with the full completion.
+        if r.deadline is not None:
+            return False
         if admit:
             with eng._alock:
                 if eng._admit or eng._deferred:
@@ -165,9 +172,9 @@ class FusedSinglePath:
         whole BATCHED SPECULATION as one program instead
         (``fused_spec_batched_fn`` — vs the host batched phase's two
         dispatches per round). Returns ``False`` to fall through to
-        continuous batching: streams, prefix rows, mixed
-        greedy/sampled draft batches, long prompts, over-cap budgets,
-        staged joiners, and unwarmed shapes in strict mode. Each
+        continuous batching: streams, prefix rows, deadlined rows,
+        mixed greedy/sampled draft batches, long prompts, over-cap
+        budgets, staged joiners, and unwarmed shapes in strict mode. Each
         row's stream stays byte-identical to its solo run (per-row
         fold_in streams), so which path served a batch is invisible.
         """
@@ -190,7 +197,11 @@ class FusedSinglePath:
             with eng._alock:
                 if eng._admit or eng._deferred:
                     return False
-        if any(r.stream or r.cancelled or r.prefix_len for r in reqs):
+        if any(
+            r.stream or r.cancelled or r.prefix_len
+            or r.deadline is not None
+            for r in reqs
+        ):
             return False
         bucket = max(len(r.row) for r in reqs)
         if bucket > eng.prompt_buckets[-1]:

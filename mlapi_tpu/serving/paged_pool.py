@@ -46,6 +46,7 @@ import threading
 
 import numpy as np
 
+from mlapi_tpu.serving import faults
 from mlapi_tpu.utils.logging import get_logger
 
 _log = get_logger("serving.paged_pool")
@@ -123,6 +124,19 @@ class PagePool:
         still short → :class:`PagePoolExhausted`."""
         if n == 0:
             return np.zeros((0,), np.int32)
+        # Injection point: armed tests force exhaustion (or a slow
+        # allocator) at exactly this seam, BEFORE any free-list state
+        # changes — the pool stays consistent and callers exercise
+        # their real PagePoolExhausted handling. The armed guard keeps
+        # the exception construction off the disarmed hot path.
+        if faults.armed:
+            faults.fire(
+                "pool_alloc",
+                exc=PagePoolExhausted(
+                    f"KV page pool exhausted (injected fault): "
+                    f"need {n} pages"
+                ),
+            )
         with self.lock:
             while len(self._free) < n and self._evict_one_locked():
                 pass
@@ -162,6 +176,18 @@ class PagePool:
             len(pages),
         )
         return True
+
+    def evict_idle(self, n: int = 1) -> int:
+        """Brownout lever: proactively drop up to ``n`` idle
+        (unreferenced, LRU-first) prefix-entry page sets so live
+        sequences keep allocating under pressure instead of slamming
+        into :class:`PagePoolExhausted`. Same eviction ``alloc`` runs
+        reactively; returns how many sets were dropped."""
+        dropped = 0
+        with self.lock:
+            while dropped < n and self._evict_one_locked():
+                dropped += 1
+        return dropped
 
     def retain(self, pages) -> None:
         """One more holder of each page (a row sharing prefix

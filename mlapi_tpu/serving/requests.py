@@ -17,6 +17,38 @@ import collections
 import threading
 import time
 
+from mlapi_tpu.serving import faults
+
+
+class DeadlineExceeded(Exception):
+    """A request's wall-clock deadline passed before its generation
+    finished: delivered IN-BAND as the stream's terminal error frame
+    (NDJSON ``{"error": ..., "code": "deadline_exceeded"}``) and
+    mapped to 504 on unary paths. ``stage`` records which dispatch
+    boundary noticed — ``queued`` (never dispatched), ``prefill``
+    (mid prompt ingestion), or ``decode`` — the same split the
+    ``deadline_expired_{stage}`` counters export."""
+
+    code = "deadline_exceeded"
+
+    def __init__(self, stage: str, budget_ms: float | None = None):
+        extra = (
+            f" (budget {budget_ms:.0f} ms)" if budget_ms is not None else ""
+        )
+        super().__init__(f"deadline exceeded while {stage}{extra}")
+        self.stage = stage
+
+
+class DrainCancelled(Exception):
+    """The server's drain budget ran out with this stream still in
+    flight: a proper terminal frame (503-mapped — the client should
+    retry against a live replica), not a dropped connection."""
+
+    code = "draining"
+
+    def __init__(self):
+        super().__init__("server draining: generation cancelled")
+
 
 class LatencyStats:
     """Bounded reservoir of per-request latency samples, recorded at
@@ -43,15 +75,21 @@ class LatencyStats:
 
     @staticmethod
     def _q(xs: list, q: float) -> float | None:
+        """Quantile pick; ``xs`` must already be sorted."""
         if not xs:
             return None
-        xs = sorted(xs)
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
     def summary(self) -> dict:
-        """p50/p95 of both series (ms; ``None`` until samples exist)."""
+        """p50/p95 of both series (ms; ``None`` until samples exist).
+        Each reservoir is sorted ONCE per call — this sits on the
+        admission-estimate path of every deadlined submit, where a
+        per-quantile re-sort of 2048 samples would be the dominant
+        cost."""
         with self._lock:
             t, i = list(self._ttft_ms), list(self._itl_ms)
+        t.sort()
+        i.sort()
         r = lambda v: None if v is None else round(v, 2)  # noqa: E731
         return {
             "ttft_p50_ms": r(self._q(t, 0.50)),
@@ -84,12 +122,13 @@ class GenRequest:
         "row", "used", "n_new", "temperature", "seed", "queue", "loop",
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
-        "prompt_tokens", "stats", "t0", "t_last",
+        "prompt_tokens", "stats", "t0", "t_last", "deadline",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
                  top_k=0, top_p=1.0, prefix=None, stream=False,
-                 stats: LatencyStats | None = None):
+                 stats: LatencyStats | None = None,
+                 deadline_ms: float | None = None):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -129,9 +168,19 @@ class GenRequest:
         self.stats = stats
         self.t0 = time.perf_counter()
         self.t_last: float | None = None
+        # Absolute expiry on the ``t0`` clock (``perf_counter``):
+        # every dispatch boundary the scheduler owns checks it via
+        # ``engine._expire_if_due`` and cancels the row exactly like a
+        # client disconnect, after pushing the terminal
+        # :class:`DeadlineExceeded` frame. ``None`` = no deadline —
+        # the pre-deadline behavior, bit for bit.
+        self.deadline = (
+            self.t0 + deadline_ms / 1e3 if deadline_ms else None
+        )
 
     def push(self, item) -> None:
         """Thread-safe enqueue from the decode thread."""
+        faults.fire("stream_push")
         _record_push(self, item)
         self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
 
@@ -170,13 +219,20 @@ class _SyncSink:
         self.prefix_len, self.prefix_lo = req.prefix_len, req.prefix_lo
         self.stream = req.stream
         self.stats, self.t0, self.t_last = req.stats, req.t0, None
+        self.deadline = req.deadline
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
 
     def push(self, item) -> None:
+        faults.fire("stream_push")
         _record_push(self, item)
         if isinstance(item, Exception):
             self.error = item
         elif item is not None:
             self._out.extend(item["token_ids"])
+
+    def cancel(self) -> None:
+        """Parity with GenRequest: deadline expiry / drain cancel the
+        sink the same way (the decode loop stops scheduling it)."""
+        self.cancelled = True

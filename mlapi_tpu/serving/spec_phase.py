@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mlapi_tpu.serving import faults
+
 _log = logging.getLogger(__name__)
 
 
@@ -130,6 +132,8 @@ class SpecPhase:
         d_upto = t_upto = pos
         d_pend = [int(tok[0])]
         while not r.cancelled and produced[0] < r.n_new:
+            if eng._expire_if_due(r, "decode"):
+                break  # round boundary = a deadline dispatch boundary
             if eng._spec_should_yield():
                 break  # joiners waiting: normal loop admits them
             budget = r.n_new - produced[0]
@@ -154,6 +158,7 @@ class SpecPhase:
             )
             d_upto += len(d_pend) + k - 1
             usable = min(k, budget - 1)
+            faults.fire("spec_verify")
             if sampled:
                 cache, packed = sample_verify_fn(eng.model, k + 1)(
                     eng.params, cache, jnp.int32(int(tok[0])), props,
@@ -257,6 +262,11 @@ class SpecPhase:
         while True:
             if eng._spec_should_yield():
                 break  # joiners waiting: realign and hand off
+            for i in range(b):
+                if not done[i]:
+                    # Round boundary = dispatch boundary: expired rows
+                    # cancel (terminal frame pushed) and freeze below.
+                    eng._expire_if_due(reqs[i], "decode")
             active = [
                 i for i in range(b)
                 if not done[i] and not reqs[i].cancelled
@@ -300,6 +310,7 @@ class SpecPhase:
                 [np.asarray(tok[:b_cur], np.int32)[:, None], props],
                 axis=1,
             )
+            faults.fire("spec_verify")
             cache, expect = verify_fn(eng.model, k + 1)(
                 eng.params, cache, jnp.asarray(block),
                 jnp.asarray(t_upto.astype(np.int32)), npj,

@@ -90,6 +90,20 @@ async def test_predict_missing_field_422(client):
     assert any("petal_width" in str(item.get("loc", "")) for item in detail)
 
 
+async def test_predict_nonpositive_deadline_422(client):
+    # Same contract as /generate: 0 would silently mean "no deadline"
+    # and a negative budget would burn a queue slot just to 504.
+    for bad_ms in (0, -5):
+        r = await client.post(
+            "/predict", json={**SETOSA, "deadline_ms": bad_ms}
+        )
+        assert r.status_code == 422, r.text
+        detail = r.json()["detail"]
+        assert any(
+            "deadline_ms" in str(item.get("loc", "")) for item in detail
+        )
+
+
 async def test_predict_non_numeric_422(client):
     r = await client.post("/predict", json={**SETOSA, "sepal_length": "wide"})
     assert r.status_code == 422
