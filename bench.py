@@ -876,6 +876,139 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _tier_report(ck: str, env: dict) -> dict:
+    """Subprocess: hierarchical KV tier evict/restore round trip on
+    the SAME checkpoint (BENCH_GEN_TIER=1). Claim classes per the
+    variance rule:
+
+    - **Spill/restore bytes — exact arithmetic, asserted.** A spilled
+      prefix page set costs exactly ``num_pages x kv_page_bytes`` in
+      its STORED format (``ops/quant`` closed form; int8 KV halves
+      the blob vs bf16 at 2D/(D+4)) — asserted for both cache
+      formats, never wall-clock. Greedy streams asserted
+      token-identical across {evict -> restore} vs {never evicted},
+      in-subprocess, with ``PrefixCache.builds`` pinning ZERO prefill
+      FLOPs on the restore path.
+    - **Restore-hit vs cold-prefill TTFT — measured, ratio only.**
+      The same prefix re-arrival served from the tier vs from a cold
+      prefill, alternated inside ONE window (restore replaces the
+      prefill's O(P^2) attention with a host->device copy, so the gap
+      widens with prefix length; on this CPU box it is reported as a
+      ratio, not an absolute).
+    """
+    src = f"""
+import asyncio, dataclasses, json, time
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_page_bytes
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+PAGE = 16
+params, meta = load_checkpoint({ck!r})
+base = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+report = {{}}
+pre = "the quick brown fox jumps over the lazy dog. " * 2
+sfx = "hello"
+
+def engine(model):
+    return TextGenerationEngine(
+        model, params, tokenizer=tok, chunk=8, fused_single=False,
+        kv_page_size=PAGE, kv_tier_bytes=64 << 20,
+    )
+
+# --- spill/restore bytes: exact closed form, both formats ------------
+for fmt in ("none", "int8"):
+    model = (
+        dataclasses.replace(base, kv_quant=fmt) if fmt != "none"
+        else base
+    )
+    eng = engine(model)
+    tier = eng.kv_tier
+    ref = eng.generate_text(sfx, max_new_tokens=8, prefix=pre)
+    n_pages = len(eng.pool.entry_pages(pre))
+    blob = n_pages * kv_page_bytes(model, PAGE)
+    assert eng.pool.evict_idle(1) == 1
+    assert tier.spill_count == 1 and tier.spill_bytes == blob, (
+        tier.spill_bytes, blob)
+    out = eng.generate_text(sfx, max_new_tokens=8, prefix=pre)
+    assert out["token_ids"] == ref["token_ids"]
+    assert tier.restore_hits == 1 and tier.restore_bytes == blob
+    assert eng.prefix.builds == 1  # restore ran zero prefill FLOPs
+    report[f"tier_blob_bytes_{{fmt}}"] = blob
+report["tier_spill_ratio_none_over_int8"] = round(
+    report["tier_blob_bytes_none"] / report["tier_blob_bytes_int8"], 3
+)
+report["tier_bytes_asserted"] = True
+
+# --- restore-hit vs cold-prefill TTFT, one window --------------------
+eng = engine(base)
+ref = eng.generate_text(sfx, max_new_tokens=8, prefix=pre)["token_ids"]
+
+async def one(mode):
+    if mode == "restore":
+        assert eng.pool.evict_idle(1) == 1      # spilled: tier serves
+    else:
+        with eng.prefix._lock:                  # pre-tier cold path
+            eng.prefix._entries.pop(pre, None)
+        eng.pool.drop_entry(pre)
+        eng.kv_tier.drop(pre)
+    t0 = time.perf_counter()
+    r = await eng.submit(sfx, max_new_tokens=8, prefix=pre)
+    first = await r.queue.get()
+    if isinstance(first, Exception):
+        raise first
+    t = (time.perf_counter() - t0) * 1e3
+    out = list(first["token_ids"])
+    while True:
+        item = await r.queue.get()
+        if item is None:
+            break
+        if isinstance(item, Exception):
+            raise item
+        out.extend(item["token_ids"])
+    return t, out
+
+async def measure():
+    await eng.start()
+    try:
+        for mode in ("restore", "cold"):        # compile, off clock
+            _, out = await one(mode)
+            assert out == ref, mode
+        ts = {{"restore": [], "cold": []}}
+        for _ in range(4):                       # alternated: one window
+            for mode in ("restore", "cold"):
+                t, out = await one(mode)
+                assert out == ref, mode
+                ts[mode].append(t)
+        return ts
+    finally:
+        await eng.stop()
+
+ts = asyncio.run(measure())
+q50 = lambda xs: round(sorted(xs)[len(xs) // 2], 1)
+report["tier_restore_ttft_p50_ms"] = q50(ts["restore"])
+report["tier_cold_prefill_ttft_p50_ms"] = q50(ts["cold"])
+report["tier_restore_hits"] = eng.kv_tier.restore_hits
+report["tier_streams_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"tier_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_generate() -> None:
     """/generate throughput: single-stream vs concurrency-8 batched
     decode through the full HTTP stack (r1 criterion: batched decode
@@ -915,6 +1048,16 @@ def bench_generate() -> None:
         # from the paged allocator; the capacity-model block rides in
         # via _paged_report below.
         srv_args += ["--kv-page-size", "16"]
+    kv_tier_on = os.environ.get("BENCH_GEN_TIER") == "1"
+    if kv_tier_on:
+        # The measured server runs with the host tier armed (paged,
+        # since the spill seam lives under the page pool): the
+        # headline numbers prove the tier costs nothing when idle,
+        # and the evict/restore round trip itself is asserted in the
+        # _tier_report subprocess.
+        if not kv_paged:
+            srv_args += ["--kv-page-size", "16"]
+        srv_args += ["--kv-tier-bytes", str(64 << 20)]
     server, health, fb_note = _start_with_cpu_fallback(
         workdir, server_env, startup_timeout, args=srv_args
     )
@@ -995,7 +1138,9 @@ def bench_generate() -> None:
             pool_g = {
                 k.removeprefix("generate."): v
                 for k, v in after.get("gauges", {}).items()
-                if k.startswith("generate.kv_page")
+                if k.startswith(
+                    ("generate.kv_page", "generate.kv_tier_")
+                )
             }
             # Robustness block (r12): the shed/deadline/brownout/fault
             # counters under this load — all zero on a healthy
@@ -1007,6 +1152,9 @@ def bench_generate() -> None:
                 if k.startswith((
                     "generate.shed_", "generate.deadline_expired_",
                     "generate.brownout_", "generate.faults_injected",
+                    "generate.kv_prefix_restore_",
+                    "generate.kv_prefix_spill_",
+                    "generate.kv_tier_", "generate.kv_entry_",
                 ))
             })
             pool_g["draining"] = after.get("gauges", {}).get(
@@ -1051,6 +1199,13 @@ def bench_generate() -> None:
             # interleaved-vs-not, alternated inside one window, with
             # the one-chunk stall bound asserted from counters.
             kv_extras.update(_prefill_report(ck, server_env))
+        if kv_tier_on:
+            # Hierarchical KV tier: evict/restore round trip with
+            # streams asserted token-identical in-subprocess, blob
+            # bytes asserted from the kv_page_bytes closed form for
+            # both cache formats, restore-hit vs cold-prefill TTFT
+            # alternated in one window.
+            kv_extras.update(_tier_report(ck, server_env))
         prefix_extras = {}
         if os.environ.get("BENCH_GEN_PREFIX") == "1":
             # Prefix-caching TTFT: the same effective prompt served

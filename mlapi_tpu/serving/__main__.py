@@ -159,6 +159,17 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         cmd += ["--kv-page-size", str(args.kv_page_size)]
     if getattr(args, "kv_pages", None):
         cmd += ["--kv-pages", str(args.kv_pages)]
+    if getattr(args, "kv_tier_bytes", 0):
+        cmd += ["--kv-tier-bytes", str(args.kv_tier_bytes)]
+    if getattr(args, "kv_tier_disk_dir", None):
+        # Workers may share one dir: blob filenames are pid-scoped,
+        # each worker indexes only its own files (the bytes budget is
+        # per-process), and the startup sweep only unlinks files
+        # whose owner pid is dead. Forwarded independently of the
+        # bytes flag so a mis-paired config fails in the worker
+        # exactly as it would single-process (main() also rejects it
+        # before supervising).
+        cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
     if not getattr(args, "prefill_page_native", True):
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
@@ -315,6 +326,29 @@ def main(argv=None) -> None:
              "generate.kv_page_utilization on /metrics",
     )
     parser.add_argument(
+        "--kv-tier-bytes", type=int, default=0,
+        help="hierarchical KV tier: keep up to this many bytes of "
+             "EVICTED prefix KV page sets in host RAM (LRU), in their "
+             "stored format (--kv-quant int8 halves the spill "
+             "bandwidth) — a re-arrival restores by device_put with "
+             "zero prefill FLOPs instead of paying a cold prefill; "
+             "streams are pinned token-identical across evict+restore "
+             "vs never-evicted. Multiplies the effective prefix "
+             "budget by the host-RAM/HBM ratio. 0 (default) disables "
+             "the tier: evictions discard as before. Watch "
+             "generate.kv_prefix_restore_hits / kv_tier_bytes_in_use "
+             "on /metrics. Generative checkpoints only",
+    )
+    parser.add_argument(
+        "--kv-tier-disk-dir", default=None,
+        help="with --kv-tier-bytes: back the tier's blob payloads "
+             "with .npz files under this directory (only the index "
+             "stays in RAM; the bytes budget then bounds disk use). "
+             "Files are per-process and inert across restarts — a "
+             "stale blob that no longer matches the live pool "
+             "geometry is dropped, never restored wrong",
+    )
+    parser.add_argument(
         "--prefill-page-native", action=argparse.BooleanOptionalAction,
         default=True,
         help="with --kv-page-size: prefill writes K/V straight into "
@@ -424,6 +458,11 @@ def main(argv=None) -> None:
 
     if not args.checkpoint and not args.demo_iris:
         parser.error("need --checkpoint or --demo-iris")
+    if args.kv_tier_disk_dir and not args.kv_tier_bytes:
+        # Validate BEFORE the --workers supervisor forks: the same
+        # mis-pair must be equally loud in both modes (the engine
+        # would reject it anyway, but only inside each worker).
+        parser.error("--kv-tier-disk-dir requires --kv-tier-bytes > 0")
     ckpt = args.checkpoint or _demo_iris_checkpoint()
 
     import os
@@ -489,6 +528,8 @@ def main(argv=None) -> None:
         kv_pages=args.kv_pages,
         prefill_page_native=args.prefill_page_native,
         prefill_interleave=args.prefill_interleave,
+        kv_tier_bytes=args.kv_tier_bytes,
+        kv_tier_disk_dir=args.kv_tier_disk_dir,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         mesh=mesh,

@@ -822,6 +822,51 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
                 snap["gauges"]["generate.kv_page_bytes"] = (
                     engine.kv_page_bytes()
                 )
+                # Prefix-entry page-set evictions under pool pressure
+                # (alloc-pressure + brownout evict_idle): with the
+                # host tier attached these are routine, recoverable
+                # spills, so the per-event log dropped to debug and
+                # THIS counter is the observable.
+                snap["counters"]["generate.kv_entry_evictions"] = (
+                    engine.pool.entry_evictions
+                )
+            if getattr(engine, "kv_tier", None) is not None:
+                # Hierarchical KV tier (r13): spill/restore traffic
+                # and the tier's occupancy. All byte counters are the
+                # kv_tree_bytes closed form per blob (exact dtype/
+                # shape arithmetic), never wall-clock — restore_hits
+                # moving while prefix builds stay flat IS the
+                # saved-prefill claim.
+                snap["counters"]["generate.kv_prefix_restore_hits"] = (
+                    engine.kv_prefix_restore_hits
+                )
+                snap["counters"]["generate.kv_prefix_restore_misses"] = (
+                    engine.kv_prefix_restore_misses
+                )
+                snap["counters"]["generate.kv_prefix_restore_bytes"] = (
+                    engine.kv_prefix_restore_bytes
+                )
+                snap["counters"][
+                    "generate.kv_prefix_restore_failures"
+                ] = engine.kv_prefix_restore_failures
+                snap["counters"]["generate.kv_prefix_spill_count"] = (
+                    engine.kv_prefix_spill_count
+                )
+                snap["counters"]["generate.kv_prefix_spill_bytes"] = (
+                    engine.kv_prefix_spill_bytes
+                )
+                snap["counters"]["generate.kv_prefix_spill_failures"] = (
+                    engine.kv_prefix_spill_failures
+                )
+                snap["counters"]["generate.kv_tier_evictions"] = (
+                    engine.kv_tier_evictions
+                )
+                snap["gauges"]["generate.kv_tier_bytes_in_use"] = (
+                    engine.kv_tier_bytes_in_use
+                )
+                snap["gauges"]["generate.kv_tier_entries"] = (
+                    engine.kv_tier_entries
+                )
         return snap
 
     return app

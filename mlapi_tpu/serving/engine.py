@@ -146,6 +146,8 @@ class InferenceEngine:
         kv_pages: int | None = None,
         prefill_page_native: bool = True,
         prefill_interleave: bool = True,
+        kv_tier_bytes: int = 0,
+        kv_tier_disk_dir: str | None = None,
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -196,6 +198,15 @@ class InferenceEngine:
         of cache gathers. Token streams are pinned identical to the
         contiguous layout across both ``kv_quant`` formats and both
         decode impls (DESIGN §15). Generative checkpoints only.
+
+        ``kv_tier_bytes=N`` enables the hierarchical host-RAM KV tier
+        (``serving/kv_tier.py``): evicted prefix KV page sets spill to
+        host memory (optionally ``kv_tier_disk_dir``-backed files) in
+        their stored format instead of being discarded, and re-arrivals
+        restore by ``device_put`` with zero prefill FLOPs — greedy
+        streams are pinned token-identical across {evict → restore} vs
+        {never evicted} (DESIGN §19). 0 (default) keeps the r12
+        discard behavior bit for bit. Generative checkpoints only.
         """
         import dataclasses
 
@@ -335,6 +346,8 @@ class InferenceEngine:
                 kv_pages=kv_pages,
                 prefill_page_native=prefill_page_native,
                 prefill_interleave=prefill_interleave,
+                kv_tier_bytes=kv_tier_bytes,
+                kv_tier_disk_dir=kv_tier_disk_dir,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
@@ -342,6 +355,8 @@ class InferenceEngine:
                          if decode_attn_impl else {}),
                       **({"kv_page_size": kv_page_size}
                          if kv_page_size else {}),
+                      **({"kv_tier_bytes": kv_tier_bytes}
+                         if kv_tier_bytes else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
@@ -350,6 +365,12 @@ class InferenceEngine:
             raise ValueError(
                 "kv_page_size/kv_pages apply to generative checkpoints "
                 f"(they hold KV caches); {type(inner).__name__} has none"
+            )
+        if kv_tier_bytes or kv_tier_disk_dir:
+            raise ValueError(
+                "kv_tier_bytes/kv_tier_disk_dir apply to generative "
+                f"checkpoints (they cache prefix KV); "
+                f"{type(inner).__name__} has none"
             )
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
@@ -577,6 +598,8 @@ class TextGenerationEngine:
         kv_pages: int | None = None,
         prefill_page_native: bool = True,
         prefill_interleave: bool = True,
+        kv_tier_bytes: int = 0,
+        kv_tier_disk_dir: str | None = None,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -747,6 +770,28 @@ class TextGenerationEngine:
                 model, page_size=int(kv_page_size),
                 num_pages=int(kv_pages),
             )
+        # Hierarchical KV tier (r13, serving/kv_tier.py): a host-RAM
+        # (optionally disk-backed) LRU store of evicted prefix page
+        # sets, multiplying the effective prefix budget by the
+        # host-RAM/HBM ratio. 0 = off (the default): evictions discard
+        # exactly as before — streams and counters bit-identical to
+        # r12. Attached to the pool (spill seam) and consulted by the
+        # PrefixCache (restore seams).
+        self.kv_tier = None
+        if kv_tier_disk_dir and not kv_tier_bytes:
+            raise ValueError(
+                "kv_tier_disk_dir requires kv_tier_bytes > 0 (the "
+                "bytes budget enables the tier; a silently-ignored "
+                "disk dir would store nothing)"
+            )
+        if kv_tier_bytes:
+            from mlapi_tpu.serving.kv_tier import KVTier
+
+            self.kv_tier = KVTier(
+                int(kv_tier_bytes), disk_dir=kv_tier_disk_dir
+            )
+            if self.pool is not None:
+                self.pool.tier = self.kv_tier
         # Page-native prefill (r10): bucket prefill and admission write
         # K/V straight into pool pages through the page table — the
         # contiguous-then-adopt copy (one full extra write of
@@ -1235,6 +1280,52 @@ class TextGenerationEngine:
         disarmed) — state lives in ``serving/faults.py``."""
         return faults.injected_count()
 
+    # -- host-tier accounting (state lives in serving/kv_tier.py) ---------
+    # All byte counters are exact dtype/shape arithmetic (the
+    # ``ops/quant.kv_tree_bytes`` closed form applied per blob), never
+    # wall-clock; every gauge reads 0 with the tier disabled.
+    @property
+    def kv_prefix_restore_hits(self) -> int:
+        """Blob applications: entry rebuilds + pool-page restores —
+        each one a prefill (or adopt) the tier made unnecessary."""
+        return self.kv_tier.restore_hits if self.kv_tier else 0
+
+    @property
+    def kv_prefix_restore_misses(self) -> int:
+        return self.kv_tier.restore_misses if self.kv_tier else 0
+
+    @property
+    def kv_prefix_restore_bytes(self) -> int:
+        return self.kv_tier.restore_bytes if self.kv_tier else 0
+
+    @property
+    def kv_prefix_restore_failures(self) -> int:
+        return self.kv_tier.restore_failures if self.kv_tier else 0
+
+    @property
+    def kv_prefix_spill_count(self) -> int:
+        return self.kv_tier.spill_count if self.kv_tier else 0
+
+    @property
+    def kv_prefix_spill_bytes(self) -> int:
+        return self.kv_tier.spill_bytes if self.kv_tier else 0
+
+    @property
+    def kv_prefix_spill_failures(self) -> int:
+        return self.kv_tier.spill_failures if self.kv_tier else 0
+
+    @property
+    def kv_tier_bytes_in_use(self) -> int:
+        return self.kv_tier.bytes_in_use if self.kv_tier else 0
+
+    @property
+    def kv_tier_entries(self) -> int:
+        return self.kv_tier.entries if self.kv_tier else 0
+
+    @property
+    def kv_tier_evictions(self) -> int:
+        return self.kv_tier.evictions if self.kv_tier else 0
+
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property
     def prefix_hits(self) -> int:
@@ -1703,7 +1794,10 @@ class TextGenerationEngine:
         if level >= 2 and self.pool is not None:
             # Brownout lever 3: proactively evict an idle (LRU,
             # unreferenced) prefix page set so live sequences keep
-            # allocating instead of hitting PagePoolExhausted.
+            # allocating instead of hitting PagePoolExhausted. With
+            # the host tier attached the eviction SPILLS instead of
+            # discarding (PagePool._spill_and_release), so the brownout
+            # trades HBM for host RAM, not for a future re-prefill.
             self.pool.evict_idle(1)
         if (
             self.admission_control

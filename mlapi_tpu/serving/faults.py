@@ -54,6 +54,8 @@ POINTS = (
     "spec_verify",     # each speculative verify block (solo + batched)
     "collector_pop",   # the collector claiming a queued request
     "stream_push",     # a token chunk entering a request's queue
+    "tier_spill",      # KV tier: registering an evicted prefix blob
+    "tier_restore",    # KV tier: applying a blob back to device
 )
 
 ENV_VAR = "MLAPI_FAULTS"

@@ -1,0 +1,381 @@
+"""Host-RAM (optionally disk-backed) spill tier under the KV page pool.
+
+The r09 page pool evicts unreferenced prefix page sets LRU-first and
+used to DISCARD them — every re-arrival of a popular prefix then paid
+a full prefill. This module is the hierarchical-memory move under that
+eviction: the victim's pages are gathered to host as numpy blobs in
+their STORED format (int8 payload + scales, or bf16/f32 — whatever
+the cache format already is, so int8 KV halves the spill bandwidth
+for free) and kept under an LRU bytes budget. A later miss restores
+by ``device_put`` into freshly allocated pages — zero prefill FLOPs,
+byte-identical to the original adopt.
+
+Wired at exactly two seams, both outside this file:
+
+- **Spill** — ``PagePool._spill_and_release`` gathers the victim
+  entry's pool rows via its page set and registers the blob here
+  BEFORE freeing the pages (plus the same hook from
+  ``PrefixCache.entry``'s own LRU eviction, which spills from the
+  entry's contiguous KV — the identical bytes — because registration
+  threads must never read pool arrays the decode thread may have
+  donated).
+- **Restore** — ``PrefixCache.entry`` / ``paged_entry`` consult the
+  tier on a device-cache miss; a hit rebuilds the entry / repopulates
+  pool pages with ref-count/COW semantics unchanged on-device.
+
+Everything here is host metadata + numpy under one lock; no jax
+arrays are held (a blob pins host RAM or disk, never HBM). Byte
+accounting is exact dtype/shape arithmetic (``ops/quant
+.kv_tree_bytes`` closed form: a spilled set costs
+``num_pages x kv_page_bytes``), never wall-clock.
+
+Disk mode (``disk_dir``): blob payloads live as ``.npz`` files and
+only the index stays in RAM; the LRU bytes budget then bounds disk
+use. The index is per-process — files from a previous run are inert
+(restores validate shapes/page size against the live pool and treat
+any mismatch as a miss).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+import numpy as np
+
+from mlapi_tpu.serving import faults
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.kv_tier")
+
+
+class KVTierBlob:
+    """One spilled prefix page set, fully host-resident: the per-layer
+    ``{leaf: [num_pages, page, ...]}`` numpy payload in the cache's
+    stored format, plus the entry metadata needed to rebuild a
+    :class:`_PrefixEntry` without a prefill (``bucket``/``lo``/``used``
+    may be ``None`` if the entry was never registered — pool-page
+    restore still works; entry rebuild treats that as a miss)."""
+
+    __slots__ = (
+        "fp", "payload", "page", "num_pages", "nbytes",
+        "bucket", "lo", "used",
+    )
+
+    def __init__(self, fp, payload, page, nbytes, bucket, lo, used):
+        self.fp = fp
+        self.payload = payload
+        self.page = int(page)
+        first = next(iter(next(iter(payload.values())).values()))
+        self.num_pages = int(first.shape[0])
+        self.nbytes = int(nbytes)
+        self.bucket = bucket
+        self.lo = lo
+        self.used = used
+
+
+class _Stored:
+    """Index record: payload in RAM or a path on disk, plus the
+    metadata that survives either way."""
+
+    __slots__ = ("payload", "path", "page", "nbytes",
+                 "bucket", "lo", "used")
+
+    def __init__(self, payload, path, page, nbytes, bucket, lo, used):
+        self.payload = payload      # None when disk-backed
+        self.path = path            # None when RAM-resident
+        self.page = page
+        self.nbytes = nbytes
+        self.bucket = bucket
+        self.lo = lo
+        self.used = used
+
+
+def payload_bytes(payload: dict) -> int:
+    """Exact blob bytes from dtype/shape arithmetic — the same closed
+    form as ``ops/quant.kv_tree_bytes`` applied to the numpy tree (an
+    ``n``-page set costs exactly ``n x kv_page_bytes(model, page)``)."""
+    return sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for layer in payload.values()
+        for a in layer.values()
+    )
+
+
+def payload_from_contiguous(kv, page: int) -> dict:
+    """A contiguous ``[1, P]`` cache pytree (a prefix entry's KV, on
+    device) → the page-shaped ``[ceil(P/page), page, ...]`` numpy
+    payload, zero-padded past ``P``. Byte-identical to gathering the
+    entry's adopted pool rows for every slot ``< P`` (the adopt
+    scatter wrote exactly these values; slots past ``P`` are never
+    read) — and safe from ANY thread, because the entry's contiguous
+    KV is never donated."""
+    out: dict = {}
+    for ln, layer in kv.items():
+        out[ln] = {}
+        for name, leaf in layer.items():
+            a = np.asarray(leaf)            # [1, P, ...] device_get
+            p = a.shape[1]
+            n = -(-p // page)
+            if n * page != p:
+                pad = np.zeros(
+                    (1, n * page - p) + a.shape[2:], a.dtype
+                )
+                a = np.concatenate([a, pad], axis=1)
+            out[ln][name] = np.ascontiguousarray(
+                a.reshape((n, page) + a.shape[2:])
+            )
+    return out
+
+
+class KVTier:
+    """LRU bytes-budgeted store of spilled prefix page sets, keyed by
+    prefix fingerprint. Thread-safe: registration threads (entry
+    build/restore, dict-LRU spill) and the decode thread (pool spill,
+    page restore) mutate it concurrently."""
+
+    def __init__(self, max_bytes: int, disk_dir: str | None = None):
+        if max_bytes <= 0:
+            raise ValueError(
+                f"kv_tier_bytes must be > 0 to enable the tier, got "
+                f"{max_bytes}"
+            )
+        self.max_bytes = int(max_bytes)
+        self.disk_dir = disk_dir
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+            self._sweep_stale(disk_dir)
+        self._lock = threading.Lock()
+        # fp -> _Stored, LRU-ordered (front = coldest).
+        self._blobs: collections.OrderedDict = collections.OrderedDict()
+        self._bytes = 0
+        self._seq = 0
+        # Entry metadata noted by the PrefixCache at build/restore time
+        # (the pool knows page ids, not buckets); bounded LRU — metas
+        # are a few ints each, the cap only guards unbounded churn.
+        self._meta: collections.OrderedDict = collections.OrderedDict()
+        self._meta_cap = 4096
+        # Counters (exported via the engine's /metrics block; bytes
+        # are the exact closed form, never wall-clock).
+        self.spill_count = 0
+        self.spill_bytes = 0
+        self.spill_failures = 0
+        self.restore_hits = 0
+        self.restore_misses = 0
+        self.restore_bytes = 0
+        self.restore_failures = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _sweep_stale(disk_dir: str) -> None:
+        """Unlink blob files left by DEAD former owners. Filenames are
+        pid-scoped and the index is per-process, so files from a
+        previous run are unreachable — without this sweep a restart
+        loop would accumulate up to one full bytes budget of dead
+        files per run. A file whose owner pid is still alive (a
+        sibling ``--workers`` process sharing the dir) is left
+        alone; so is anything this process cannot signal (EPERM: not
+        ours to judge) or cannot parse (not ours at all)."""
+        for name in os.listdir(disk_dir):
+            if not (name.startswith("kvtier-") and name.endswith(".npz")):
+                continue
+            try:
+                pid = int(name.split("-")[1])
+            except (IndexError, ValueError):
+                continue
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join(disk_dir, name))
+                    _log.debug("swept stale tier blob %s", name)
+                except OSError:
+                    pass
+            except OSError:
+                pass  # EPERM etc.: a live process we can't signal
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+    # -- entry metadata ------------------------------------------------
+    def note_meta(self, fp, *, bucket: int, lo: int, used: int) -> None:
+        """Record the entry-rebuild metadata for ``fp`` (called by the
+        PrefixCache whenever it creates or restores an entry — the ONE
+        place that knows bucket/lo/used). Spills attach it so a later
+        ``entry()`` miss can rebuild without a prefill."""
+        with self._lock:
+            self._meta[fp] = (int(bucket), int(lo), int(used))
+            self._meta.move_to_end(fp)
+            while len(self._meta) > self._meta_cap:
+                self._meta.popitem(last=False)
+
+    # -- spill ---------------------------------------------------------
+    def spill(self, fp, payload: dict, page: int) -> int:
+        """Register a spilled page set (replacing any prior blob for
+        ``fp``), evicting LRU blobs past the bytes budget. Returns the
+        blob's exact bytes. The ``tier_spill`` fault point fires FIRST
+        — an injected raise leaves the tier untouched and the caller
+        falls back to the pre-tier discard. Disk mode registers the
+        blob RAM-resident first and moves the payload to its ``.npz``
+        AFTER releasing the lock — the (multi-MB, slow-disk) write
+        must not block concurrent lookups/spills; the transient RAM
+        copy is bounded by one blob and disappears with the swap (a
+        blob replaced or evicted mid-write just unlinks the fresh
+        file)."""
+        faults.fire("tier_spill")
+        nbytes = payload_bytes(payload)
+        with self._lock:
+            meta = self._meta.get(fp)
+            bucket, lo, used = meta if meta else (None, None, None)
+            old = self._blobs.pop(fp, None)
+            if old is not None:
+                self._discard_locked(old)
+            if nbytes > self.max_bytes:
+                # Can't ever fit: count it as an eviction of itself
+                # rather than silently thrashing the whole tier out.
+                self.evictions += 1
+                _log.debug(
+                    "tier blob (%d bytes) exceeds the %d-byte budget; "
+                    "not stored", nbytes, self.max_bytes,
+                )
+                return nbytes
+            path = None
+            if self.disk_dir:
+                path = os.path.join(
+                    self.disk_dir, f"kvtier-{os.getpid()}-{self._seq}.npz"
+                )
+                self._seq += 1
+            stored = _Stored(
+                payload, None, int(page), nbytes, bucket, lo, used
+            )
+            self._blobs[fp] = stored
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._blobs) > 1:
+                _, victim = self._blobs.popitem(last=False)  # LRU
+                self._discard_locked(victim)
+                self.evictions += 1
+            self.spill_count += 1
+            self.spill_bytes += nbytes
+        if path is not None:
+            try:
+                np.savez(
+                    path,
+                    **{
+                        f"{ln}|{name}": a
+                        for ln, layer in payload.items()
+                        for name, a in layer.items()
+                    },
+                )
+            except Exception as e:
+                # Disk refused: the blob simply stays RAM-resident —
+                # still restorable, budget still enforced.
+                _log.debug("tier disk write failed (%s); RAM blob", e)
+                return nbytes
+            with self._lock:
+                live = self._blobs.get(fp)
+                if live is stored and live.payload is payload:
+                    live.path = path
+                    live.payload = None
+                else:
+                    # Replaced or evicted while writing: the file is
+                    # an orphan — drop it, the index never saw it.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+        return nbytes
+
+    def drop(self, fp) -> None:
+        """Forget ``fp``'s blob (no-op if absent): a restore proved it
+        can never apply to the live pool/model (geometry or metadata
+        drift — e.g. a disk blob from a previous run with a different
+        page size), so keeping it would repeat the failed validation
+        on every miss. Distinct from LRU eviction: not counted there
+        (`evictions` measures budget pressure, not invalidation)."""
+        with self._lock:
+            stored = self._blobs.pop(fp, None)
+            if stored is not None:
+                self._discard_locked(stored)
+                _log.debug("dropped inapplicable tier blob for %r", fp)
+
+    def _discard_locked(self, stored: _Stored) -> None:
+        self._bytes -= stored.nbytes
+        if stored.path is not None:
+            try:
+                os.unlink(stored.path)
+            except OSError:
+                pass
+
+    # -- restore -------------------------------------------------------
+    def lookup(self, fp) -> KVTierBlob | None:
+        """The blob for ``fp`` (LRU-touched), payload loaded back to
+        RAM if disk-backed; ``None`` counts a restore miss. The blob
+        stays resident — a restore is a cache READ, so a re-eviction
+        of the restored pages re-spills identical bytes (or cheaply
+        replaces them)."""
+        with self._lock:
+            stored = self._blobs.get(fp)
+            if stored is None:
+                self.restore_misses += 1
+                return None
+            self._blobs.move_to_end(fp)
+            payload = stored.payload
+            path = stored.path
+            page = stored.page
+            nbytes = stored.nbytes
+            bucket, lo, used = stored.bucket, stored.lo, stored.used
+        if payload is None:
+            try:
+                with np.load(path) as z:
+                    payload = {}
+                    for key in z.files:
+                        ln, name = key.split("|", 1)
+                        payload.setdefault(ln, {})[name] = z[key]
+            except Exception as e:
+                # A vanished/corrupt file is a miss, not a crash: drop
+                # the index entry and let the caller go cold — but
+                # only if it is still the record WE read. A concurrent
+                # re-spill of the same fp may have replaced it (and
+                # unlinked our file, which is exactly why the load
+                # failed); the fresh blob must survive.
+                _log.debug("tier disk blob unreadable (%s); dropping", e)
+                with self._lock:
+                    if self._blobs.get(fp) is stored:
+                        self._blobs.pop(fp)
+                        self._discard_locked(stored)
+                    self.restore_misses += 1
+                return None
+        return KVTierBlob(fp, payload, page, nbytes, bucket, lo, used)
+
+    def count_restore(self, blob: KVTierBlob) -> None:
+        """A blob was successfully applied (pool pages repopulated or
+        an entry rebuilt): count the hit and its exact bytes."""
+        with self._lock:
+            self.restore_hits += 1
+            self.restore_bytes += blob.nbytes
+
+    def count_spill_failure(self) -> None:
+        """A spill seam degraded to the pre-tier discard — counted
+        here, under the lock, because spill failures fire from both
+        the decode thread (pool eviction) and registration threads
+        (dict-LRU eviction); an unsynchronized ``+=`` could drop the
+        very increments the fault-matrix degradation story reads."""
+        with self._lock:
+            self.spill_failures += 1
+
+    def count_restore_failure(self) -> None:
+        """A restore seam fell back to the cold path — same locking
+        rationale as :meth:`count_spill_failure`."""
+        with self._lock:
+            self.restore_failures += 1

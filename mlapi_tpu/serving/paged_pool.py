@@ -36,12 +36,18 @@ Invariants:
   (``models/gpt.paged_cow_fn`` + a table rewrite).
 - Exhaustion first evicts prefix-entry page sets nobody currently
   references (LRU), then raises :class:`PagePoolExhausted` — a LOUD
-  reject, never a silent spill.
+  reject. With a :class:`~mlapi_tpu.serving.kv_tier.KVTier` attached
+  (``self.tier``), eviction SPILLS the victim's pages to host before
+  freeing them (gather registered before release, so a fault can
+  never lose both copies) and a later miss restores them by
+  ``device_put`` into fresh pages — see ``serving/kv_tier.py`` and
+  DESIGN §19.
 """
 
 from __future__ import annotations
 
 import collections
+import functools
 import threading
 
 import numpy as np
@@ -58,6 +64,40 @@ class PagePoolExhausted(RuntimeError):
     """No free KV pages (after prefix eviction): the pool is sized too
     small for the offered concurrency — a capacity-planning signal,
     surfaced loudly to every waiter of the batch that hit it."""
+
+
+class PagePoolPoisoned(RuntimeError):
+    """A donated pool program failed DURING execution: the pool
+    arrays were consumed and never rebound, so no fallback path may
+    read them. Surfaced loudly (callers must not swallow this into a
+    cold-path retry — the retry would die on deleted buffers, the
+    r12 formation-poisoning bug class)."""
+
+
+@functools.cache
+def _tier_restore_fn():
+    """Jitted tier-restore scatter: write a host blob's
+    ``[n, page, ...]`` payload rows into pool pages ``pages`` across
+    every layer. The pools are DONATED — the restored arrays replace
+    them in place, exactly like the adopt scatter's donation — so a
+    restore never doubles the pool's HBM footprint. Shape-keyed by
+    jit's own cache (one compile per distinct page count), and safe
+    under mesh-sharded pools: the payload uploads replicated and
+    GSPMD partitions the scatter like any other pool write."""
+    import jax
+
+    def _run(pools, payload, pages):
+        return {
+            ln: {
+                name: leaf.at[pages].set(
+                    payload[ln][name].astype(leaf.dtype)
+                )
+                for name, leaf in layer.items()
+            }
+            for ln, layer in pools.items()
+        }
+
+    return jax.jit(_run, donate_argnums=(0,))
 
 
 class PagePool:
@@ -79,6 +119,14 @@ class PagePool:
         self.layers = make_paged_pools(model, num_pages, page_size)
         self.page_bytes = kv_page_bytes(model, page_size)
         self.lock = threading.Lock()
+        # Eviction runs its spill (device gather + optional disk
+        # write) OUTSIDE the lock; this condition (sharing the lock)
+        # lets a concurrent alloc that finds no free pages AND no
+        # victim wait for an in-flight eviction's release instead of
+        # raising a spurious PagePoolExhausted for capacity that is
+        # moments from free.
+        self._evict_cond = threading.Condition(self.lock)
+        self._evicting = 0
         self.ref = np.zeros((num_pages,), np.int64)
         self.ref[NULL_PAGE] = 1  # pinned forever
         self._free: list[int] = list(range(num_pages - 1, 0, -1))
@@ -92,6 +140,10 @@ class PagePool:
         self.cow_copies = 0
         self.entry_evictions = 0
         self.exhaustions = 0
+        # Host-RAM spill tier (serving/kv_tier.py), attached by the
+        # engine when --kv-tier-bytes > 0. None = the pre-tier
+        # behavior: eviction discards, restore never happens.
+        self.tier = None
 
     # -- accounting (read by /metrics and bench) -----------------------
     @property
@@ -137,28 +189,44 @@ class PagePool:
                     f"need {n} pages"
                 ),
             )
-        with self.lock:
-            while len(self._free) < n and self._evict_one_locked():
-                pass
-            if len(self._free) < n:
-                self.exhaustions += 1
-                raise PagePoolExhausted(
-                    f"KV page pool exhausted: need {n} pages, "
-                    f"{len(self._free)} free of {self.pages_total} "
-                    f"(page={self.page} tokens); raise --kv-pages or "
-                    f"lower concurrency"
-                )
-            out = np.asarray(
-                [self._free.pop() for _ in range(n)], np.int32
-            )
-            self.ref[out] = 1
-            return out
+        while True:
+            with self.lock:
+                if len(self._free) >= n:
+                    out = np.asarray(
+                        [self._free.pop() for _ in range(n)], np.int32
+                    )
+                    self.ref[out] = 1
+                    return out
+                victim = self._pop_victim_locked()
+                if victim is None:
+                    if self._evicting:
+                        # Another thread's eviction is mid-spill: its
+                        # pages free the moment it finishes — wait for
+                        # the release instead of shedding capacity
+                        # that exists.
+                        self._evict_cond.wait(timeout=5.0)
+                        continue
+                    self.exhaustions += 1
+                    raise PagePoolExhausted(
+                        f"KV page pool exhausted: need {n} pages, "
+                        f"{len(self._free)} free of {self.pages_total} "
+                        f"(page={self.page} tokens); raise --kv-pages "
+                        f"or lower concurrency"
+                    )
+                self._evicting += 1
+            # Outside the lock: the victim's pages still carry their
+            # entry references (the set is popped, so no other thread
+            # can find or free them), and the spill's device gather +
+            # optional disk write must not convoy every concurrent
+            # pool operation behind one eviction.
+            self._spill_and_release(*victim)
 
-    def _evict_one_locked(self) -> bool:
-        """Drop the LRU prefix-entry page set whose pages nobody else
-        references (ref == 1 everywhere: only the entry's own hold).
-        The PrefixCache entry itself survives — its contiguous KV
-        re-adopts into fresh pages on next use."""
+    def _pop_victim_locked(self):
+        """Claim (pop) the LRU prefix-entry page set whose pages
+        nobody else references (ref == 1 everywhere: only the entry's
+        own hold) — or ``None``. The pop IS the claim: the pages keep
+        their entry refs until :meth:`_spill_and_release` frees them,
+        invisible to every other thread in between."""
         victim = next(
             (
                 fp for fp, pages in self._entries.items()
@@ -167,26 +235,136 @@ class PagePool:
             None,
         )
         if victim is None:
-            return False
-        pages = self._entries.pop(victim)
-        self._release_locked(pages)
+            return None
+        return victim, self._entries.pop(victim)
+
+    def _spill_and_release(self, fp, pages) -> None:
+        """Spill a claimed victim to the host tier (when attached),
+        then free its pages. Runs OUTSIDE the pool lock (caller
+        bumped ``_evicting`` under it); the spill happens BEFORE the
+        release so the bytes exist somewhere at every instant. A
+        spill failure at any point (including an injected
+        ``tier_spill`` raise, or a gather racing a donated program
+        when brownout's ``evict_idle`` fires from the event loop)
+        leaves the tier untouched and falls back to the pre-tier
+        discard, counted — it can never strand pages or lose the
+        only copy. The PrefixCache entry itself survives either way
+        — its contiguous KV re-adopts into fresh pages on next use.
+        Logged at debug: with the tier this is a routine,
+        recoverable path (the ``entry_evictions`` counter is the
+        observable, exported as ``generate.kv_entry_evictions``)."""
+        try:
+            if self.tier is not None:
+                try:
+                    idx = np.asarray(pages)
+                    payload = {
+                        ln: {
+                            name: np.asarray(leaf[idx])
+                            for name, leaf in layer.items()
+                        }
+                        for ln, layer in self.layers.items()
+                    }
+                    self.tier.spill(fp, payload, self.page)
+                except Exception as e:
+                    self.tier.count_spill_failure()
+                    _log.debug(
+                        "tier spill failed (%s); evicting cold", e
+                    )
+        finally:
+            with self.lock:
+                # Decrement BEFORE the release: if the release ever
+                # raised (a double-release lifecycle bug), waiters
+                # must not spin forever on a phantom in-flight
+                # eviction.
+                self._evicting -= 1
+                self._release_locked(np.asarray(pages))
         self.entry_evictions += 1
-        _log.info(
-            "evicted prefix page set (%d pages) under pool pressure",
+        _log.debug(
+            "evicted prefix page set (%d pages) under pool pressure%s",
             len(pages),
+            " (spilled to host tier)" if self.tier is not None else "",
         )
-        return True
+
+    def restore_entry(self, fp, blob, holds: int = 0):
+        """Repopulate fresh pool pages from a spilled tier blob and
+        register them as ``fp``'s entry page set (with ``holds`` row
+        references, like :meth:`put_entry_pages`). Ordering is the
+        whole point: pages are ALLOCATED first (a
+        :class:`PagePoolExhausted` here propagates with nothing
+        installed and nothing device-written — no half-restored entry
+        can exist), the ``tier_restore`` fault point fires before any
+        device write, the donated scatter rebinds ``self.layers``
+        atomically, and registration is last. Returns the installed
+        page ids, or ``None`` when the blob does not match this
+        pool's geometry (dropped from the tier — it can never apply).
+        Decode-thread only, like every other pool-array touch."""
+        import jax.numpy as jnp
+
+        if blob.page != self.page:
+            self.tier.drop(blob.fp)
+            return None
+        for ln, layer in self.layers.items():
+            pl = blob.payload.get(ln)
+            if pl is None:
+                self.tier.drop(blob.fp)
+                return None
+            for name, leaf in layer.items():
+                a = pl.get(name)
+                if (
+                    a is None
+                    or a.shape[1:] != leaf.shape[1:]
+                    or a.dtype != leaf.dtype
+                ):
+                    self.tier.drop(blob.fp)
+                    return None
+        pages = self.alloc(blob.num_pages)
+        try:
+            faults.fire("tier_restore")
+            self.layers = _tier_restore_fn()(
+                self.layers, blob.payload, jnp.asarray(pages)
+            )
+        except BaseException as e:
+            # Nothing was installed: hand the pages back and let the
+            # caller fall back to the adopt path — ``kv_pages_in_use``
+            # is conserved exactly. UNLESS the donated scatter failed
+            # DURING execution: then the pool buffers are consumed
+            # with no result to rebind, and any fallback that reads
+            # them dies on deleted buffers (the r12 formation-
+            # poisoning bug class) — surface that loudly instead.
+            # The ``tier_restore`` fault point fires BEFORE the call
+            # on purpose, so injected raises always take the safe
+            # branch.
+            self.release(pages)
+            leaf = next(
+                iter(next(iter(self.layers.values())).values())
+            )
+            if getattr(leaf, "is_deleted", lambda: False)():
+                raise PagePoolPoisoned(
+                    "KV pool consumed by a tier restore that failed "
+                    "mid-execution; no fallback may read the pool"
+                ) from e
+            raise
+        self.put_entry_pages(fp, pages, holds=holds)
+        self.tier.count_restore(blob)
+        return pages
 
     def evict_idle(self, n: int = 1) -> int:
         """Brownout lever: proactively drop up to ``n`` idle
         (unreferenced, LRU-first) prefix-entry page sets so live
         sequences keep allocating under pressure instead of slamming
         into :class:`PagePoolExhausted`. Same eviction ``alloc`` runs
-        reactively; returns how many sets were dropped."""
+        reactively (claim under the lock, spill+free outside it);
+        returns how many sets were dropped."""
         dropped = 0
-        with self.lock:
-            while dropped < n and self._evict_one_locked():
-                dropped += 1
+        while dropped < n:
+            with self.lock:
+                victim = self._pop_victim_locked()
+                if victim is not None:
+                    self._evicting += 1
+            if victim is None:
+                break
+            self._spill_and_release(*victim)
+            dropped += 1
         return dropped
 
     def retain(self, pages) -> None:
@@ -220,7 +398,11 @@ class PagePool:
                 "released below zero references"
             )
         freed = np.unique(pages[self.ref[pages] == 0])
-        self._free.extend(int(p) for p in freed)
+        if len(freed):
+            self._free.extend(int(p) for p in freed)
+            # Wake any alloc waiting out an in-flight eviction (the
+            # condition shares self.lock, already held here).
+            self._evict_cond.notify_all()
 
     def is_shared(self, page: int) -> bool:
         with self.lock:

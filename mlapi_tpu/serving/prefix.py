@@ -8,6 +8,20 @@ hit/miss/fallback counters ``/metrics`` exports. Device work (prefill,
 widen, warm grids) runs through the engine's model/params — the cache
 holds a back-reference for those, but every piece of PREFIX STATE
 lives here. Split out of ``engine.py`` (r04 VERDICT "Next" #7).
+
+Host-tier integration (r13, ``serving/kv_tier.py``): when the engine
+carries a :class:`~mlapi_tpu.serving.kv_tier.KVTier`
+(``--kv-tier-bytes``), this cache is BOTH tier seams' client — an
+entry falling off this dict's own LRU spills its contiguous KV to the
+tier before being discarded, and a device-cache miss consults the
+tier before paying the cold prefill: :meth:`entry` rebuilds the
+``_PrefixEntry`` from the spilled blob (``device_put``, zero prefill
+FLOPs — ``builds`` does not move), and :meth:`paged_entry` restores
+evicted pool page sets straight from the blob
+(``PagePool.restore_entry``) instead of re-adopting. Every restore is
+byte-identical to the state it replaces, so greedy streams cannot
+tell {evict → restore} from {never evicted}. Tier absent (the
+default): every path below is bit-for-bit the r12 behavior.
 """
 
 from __future__ import annotations
@@ -20,6 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from mlapi_tpu.serving.requests import _PrefixEntry
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.prefix")
 
 
 class PrefixCache:
@@ -43,9 +60,14 @@ class PrefixCache:
         self._wide: collections.OrderedDict = collections.OrderedDict()
         self.mix_warmed: set = set()
         # Stats (read by /metrics via the engine's properties).
+        # ``builds`` counts actual cold prefills (``_build`` runs) —
+        # the counter the zero-prefill-FLOPs restore claim is pinned
+        # against: a tier restore increments ``misses`` (it missed the
+        # device cache) but never ``builds``.
         self.hits = 0
         self.misses = 0
         self.fallbacks = 0
+        self.builds = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -78,29 +100,56 @@ class PrefixCache:
             # builder and surface the same error to this caller).
             ev.wait(timeout=600.0)
         try:
-            entry = self._build(text)
+            # Device-cache miss: the host tier first (a spilled blob
+            # rebuilds the entry with ZERO prefill FLOPs), then the
+            # cold prefill. Either way it is a miss — the tier's own
+            # restore_hits counter carries the savings story.
+            entry = self._restore(text)
+            if entry is None:
+                entry = self._build(text)
+            tier = getattr(self.eng, "kv_tier", None)
+            if tier is not None:
+                # The rebuild metadata a later spill must attach (the
+                # pool spill seam knows page ids, not buckets).
+                tier.note_meta(
+                    text, bucket=entry.bucket, lo=entry.lo,
+                    used=entry.used,
+                )
+            evicted = []
             with self._lock:
                 self._entries[text] = entry
                 self.misses += 1
                 while len(self._entries) > self.max_entries:
-                    old, _ = self._entries.popitem(last=False)  # LRU
+                    old, old_e = self._entries.popitem(last=False)  # LRU
+                    evicted.append(old_e)
                     if self.eng.pool is not None:
                         # The evicted entry's pool pages lose their
                         # entry hold (rows still sharing them keep
                         # theirs; the pages free when the last row
-                        # departs).
+                        # departs). No pool-side spill here — the
+                        # entry's contiguous KV below is the same
+                        # bytes, readable from THIS thread.
                         self.eng.pool.drop_entry(old)
+            for old_e in evicted:
+                # Outside the lock: the spill device_gets a [1, P]
+                # cache — other prefixes' lookups must not wait on it.
+                # A concurrent re-arrival of the evicted prefix in
+                # this window just pays a cold build (correct, merely
+                # unlucky).
+                self._spill_entry(old_e)
             return entry
         finally:
             with self._lock:
                 self._building.pop(text, None)
             ev.set()
 
-    def _build(self, text: str) -> _PrefixEntry:
-        """Tokenize, validate, prefill, and (strict mode) warm one
-        prefix — device work, run OUTSIDE the registry lock."""
-        from mlapi_tpu.models.gpt import prefill_fn
-
+    def _plan(self, text: str):
+        """Tokenize and bucket one prefix EXACTLY as a cold build
+        would — ``(ids, bucket, lo)``. Shared between :meth:`_build`
+        and tier-restore validation, so a spilled blob only ever
+        applies when its geometry matches what a build would produce
+        today (tokenizer/bucket/page-size drift turns the blob into a
+        miss, never a wrong cache)."""
         eng = self.eng
         ids = eng.tokenizer.token_ids(text)
         if not ids:
@@ -129,6 +178,16 @@ class PrefixCache:
             aligned = -(-bucket // eng.pool.page) * eng.pool.page
             if aligned <= cap:
                 bucket = aligned
+        return ids, bucket, bucket - len(ids)
+
+    def _build(self, text: str) -> _PrefixEntry:
+        """Tokenize, validate, prefill, and (strict mode) warm one
+        prefix — device work, run OUTSIDE the registry lock."""
+        from mlapi_tpu.models.gpt import prefill_fn
+
+        eng = self.eng
+        ids, bucket, _ = self._plan(text)
+        self.builds += 1
         row = np.full((1, bucket), eng.tokenizer.pad_id, np.int32)
         row[0, -len(ids):] = ids
         lo = bucket - len(ids)
@@ -144,6 +203,112 @@ class PrefixCache:
         if eng._strict_admit:
             self.warm_shapes(entry)
         return entry
+
+    # -- host-tier seams (serving/kv_tier.py; no-ops when absent) ------
+    def _restore(self, text: str) -> _PrefixEntry | None:
+        """Tier consult on a device-cache miss: rebuild the entry from
+        its spilled blob — ``device_put`` of the stored-format payload,
+        ZERO prefill FLOPs (``builds`` does not move) — or ``None`` to
+        fall back to the cold build. Failure discipline: geometry or
+        metadata drift DROPS the blob (it can never apply) and goes
+        cold; a transient failure (including an injected
+        ``tier_restore`` raise) keeps the blob, counts
+        ``restore_failures``, and goes cold — either way the caller's
+        path is the normal prefill, never a half-built entry."""
+        from mlapi_tpu.serving import faults
+
+        tier = getattr(self.eng, "kv_tier", None)
+        if tier is None:
+            return None
+        blob = tier.lookup(text)  # absent -> counted restore miss
+        if blob is None:
+            return None
+        try:
+            faults.fire("tier_restore")
+            entry = self._entry_from_blob(text, blob)
+        except Exception as e:
+            tier.count_restore_failure()
+            _log.debug(
+                "tier entry restore failed (%s); cold prefill", e
+            )
+            return None
+        if entry is not None:
+            if self.eng._strict_admit:
+                self.warm_shapes(entry)
+            tier.count_restore(blob)
+        return entry
+
+    def _entry_from_blob(self, text: str, blob) -> _PrefixEntry | None:
+        """Blob payload ``{layer: {leaf: [n, page, ...]}}`` → the
+        ``[1, bucket]`` contiguous entry KV, byte-identical to the one
+        the original build produced (the spill gathered exactly those
+        bytes; slots past ``bucket`` in the final page are spill-time
+        pool residue, sliced off here and never read). Returns
+        ``None`` — after dropping the blob — when the blob's recorded
+        geometry does not match what a cold build would produce
+        today."""
+        tier = self.eng.kv_tier
+        if blob.bucket is None:
+            # Spilled before any entry registration recorded its
+            # metadata: pool-page restore still works (paged_entry),
+            # but an entry cannot be rebuilt. Keep the blob.
+            return None
+        ids, bucket, lo = self._plan(text)
+        if (
+            blob.bucket != bucket
+            or blob.lo != lo
+            or blob.used != len(ids)
+            or blob.num_pages * blob.page < bucket
+        ):
+            tier.drop(text)
+            _log.debug(
+                "tier blob geometry drifted for %r; cold prefill", text
+            )
+            return None
+        kv = {
+            ln: {
+                name: jnp.asarray(
+                    np.ascontiguousarray(
+                        a.reshape(
+                            (1, a.shape[0] * a.shape[1]) + a.shape[2:]
+                        )[:, :bucket]
+                    )
+                )
+                for name, a in layer.items()
+            }
+            for ln, layer in blob.payload.items()
+        }
+        return _PrefixEntry(text, kv, bucket, lo, len(ids))
+
+    def _spill_entry(self, entry: _PrefixEntry) -> None:
+        """Spill a dict-LRU-evicted entry's contiguous KV to the host
+        tier before it is garbage-collected — the second spill seam
+        (the first is ``PagePool._spill_and_release``). Reads the
+        entry's own ``[1, P]`` KV, never pool arrays, so it is safe
+        from registration threads; page-shaped to the pool's page size
+        (paged engines) so the blob is interchangeable with pool
+        spills, or one bucket-wide page (contiguous engines). A
+        failure here (including an injected ``tier_spill`` raise)
+        falls back to the pre-tier discard, counted."""
+        tier = getattr(self.eng, "kv_tier", None)
+        if tier is None:
+            return
+        from mlapi_tpu.serving.kv_tier import payload_from_contiguous
+
+        page = (
+            self.eng.pool.page if self.eng.pool is not None
+            else entry.bucket
+        )
+        try:
+            tier.note_meta(
+                entry.fp, bucket=entry.bucket, lo=entry.lo,
+                used=entry.used,
+            )
+            payload = payload_from_contiguous(entry.kv, page)
+            tier.spill(entry.fp, payload, page)
+        except Exception as e:
+            tier.count_spill_failure()
+            _log.debug("tier entry spill failed (%s); evicting cold", e)
 
     def warm_shapes(self, entry: _PrefixEntry) -> None:
         """Registration-time warm of the prefix-batch programs: on a
@@ -233,14 +398,47 @@ class PrefixCache:
         page table here (ref-counted; the contiguous path
         re-broadcast the prefix KV into every row of every batch).
         Under pool pressure the page set may have been evicted
-        (``PagePool._evict_one_locked``); the entry silently
-        re-adopts."""
+        (``PagePool._spill_and_release``); with a host tier attached
+        the eviction SPILLED those pages, so the miss first tries
+        ``PagePool.restore_entry`` — a ``device_put`` of the blob back
+        into fresh pages, byte-identical to the re-adopt it replaces —
+        and only then falls back to the adopt scatter. A
+        :class:`~mlapi_tpu.serving.paged_pool.PagePoolExhausted`
+        during restore propagates loudly (restore allocates FIRST, so
+        nothing is half-installed; the adopt path would need the same
+        pages and fail the same way); any other restore failure
+        (including an injected ``tier_restore`` raise) is counted and
+        falls back to the adopt, pages conserved."""
         import jax
 
         pool = self.eng.pool
         pages = pool.entry_pages(fp, holds=holds)
         if pages is not None:
             return pages, False
+        tier = getattr(self.eng, "kv_tier", None)
+        if tier is not None:
+            from mlapi_tpu.serving.paged_pool import (
+                PagePoolExhausted, PagePoolPoisoned,
+            )
+
+            blob = tier.lookup(fp)  # absent -> counted restore miss
+            if blob is not None:
+                try:
+                    pages = pool.restore_entry(fp, blob, holds=holds)
+                except (PagePoolExhausted, PagePoolPoisoned):
+                    # Exhaustion: the adopt fallback needs the same
+                    # pages and would fail the same way. Poisoning:
+                    # the fallback would read consumed buffers. Both
+                    # propagate loudly, nothing half-installed.
+                    raise
+                except Exception as e:
+                    tier.count_restore_failure()
+                    _log.debug(
+                        "tier page restore failed (%s); re-adopting", e
+                    )
+                    pages = None
+                if pages is not None:
+                    return pages, False
         p = jax.tree.leaves(kv)[0].shape[1]
         pages = pool.alloc(-(-p // pool.page))
         pool.put_entry_pages(fp, pages, holds=holds)

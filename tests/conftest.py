@@ -92,6 +92,16 @@ _CACHE_FAMILIES = {
         "test_speculative_sampling",
         "test_spec_batched_serving",
     }),
+    # Identical tiny-model CFG (vocab 260 / h32 / 2L / 4H / 160 pos,
+    # f32) and the same {gpt, llama} x {none, int8} engine shapes at
+    # page 8 / chunk 2: the tier module re-drives the SAME compiled
+    # prefill/decode programs test_paged_kv built, plus only its own
+    # restore scatter — sharing the window saves the whole 4-config
+    # compile ladder a second time (~15 s).
+    "paged-family": frozenset({
+        "test_paged_kv",
+        "test_paged_kv_tier",
+    }),
 }
 _last_cache_group = [None]
 

@@ -650,12 +650,14 @@ def _matrix_engine() -> TextGenerationEngine:
     (pool_alloc / table_install), a draft (spec_verify), small
     prompt buckets so a 20-token prompt takes the chunked-prefill
     path (prefill_chunk), chunk=2 decode (decode), the async
-    collector (collector_pop), streams (stream_push)."""
+    collector (collector_pop), streams (stream_push), and the host
+    tier (tier_spill / tier_restore — the prefix evict/restore leg
+    in ``_matrix_traffic``)."""
     return TextGenerationEngine(
         _SPEC_MODEL, _SPEC_PARAMS, tokenizer=ByteTokenizer(),
         chunk=2, fused_single=False, kv_page_size=4, max_batch=4,
         prompt_buckets=(4, 8), draft=(_SPEC_MODEL, _SPEC_PARAMS),
-        spec_k=3,
+        spec_k=3, kv_tier_bytes=1 << 22,
     )
 
 
@@ -669,10 +671,13 @@ async def _submit_or_outcome(eng, *a, **kw):
         return None, ([], e)
 
 
-async def _matrix_traffic(eng) -> list:
+async def _matrix_traffic(eng, tier_leg: bool = False) -> list:
     """Deterministic traffic hitting every seam; returns each
     stream's (tokens, terminal) — raising only on a HANG (wait_for),
-    never on an in-band error frame."""
+    never on an in-band error frame. ``tier_leg`` adds the prefix
+    evict/restore rounds that cross the tier_spill / tier_restore
+    seams (enabled only for those points — the other 14 cases keep
+    the r12 traffic and the r12 runtime)."""
     outcomes = []
     # Solo greedy → speculation engages (spec_verify); streams push.
     g1, out = await _submit_or_outcome(
@@ -702,6 +707,26 @@ async def _matrix_traffic(eng) -> list:
         outcomes.append(
             out3 if g3 is None else await _collect(g3)
         )
+    # Prefix evict/restore over the host tier: the entry's page set
+    # spills on eviction (tier_spill) and the re-arrival restores it
+    # from the blob (tier_restore); a raise at either point must
+    # degrade to the pre-tier discard / cold path with the stream
+    # still completing. The final evict returns the pool to the
+    # page-conservation baseline (prefix entries hold pages by
+    # design; a baseline sweep is not a leak).
+    if tier_leg and eng.pool is not None and eng.kv_tier is not None:
+        g4, out4 = await _submit_or_outcome(
+            eng, " q", max_new_tokens=4, prefix="matrix sys"
+        )
+        outcomes.append(out4 if g4 is None else await _collect(g4))
+        await _settle(eng, 10)
+        eng.pool.evict_idle(1)           # spill seam
+        g5, out5 = await _submit_or_outcome(
+            eng, " q", max_new_tokens=4, prefix="matrix sys"
+        )
+        outcomes.append(out5 if g5 is None else await _collect(g5))
+        await _settle(eng, 10)
+        eng.pool.evict_idle(1)           # back to the page baseline
     return outcomes
 
 
@@ -717,7 +742,9 @@ async def test_fault_matrix_conservation(point, action):
     await eng.start()
     try:
         faults.arm(f"{point}:{action}")
-        outcomes = await _matrix_traffic(eng)
+        outcomes = await _matrix_traffic(
+            eng, tier_leg=point.startswith("tier_")
+        )
         if action == "delay=0.02":
             # Delays slow, never break: every stream must COMPLETE.
             for toks, err in outcomes:
