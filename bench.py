@@ -1009,6 +1009,227 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _router_report(ck: str, env: dict) -> dict:
+    """Scale-out router block (``BENCH_GEN_ROUTER=1``): TWO real
+    engine replica processes on the SAME checkpoint behind the
+    prefix-affinity router, driven with a repeated-prefix workload
+    under affinity and forced round-robin ALTERNATED round-by-round
+    inside one window (the variance rule). Claim classes:
+
+    - **Prefix-cache counters — asserted, never wall-clock.** With
+      affinity the fleet pays exactly ONE cold prefill per distinct
+      prefix (``generate.prefix_builds`` summed over replicas moves
+      by the prefix count); with round-robin every replica pays its
+      own (2x the builds at 2 replicas). ``router.affinity_hits`` >
+      0 and no failovers on the healthy fleet.
+    - **TTFT p50/p95 — measured per policy, reported.** Client-side
+      time to the first NDJSON frame through the router, per policy,
+      with the compile-paying first round off the clock; the numbers
+      ride the artifact for the ratio story (affinity's repeats skip
+      the prefill), subject to VARIANCE_NOTE like every wall-clock
+      number on this box.
+    """
+    import socket
+
+    from mlapi_tpu.serving.router import (
+        Router,
+        _get_json,
+        build_router_app,
+    )
+    from mlapi_tpu.serving.server import Server
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port(), free_port()]
+    workdir = tempfile.mkdtemp(prefix="mlapi_tpu_bench_router_")
+    # Replicas boot with minimal warmup (first-request compiles hit
+    # both policies' round 0 equally, which stays off the clock).
+    renv = dict(
+        os.environ, **env, MLAPI_TPU_REPLICA="1",
+        MLAPI_TPU_WARMUP="minimal",
+    )
+    replicas = []
+    with open(os.path.join(workdir, "replicas.log"), "a") as log:
+        for p in ports:
+            replicas.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "mlapi_tpu.serving",
+                        "--checkpoint", ck, "--port", str(p),
+                        "--no-admission-control",
+                    ],
+                    stdout=log, stderr=subprocess.STDOUT, env=renv,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+            )
+    report: dict = {}
+    try:
+        for p, proc in zip(ports, replicas):
+            wait_healthy(
+                p,
+                timeout_s=float(
+                    os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")
+                ),
+                proc=proc,
+            )
+
+        async def scrape(port: int) -> dict:
+            return await _get_json("127.0.0.1", port, "/metrics", 10.0)
+
+        async def builds_sum() -> int:
+            snaps = [await scrape(p) for p in ports]
+            return sum(
+                s["counters"].get("generate.prefix_builds", 0)
+                for s in snaps
+            )
+
+        async def ttft_stream(port: int, payload: dict) -> float:
+            """ms to the first NDJSON frame through the router."""
+            body = json.dumps(payload).encode()
+            t0 = time.perf_counter()
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            try:
+                writer.write(
+                    b"POST /generate HTTP/1.1\r\nhost: x\r\n"
+                    b"content-type: application/json\r\n"
+                    b"connection: close\r\n"
+                    b"content-length: %d\r\n\r\n" % len(body) + body
+                )
+                await writer.drain()
+                await reader.readuntil(b"\r\n\r\n")   # head
+                # First chunk of the NDJSON body (its chunked size
+                # line lands in the same packet as the frame).
+                await reader.readuntil(b"\n")
+                ttft = (time.perf_counter() - t0) * 1e3
+                await reader.read()                    # drain to EOF
+                return ttft
+            finally:
+                writer.close()
+
+        async def measure() -> dict:
+            eps = [("127.0.0.1", p) for p in ports]
+            fronts = {}
+            routers = {}
+            for policy in ("affinity", "round_robin"):
+                routers[policy] = Router(eps, policy=policy)
+                fronts[policy] = Server(
+                    build_router_app(routers[policy]),
+                    host="127.0.0.1", port=0,
+                )
+                await fronts[policy].start()
+            prefixes = {
+                "affinity": [
+                    "affinity shared system prompt %d. " % i
+                    + "the quick brown fox jumps over the lazy dog."
+                    for i in range(4)
+                ],
+                "round_robin": [
+                    "round robin system prompt %d. " % i
+                    + "the quick brown fox jumps over the lazy dog."
+                    for i in range(4)
+                ],
+            }
+            builds = {"before": await builds_sum()}
+            ttfts = {"affinity": [], "round_robin": []}
+            rounds = int(os.environ.get("BENCH_ROUTER_ROUNDS", "4"))
+            try:
+                for rnd in range(rounds):
+                    # Alternate policies inside ONE window: the only
+                    # wall-clock comparison this block reports. Each
+                    # prefix is offered TWICE back-to-back (the
+                    # repeated-prefix workload): under affinity the
+                    # repeat is a warm hit on the same replica; under
+                    # round-robin the repeat lands on the OTHER
+                    # replica and pays its own cold build.
+                    for policy in ("affinity", "round_robin"):
+                        for pre in prefixes[policy]:
+                            for _ in range(2):
+                                t = await ttft_stream(
+                                    fronts[policy].port,
+                                    {
+                                        "text": " go", "prefix": pre,
+                                        "max_new_tokens": 4,
+                                        "stream": True,
+                                    },
+                                )
+                                if rnd > 0:  # round 0 pays the builds
+                                    ttfts[policy].append(t)
+                    if rnd == 0:
+                        # After one full alternated round every
+                        # distinct prefix has been offered to every
+                        # policy once: the builds split is final for
+                        # affinity (later rounds are warm hits).
+                        builds["after_round0"] = await builds_sum()
+            finally:
+                for f in fronts.values():
+                    await f.stop()
+            builds["after"] = await builds_sum()
+            snaps = [await scrape(p) for p in ports]
+            return {
+                "routers": routers, "builds": builds, "ttfts": ttfts,
+                "snaps": snaps,
+            }
+
+        m = asyncio.run(measure())
+        aff, rr = m["routers"]["affinity"], m["routers"]["round_robin"]
+        n_pre = 4
+        total_builds = m["builds"]["after"] - m["builds"]["before"]
+        # Affinity's share: one per distinct prefix. Round-robin's:
+        # one per (prefix, replica) — the alternation guarantees both
+        # replicas saw each rr prefix by round 1.
+        assert aff.affinity_hits > 0, "affinity never hit its preferred"
+        assert aff.failovers == 0 and rr.failovers == 0
+        assert total_builds == n_pre + 2 * n_pre, (
+            "expected %d affinity + %d round-robin cold builds, saw %d"
+            % (n_pre, 2 * n_pre, total_builds)
+        )
+        q = lambda xs, f: (  # noqa: E731
+            round(sorted(xs)[min(len(xs) - 1, int(f * len(xs)))], 1)
+            if xs else None
+        )
+        prefix_hits = sum(
+            s["counters"].get("generate.prefix_hits", 0) for s in m["snaps"]
+        )
+        report.update(
+            {
+                "router_replicas": 2,
+                "router_prefixes_per_policy": n_pre,
+                "router_builds_affinity": n_pre,
+                "router_builds_round_robin": 2 * n_pre,
+                "router_builds_asserted": True,
+                "router_affinity_hits": aff.affinity_hits,
+                "router_affinity_fallbacks": aff.affinity_fallbacks,
+                "router_failovers": 0,
+                "router_prefix_hits_total": prefix_hits,
+                "router_ttft_p50_ms_affinity": q(m["ttfts"]["affinity"], 0.5),
+                "router_ttft_p95_ms_affinity": q(
+                    m["ttfts"]["affinity"], 0.95
+                ),
+                "router_ttft_p50_ms_round_robin": q(
+                    m["ttfts"]["round_robin"], 0.5
+                ),
+                "router_ttft_p95_ms_round_robin": q(
+                    m["ttfts"]["round_robin"], 0.95
+                ),
+            }
+        )
+        return report
+    except Exception as e:  # noqa: BLE001 — the block must not kill the run
+        report["router_report_error"] = repr(e)[-400:]
+        return report
+    finally:
+        for proc in replicas:
+            proc.send_signal(signal.SIGTERM)
+        for proc in replicas:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def bench_generate() -> None:
     """/generate throughput: single-stream vs concurrency-8 batched
     decode through the full HTTP stack (r1 criterion: batched decode
@@ -1206,6 +1427,12 @@ def bench_generate() -> None:
             # both cache formats, restore-hit vs cold-prefill TTFT
             # alternated in one window.
             kv_extras.update(_tier_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_ROUTER") == "1":
+            # Scale-out router: 2 engine replicas, repeated-prefix
+            # workload, affinity vs forced round-robin alternated in
+            # one window — prefix-build/hit counters asserted (never
+            # wall-clock), TTFT p50/p95 per policy reported.
+            kv_extras.update(_router_report(ck, server_env))
         prefix_extras = {}
         if os.environ.get("BENCH_GEN_PREFIX") == "1":
             # Prefix-caching TTFT: the same effective prompt served

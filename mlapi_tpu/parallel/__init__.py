@@ -27,4 +27,7 @@ from mlapi_tpu.parallel.layout import (  # noqa: F401
     SpecLayout,
     fsdp_spec_tree,
 )
-from mlapi_tpu.parallel.distributed import initialize_from_env  # noqa: F401
+from mlapi_tpu.parallel.distributed import (  # noqa: F401
+    initialize_from_env,
+    replica_endpoints_from_env,
+)

@@ -92,3 +92,40 @@ def initialize_from_env() -> bool:
         )
         return True
     return False
+
+
+REPLICAS_ENV_VAR = "MLAPI_TPU_REPLICAS"
+
+
+def replica_endpoints_from_env(
+    spec: str | None = None,
+) -> list[tuple[str, int]]:
+    """Serving-replica discovery — the HTTP sibling of the rendezvous
+    trio above. The ``--router`` topology supervisor exports::
+
+        MLAPI_TPU_REPLICAS=host0:8001,host0:8002
+        MLAPI_TPU_REPLICA_ID=0   # per spawned replica, its slot
+
+    to every process it spawns, exactly the launcher convention the
+    multi-host trio uses (env-driven so GKE manifests, SSH loops, and
+    tests all speak it); a router pointed at externally-launched
+    replicas (other hosts, other supervisors) reads the same variable
+    instead of spawning. Returns ``[]`` when unset — single-process
+    serving has no replica set. Malformed entries are loud: a typo'd
+    fleet definition must not silently route to half the fleet.
+    """
+    if spec is None:
+        spec = os.environ.get(REPLICAS_ENV_VAR, "")
+    endpoints: list[tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"bad replica endpoint {part!r} (want host:port) in "
+                f"${REPLICAS_ENV_VAR} / --replica-urls"
+            )
+        endpoints.append((host, int(port)))
+    return endpoints

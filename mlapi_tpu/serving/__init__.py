@@ -19,4 +19,5 @@ from mlapi_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
     TextClassificationEngine,
 )
+from mlapi_tpu.serving.router import Router, build_router_app  # noqa: F401
 from mlapi_tpu.serving.server import Server  # noqa: F401

@@ -120,6 +120,56 @@ def _watch_and_reexec(argv) -> int:
             return 0
 
 
+def _forwarded_engine_flags(args) -> list:
+    """The engine/app flags a topology supervisor (``--workers``,
+    ``--router``) forwards verbatim to every child server process —
+    one list so the two supervisors cannot drift (a flag added to one
+    but not the other would silently serve a different engine config
+    per topology)."""
+    cmd: list = []
+    if args.max_batch is not None:
+        cmd += ["--max-batch", str(args.max_batch)]
+    if getattr(args, "quantize", None):
+        cmd += ["--quantize", args.quantize]
+    if getattr(args, "kv_quant", None):
+        cmd += ["--kv-quant", args.kv_quant]
+    if getattr(args, "decode_attn_impl", None):
+        cmd += ["--decode-attn-impl", args.decode_attn_impl]
+    if getattr(args, "kv_page_size", None):
+        cmd += ["--kv-page-size", str(args.kv_page_size)]
+    if getattr(args, "kv_pages", None):
+        cmd += ["--kv-pages", str(args.kv_pages)]
+    if getattr(args, "kv_tier_bytes", 0):
+        cmd += ["--kv-tier-bytes", str(args.kv_tier_bytes)]
+    if getattr(args, "kv_tier_disk_dir", None):
+        # Children may share one dir: blob filenames are pid-scoped,
+        # each process indexes only its own files (the bytes budget is
+        # per-process), and the startup sweep only unlinks files
+        # whose owner pid is dead. Forwarded independently of the
+        # bytes flag so a mis-paired config fails in the child
+        # exactly as it would single-process (main() also rejects it
+        # before supervising).
+        cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
+    if not getattr(args, "prefill_page_native", True):
+        cmd += ["--no-prefill-page-native"]
+    if not getattr(args, "prefill_interleave", True):
+        cmd += ["--no-prefill-interleave"]
+    if getattr(args, "mesh_shape", None):
+        cmd += ["--mesh-shape", args.mesh_shape]
+    if getattr(args, "draft_checkpoint", None):
+        cmd += ["--draft-checkpoint", args.draft_checkpoint]
+    if getattr(args, "spec_sample", False):
+        cmd += ["--spec-sample"]
+    if getattr(args, "fused_batch", "auto") != "auto":
+        cmd += ["--fused-batch", args.fused_batch]
+    if getattr(args, "default_deadline_ms", None) is not None:
+        cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
+    if not getattr(args, "admission_control", True):
+        cmd += ["--no-admission-control"]
+    cmd += ["--drain-timeout-s", str(getattr(args, "drain_timeout_s", 10.0))]
+    return cmd
+
+
 def _supervise_workers(n: int, ckpt: str, args) -> int:
     """SO_REUSEPORT worker pool: spawn ``n`` fresh server processes
     all bound to the same (host, port), restart any that die, fan out
@@ -146,47 +196,8 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
         sys.executable, "-m", "mlapi_tpu.serving",
         "--checkpoint", ckpt, "--host", args.host, "--port", str(args.port),
         "--max-wait-ms", str(args.max_wait_ms),
+        *_forwarded_engine_flags(args),
     ]
-    if args.max_batch is not None:
-        cmd += ["--max-batch", str(args.max_batch)]
-    if getattr(args, "quantize", None):
-        cmd += ["--quantize", args.quantize]
-    if getattr(args, "kv_quant", None):
-        cmd += ["--kv-quant", args.kv_quant]
-    if getattr(args, "decode_attn_impl", None):
-        cmd += ["--decode-attn-impl", args.decode_attn_impl]
-    if getattr(args, "kv_page_size", None):
-        cmd += ["--kv-page-size", str(args.kv_page_size)]
-    if getattr(args, "kv_pages", None):
-        cmd += ["--kv-pages", str(args.kv_pages)]
-    if getattr(args, "kv_tier_bytes", 0):
-        cmd += ["--kv-tier-bytes", str(args.kv_tier_bytes)]
-    if getattr(args, "kv_tier_disk_dir", None):
-        # Workers may share one dir: blob filenames are pid-scoped,
-        # each worker indexes only its own files (the bytes budget is
-        # per-process), and the startup sweep only unlinks files
-        # whose owner pid is dead. Forwarded independently of the
-        # bytes flag so a mis-paired config fails in the worker
-        # exactly as it would single-process (main() also rejects it
-        # before supervising).
-        cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
-    if not getattr(args, "prefill_page_native", True):
-        cmd += ["--no-prefill-page-native"]
-    if not getattr(args, "prefill_interleave", True):
-        cmd += ["--no-prefill-interleave"]
-    if getattr(args, "mesh_shape", None):
-        cmd += ["--mesh-shape", args.mesh_shape]
-    if getattr(args, "draft_checkpoint", None):
-        cmd += ["--draft-checkpoint", args.draft_checkpoint]
-    if getattr(args, "spec_sample", False):
-        cmd += ["--spec-sample"]
-    if getattr(args, "fused_batch", "auto") != "auto":
-        cmd += ["--fused-batch", args.fused_batch]
-    if getattr(args, "default_deadline_ms", None) is not None:
-        cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
-    if not getattr(args, "admission_control", True):
-        cmd += ["--no-admission-control"]
-    cmd += ["--drain-timeout-s", str(getattr(args, "drain_timeout_s", 10.0))]
     # systemd/docker stop the supervisor with SIGTERM; without a
     # handler the finally below never runs and the workers are
     # orphaned still bound to the port (SO_REUSEPORT would then let a
@@ -259,6 +270,172 @@ def _supervise_workers(n: int, ckpt: str, args) -> int:
     return 0
 
 
+def _supervise_router(ckpt: str | None, args) -> int:
+    """``--router`` topology: N full engine replicas (separate
+    processes, each the whole r13 stack on its own port — ports
+    ``--port``+1..N) under one prefix-affinity router serving the
+    front ``--port`` in THIS process. Replica discovery speaks the
+    same env convention as the multi-host rendezvous trio
+    (``parallel/distributed.py``): the supervisor exports
+    ``MLAPI_TPU_REPLICAS=host:p1,host:p2`` (+ per-child
+    ``MLAPI_TPU_REPLICA_ID``) to everything it spawns, and a router
+    over externally-launched replicas (other hosts, k8s pods) reads
+    the same variable — or ``--replica-urls`` — instead of spawning.
+
+    Replicas are pinned to CPU unless the operator overrides
+    ``MLAPI_TPU_PLATFORM`` (same rule as ``--workers``: the TPU is
+    single-process-exclusive — a TPU fleet is one replica per host
+    with ``--replica-urls`` across hosts, not N processes on one
+    chip). Dead replicas respawn with backoff; while one is down the
+    router routes around it (HRW moves only ITS affinity slice) and
+    the health poll folds it back in when it returns."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import time
+
+    from mlapi_tpu.parallel.distributed import (
+        REPLICAS_ENV_VAR,
+        replica_endpoints_from_env,
+    )
+    from mlapi_tpu.serving.router import Router, build_router_app
+
+    if args.replica_urls:
+        endpoints = replica_endpoints_from_env(args.replica_urls)
+        spawn = False
+    else:
+        endpoints = replica_endpoints_from_env()  # $MLAPI_TPU_REPLICAS
+        spawn = not endpoints
+        if spawn:
+            endpoints = [
+                (args.host, args.port + 1 + i) for i in range(args.replicas)
+            ]
+    if not endpoints:
+        raise SystemExit("--router: no replica endpoints")
+    env_spec = ",".join(f"{h}:{p}" for h, p in endpoints)
+
+    cmds: list = []
+    if spawn:
+        base_env = dict(
+            os.environ, MLAPI_TPU_REPLICA="1", **{REPLICAS_ENV_VAR: env_spec}
+        )
+        if not base_env.get("MLAPI_TPU_PLATFORM"):
+            base_env["MLAPI_TPU_PLATFORM"] = "cpu"
+            _log.info(
+                "--router: pinning replicas to CPU (MLAPI_TPU_PLATFORM="
+                "cpu); TPU fleets run one replica per host via "
+                "--replica-urls"
+            )
+        for i, (h, p) in enumerate(endpoints):
+            cmds.append(
+                (
+                    [
+                        sys.executable, "-m", "mlapi_tpu.serving",
+                        "--checkpoint", ckpt, "--host", h, "--port", str(p),
+                        "--max-wait-ms", str(args.max_wait_ms),
+                        *_forwarded_engine_flags(args),
+                    ],
+                    dict(base_env, MLAPI_TPU_REPLICA_ID=str(i)),
+                )
+            )
+
+    async def _run() -> int:
+        router = Router(
+            endpoints,
+            policy=args.route_policy,
+            affinity_prefix_bytes=args.affinity_prefix_bytes,
+            health_poll_s=args.health_poll_s,
+            queue_depth_limit=args.queue_depth_limit,
+            # Gate routing on a passed health poll: a replica still
+            # compiling its warmup grids must not eat traffic.
+            assume_live=False,
+        )
+        server = Server(build_router_app(router), host=args.host,
+                        port=args.port)
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_ev.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+
+        children: list = [
+            subprocess.Popen(cmd, env=env) for cmd, env in cmds
+        ]
+        spawned_at = [time.time()] * len(children)
+        restart_at = [0.0] * len(children)
+        backoff = [0.5] * len(children)
+
+        async def _respawn_loop():
+            # Same backoff discipline as the --workers supervisor, but
+            # no global give-up: the router's whole job is serving on
+            # the replicas that ARE up while a bad one crash-loops at
+            # bounded cost.
+            while True:
+                await asyncio.sleep(0.5)
+                for i, c in enumerate(children):
+                    if c is not None and c.poll() is not None:
+                        lived = time.time() - spawned_at[i]
+                        backoff[i] = (
+                            0.5 if lived >= 5.0
+                            else min(30.0, backoff[i] * 2)
+                        )
+                        _log.warning(
+                            "replica %d (pid %d) exited rc=%d after "
+                            "%.1fs; respawning in %.1fs",
+                            i, c.pid, c.returncode, lived, backoff[i],
+                        )
+                        restart_at[i] = time.time() + backoff[i]
+                        children[i] = None
+                    elif c is None and time.time() >= restart_at[i]:
+                        children[i] = subprocess.Popen(
+                            cmds[i][0], env=cmds[i][1]
+                        )
+                        spawned_at[i] = time.time()
+
+        respawn = None
+        try:
+            # Inside the try: a front server that fails to bind (port
+            # taken) must still run the finally's SIGTERM fan-out —
+            # never orphan N engine replicas behind a dead router.
+            await server.start()
+            _log.info(
+                "router (%s) on %s:%d over replicas %s",
+                args.route_policy, args.host, server.port, env_spec,
+            )
+            if spawn:
+                respawn = asyncio.create_task(_respawn_loop())
+            await stop_ev.wait()
+        finally:
+            if respawn is not None:
+                respawn.cancel()
+            # Drain the FLEET: fan SIGTERM to the replicas (each sheds
+            # new work and drains under its own --drain-timeout-s)
+            # while the router keeps relaying in-flight streams and
+            # answering /healthz "degraded" — the layer above sees a
+            # draining fleet, never connection-refused mid-stream.
+            for c in children:
+                if c is not None and c.poll() is None:
+                    c.send_signal(_signal.SIGTERM)
+            deadline = time.time() + args.drain_timeout_s + 5.0
+            while time.time() < deadline and any(
+                c is not None and c.poll() is None for c in children
+            ):
+                await asyncio.sleep(0.2)
+            for c in children:
+                if c is not None and c.poll() is None:
+                    c.kill()
+            await server.stop()
+        return 0
+
+    try:
+        return asyncio.run(_run())
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> None:
     from mlapi_tpu.utils.platform import apply_platform_override
 
@@ -278,6 +455,57 @@ def main(argv=None) -> None:
         "--workers", type=int, default=1,
         help="number of SO_REUSEPORT server processes (CPU-attach "
              "scale-out; needs an explicit --port)",
+    )
+    parser.add_argument(
+        "--router", action="store_true",
+        help="scale-out topology: spawn --replicas full engine "
+             "replicas (separate processes on ports --port+1..N) and "
+             "serve a prefix-affinity front-end router on --port — "
+             "repeated prompt prefixes land on the replica whose "
+             "pool pages / kv-tier blobs are already warm "
+             "(rendezvous hashing; power-of-two-choices fallback when "
+             "the preferred replica sheds/drains/overloads). With "
+             "--replica-urls (or $MLAPI_TPU_REPLICAS) the router "
+             "mounts over externally-launched replicas instead of "
+             "spawning",
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=2,
+        help="with --router: how many engine replica processes to "
+             "spawn (default 2)",
+    )
+    parser.add_argument(
+        "--replica-urls", default=None,
+        help="with --router: comma-separated host:port replica "
+             "endpoints to route over instead of spawning (multi-host "
+             "fleets; same format as $MLAPI_TPU_REPLICAS)",
+    )
+    parser.add_argument(
+        "--affinity-prefix-bytes", type=int, default=64,
+        help="with --router: how many leading BYTES of the request's "
+             "prompt prefix (the 'prefix' field when present, else "
+             "'text') feed the rendezvous hash — the affinity key. "
+             "The router never tokenizes",
+    )
+    parser.add_argument(
+        "--route-policy", choices=["affinity", "round_robin"],
+        default="affinity",
+        help="with --router: 'affinity' (prefix-hash rendezvous "
+             "routing, the default) or 'round_robin' (the A/B "
+             "baseline the bench compares against — every replica "
+             "rebuilds every prefix)",
+    )
+    parser.add_argument(
+        "--health-poll-s", type=float, default=0.5,
+        help="with --router: per-replica /healthz + /metrics poll "
+             "cadence (liveness, draining, queue depth)",
+    )
+    parser.add_argument(
+        "--queue-depth-limit", type=int, default=None,
+        help="with --router: a replica whose scraped queue depth plus "
+             "router-side in-flight exceeds this is skipped by "
+             "routing until it recedes (default: no limit — replica "
+             "admission control sheds instead)",
     )
     parser.add_argument(
         "--quantize", choices=["int8"], default=None,
@@ -456,19 +684,38 @@ def main(argv=None) -> None:
         jax.profiler.start_server(args.profiler_port)
         _log.info("jax profiler server on port %d", args.profiler_port)
 
-    if not args.checkpoint and not args.demo_iris:
-        parser.error("need --checkpoint or --demo-iris")
-    if args.kv_tier_disk_dir and not args.kv_tier_bytes:
-        # Validate BEFORE the --workers supervisor forks: the same
-        # mis-pair must be equally loud in both modes (the engine
-        # would reject it anyway, but only inside each worker).
-        parser.error("--kv-tier-disk-dir requires --kv-tier-bytes > 0")
-    ckpt = args.checkpoint or _demo_iris_checkpoint()
-
     import os
     import sys
 
+    # A router over external replicas spawns no engine of its own —
+    # the only mode that needs no checkpoint.
+    router_external = args.router and bool(
+        args.replica_urls or os.environ.get("MLAPI_TPU_REPLICAS")
+    )
+    if not args.checkpoint and not args.demo_iris and not router_external:
+        parser.error("need --checkpoint or --demo-iris")
+    if args.kv_tier_disk_dir and not args.kv_tier_bytes:
+        # Validate BEFORE a topology supervisor forks: the same
+        # mis-pair must be equally loud in every mode (the engine
+        # would reject it anyway, but only inside each child).
+        parser.error("--kv-tier-disk-dir requires --kv-tier-bytes > 0")
+    if args.router and args.workers > 1:
+        parser.error(
+            "--router and --workers are different topologies (distinct "
+            "ports with affinity vs one shared port); pick one"
+        )
+    if router_external:
+        ckpt = args.checkpoint
+    else:
+        ckpt = args.checkpoint or _demo_iris_checkpoint()
+
     is_worker = os.environ.get("MLAPI_TPU_WORKER") == "1"
+    is_replica = os.environ.get("MLAPI_TPU_REPLICA") == "1"
+    if args.router and not is_replica:
+        if args.port == 0 and not router_external:
+            parser.error("--router needs an explicit --port (replica "
+                         "ports derive from it: --port+1..N)")
+        sys.exit(_supervise_router(ckpt, args))
     if args.workers > 1 and not is_worker:
         if args.port == 0:
             parser.error("--workers needs an explicit --port "
@@ -483,8 +730,10 @@ def main(argv=None) -> None:
     # a global mesh. NOT in --workers children: the SO_REUSEPORT pool
     # is single-host CPU scale-out and every child inherits the SAME
     # PROCESS_ID — N workers claiming one rendezvous slot would wedge
-    # the pool (a worker is a replica, not a pod rank).
-    if not is_worker:
+    # the pool (a worker is a replica, not a pod rank). Same for
+    # --router replica children: the HTTP replica set is its OWN
+    # discovery plane ($MLAPI_TPU_REPLICAS), not pod ranks.
+    if not is_worker and not is_replica:
         from mlapi_tpu.parallel import initialize_from_env
 
         initialize_from_env()

@@ -639,10 +639,19 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             getattr(engine, "draining", False)
             or (batcher is not None and batcher.draining)
         )
+        depth = (
+            batcher.queue_depth if batcher is not None
+            else getattr(engine, "queue_depth", 0)
+        )
         return {
             # "draining" the moment shutdown begins: the load balancer
             # stops routing here while in-flight streams finish.
             "status": "draining" if draining else "ok",
+            # Backpressure in the SAME poll the router/balancer already
+            # makes for liveness (its threshold check still scrapes the
+            # authoritative /metrics gauges on the poll cadence; this
+            # rides along for one-shot dashboards and humans).
+            "queue_depth": depth,
             "model": type(engine.model).__name__,
             "classes": list(engine.vocab.labels),
             "checkpoint": engine.meta,
@@ -692,6 +701,13 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             )
             snap["counters"]["generate.prefix_fallbacks"] = (
                 engine.prefix_fallbacks
+            )
+            # Cold prefix prefills (distinct from misses, which a tier
+            # restore also moves): the counter the router's affinity
+            # A/B is pinned against — fleet-summed builds stay at one
+            # per distinct prefix under affinity routing.
+            snap["counters"]["generate.prefix_builds"] = (
+                engine.prefix_builds
             )
             snap["counters"]["generate.prefill_chunks"] = (
                 engine.prefill_chunks

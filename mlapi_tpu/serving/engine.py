@@ -1339,6 +1339,16 @@ class TextGenerationEngine:
     def prefix_fallbacks(self) -> int:
         return self.prefix.fallbacks
 
+    @property
+    def prefix_builds(self) -> int:
+        """Actual cold prefills (``_build`` ran): the counter the
+        router's prefix-affinity claim is asserted against — affinity
+        keeps repeated prefixes on one replica, so the fleet-wide sum
+        of ``builds`` stays at one per distinct prefix instead of one
+        per (prefix, replica) pair. Tier restores move ``misses`` but
+        never this."""
+        return self.prefix.builds
+
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
                 prefix: str | None = None,

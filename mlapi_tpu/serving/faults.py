@@ -56,6 +56,14 @@ POINTS = (
     "stream_push",     # a token chunk entering a request's queue
     "tier_spill",      # KV tier: registering an evicted prefix blob
     "tier_restore",    # KV tier: applying a blob back to device
+    # The router↔replica hop (serving/router.py): fires once per
+    # forward attempt BEFORE the first request byte is written (a
+    # raise there triggers the single failover hop with no duplicate
+    # generation) and once per relayed stream chunk (a raise there
+    # must yield a well-formed error terminal frame, never a
+    # truncated stream). Call counts are shared across both seams —
+    # ``after=N`` skips the submits to target the relay.
+    "router_forward",
 )
 
 ENV_VAR = "MLAPI_FAULTS"

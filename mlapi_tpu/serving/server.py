@@ -30,6 +30,7 @@ _STATUS_PHRASES = {
     422: "Unprocessable Entity", 429: "Too Many Requests",
     431: "Request Header Fields Too Large",
     500: "Internal Server Error", 501: "Not Implemented",
+    502: "Bad Gateway",  # the router's upstream-replica failure
     503: "Service Unavailable", 504: "Gateway Timeout",
 }
 

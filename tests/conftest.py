@@ -51,6 +51,13 @@ def pytest_configure(config):
         "them: pytest -m 'not heavy' (~8 min serial vs ~10.5 full — "
         "measured times in README). CI and tier-1 run the full suite.",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute spawned-process drills (e.g. the --router "
+        "SIGTERM/respawn topology test) excluded from the tier-1 "
+        "window by its time budget (-m 'not slow'); run explicitly "
+        "with pytest -m slow.",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

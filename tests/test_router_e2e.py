@@ -1,0 +1,579 @@
+"""Scale-out serving end to end: REAL generative engines behind the
+prefix-affinity router.
+
+Two layers:
+
+- **In-process fleet** (tier-1): two full ``TextGenerationEngine``
+  replicas, each behind its own real-socket HTTP server, fronted by
+  the router on a third socket — the complete relay path over real
+  chunked HTTP. Pins: streams byte-identical router-vs-direct
+  (including the deadline and drain terminal frames), affinity
+  measurably beating forced round-robin on the prefix-cache counters
+  (``PrefixCache.builds`` — asserted from counters, never
+  wall-clock), and drain redistribution without remapping the
+  healthy replica's affinity slice.
+- **Spawned-process CLI topology** (``slow`` — outside the tier-1
+  window's time budget; the chaos-drill profile runs it):
+  ``--router --replicas 2`` spawns real replica processes, SIGTERM
+  to one flips it draining, the router observes via the cached
+  health poll, in-flight streams finish, and the supervisor
+  respawns it back to a 2-live fleet.
+"""
+
+import asyncio
+import json
+import socket
+
+import httpx
+import jax
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving import faults
+from mlapi_tpu.serving.app import build_app
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.router import Router, build_router_app, hrw_order
+from mlapi_tpu.serving.server import Server
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+# Same tiny config as test_robustness: identical programs, one shared
+# in-process compile.
+CFG = dict(
+    vocab_size=260,
+    hidden_size=16,
+    num_layers=1,
+    num_heads=2,
+    max_positions=96,
+    compute_dtype="float32",
+)
+
+_MODEL = get_model("gpt_lm", **CFG)
+_PARAMS = _MODEL.init(jax.random.key(0))
+
+
+def _engine(**kw) -> TextGenerationEngine:
+    kw.setdefault("chunk", 4)
+    kw.setdefault("fused_single", False)
+    return TextGenerationEngine(
+        _MODEL, _PARAMS, tokenizer=ByteTokenizer(), **kw
+    )
+
+
+class _Fleet:
+    """Two real engine replicas on real sockets + a router front."""
+
+    def __init__(self):
+        self.engines: list[TextGenerationEngine] = []
+        self.servers: list[Server] = []
+        self.front: Server | None = None
+        self.router: Router | None = None
+
+    async def start(self, n: int = 2, **router_kw):
+        for _ in range(n):
+            eng = _engine()
+            # Deadlines must reach the engine (the terminal-frame
+            # relay pin), so admission control cannot shed them at
+            # the door first.
+            srv = Server(
+                build_app(eng, admission_control=False),
+                host="127.0.0.1", port=0,
+            )
+            await srv.start()
+            self.engines.append(eng)
+            self.servers.append(srv)
+        self.router = Router(
+            [("127.0.0.1", s.port) for s in self.servers], **router_kw
+        )
+        self.front = Server(
+            build_router_app(self.router), host="127.0.0.1", port=0
+        )
+        await self.front.start()
+        return self
+
+    def engine_for(self, replica) -> TextGenerationEngine:
+        return self.engines[
+            [s.port for s in self.servers].index(replica.port)
+        ]
+
+    def prefix_preferring(self, replica, tag: str) -> str:
+        """A prefix string whose HRW top choice is ``replica`` — the
+        deterministic way to aim traffic in these tests."""
+        names = [r.name for r in self.router.replicas]
+        for i in range(1000):
+            p = f"{tag} system prompt {i}"
+            key = p.encode()[: self.router.affinity_prefix_bytes]
+            if hrw_order(key, names)[0] == replica.name:
+                return p
+        raise AssertionError("no preferring prefix found in 1000 tries")
+
+    async def stop(self):
+        if self.front is not None:
+            await self.front.stop()
+        for s in self.servers:
+            await s.stop()
+
+
+@pytest.fixture
+async def fleet():
+    f = await _Fleet().start()
+    yield f
+    await f.stop()
+
+
+def _url(port: int) -> str:
+    return f"http://127.0.0.1:{port}"
+
+
+async def test_streams_byte_identical_router_vs_direct(fleet):
+    """The relay contract: an NDJSON stream through the router is
+    byte-for-byte the stream a direct client of the replica sees —
+    token frames, the done frame, and the deadline terminal frame."""
+    payload = {
+        "text": "the quick brown fox", "max_new_tokens": 10, "stream": True,
+    }
+    pref = fleet.router.choose(
+        fleet.router.routing_key(json.dumps(payload).encode())
+    )
+    async with httpx.AsyncClient(timeout=60.0) as c:
+        direct = await c.post(f"http://{pref.name}/generate", json=payload)
+        via = await c.post(
+            _url(fleet.front.port) + "/generate", json=payload
+        )
+        assert direct.status_code == via.status_code == 200
+        assert via.content == direct.content
+        assert via.headers["content-type"] == direct.headers["content-type"]
+        frames = [json.loads(ln) for ln in via.content.splitlines()]
+        assert frames[-1]["done"] is True and frames[-1]["token_ids"]
+
+        # Unary parity too (same engine state, deterministic greedy).
+        unary = dict(payload, stream=False)
+        d2 = await c.post(f"http://{pref.name}/generate", json=unary)
+        v2 = await c.post(_url(fleet.front.port) + "/generate", json=unary)
+        assert v2.content == d2.content
+
+        # Deadline terminal frame: an already-expired budget dies at
+        # the first dispatch boundary (queued) on both paths — the
+        # in-band error frame must relay byte-for-byte.
+        dl = dict(payload, deadline_ms=0.001)
+        d3 = await c.post(f"http://{pref.name}/generate", json=dl)
+        v3 = await c.post(_url(fleet.front.port) + "/generate", json=dl)
+        assert v3.content == d3.content
+        last = json.loads(v3.content.splitlines()[-1])
+        assert last["code"] == "deadline_exceeded"
+
+
+async def test_drain_terminal_frame_relays_byte_for_byte(fleet):
+    """A replica draining mid-stream ends the relayed stream with the
+    replica's own DrainCancelled frame, byte-for-byte — the router
+    adds nothing and truncates nothing."""
+    payload = {"text": "drain me", "max_new_tokens": 64, "stream": True}
+    pref = fleet.router.choose(
+        fleet.router.routing_key(json.dumps(payload).encode())
+    )
+    eng = fleet.engine_for(pref)
+    lines: list[bytes] = []
+    # Slow each decode dispatch so the stream is still mid-flight when
+    # the drain lands (the tiny model would otherwise finish all 64
+    # tokens before the first relayed line is even consumed).
+    with faults.active("decode:delay=0.05"):
+        async with httpx.AsyncClient(timeout=60.0) as c:
+            async with c.stream(
+                "POST", _url(fleet.front.port) + "/generate", json=payload
+            ) as resp:
+                assert resp.status_code == 200
+                drained = False
+                async for ln in resp.aiter_lines():
+                    lines.append(ln.encode())
+                    if not drained:
+                        # First chunk arrived: the stream is in
+                        # flight. Drain with a tiny budget so it
+                        # cancels NOW.
+                        drained = True
+                        await eng.drain(0.05)
+    # The exact frame a direct client sees (serving/app.py builds it
+    # from the DrainCancelled exception with fixed text).
+    assert lines[-1] == (
+        b'{"error": "server draining: generation cancelled", '
+        b'"code": "draining"}'
+    )
+    for ln in lines:
+        json.loads(ln)  # nothing truncated mid-line
+
+
+async def test_affinity_beats_round_robin_on_prefix_counters(fleet):
+    """The cache-economics claim, from counters only: with affinity
+    routing the fleet pays ONE cold prefill per distinct prefix; with
+    forced round-robin every replica pays its own. Asserted on
+    ``PrefixCache.builds`` in-process and on the exported
+    ``generate.prefix_builds`` /metrics counter."""
+    eps = [("127.0.0.1", s.port) for s in fleet.servers]
+
+    async def drive(policy: str, prefixes: list[str]) -> Router:
+        router = Router(eps, policy=policy)
+        front = Server(build_router_app(router), host="127.0.0.1", port=0)
+        await front.start()
+        try:
+            async with httpx.AsyncClient(timeout=60.0) as c:
+                for p in prefixes:
+                    for _ in range(2):  # each prefix arrives twice
+                        r = await c.post(
+                            _url(front.port) + "/generate",
+                            json={
+                                "text": " go", "prefix": p,
+                                "max_new_tokens": 2,
+                            },
+                        )
+                        assert r.status_code == 200, r.text
+        finally:
+            await front.stop()
+        return router
+
+    builds0 = [e.prefix_builds for e in fleet.engines]
+    aff = await drive(
+        "affinity", [f"affinity shared prompt {i}" for i in range(4)]
+    )
+    builds1 = [e.prefix_builds for e in fleet.engines]
+    rr = await drive(
+        "round_robin", [f"rr shared prompt {i}" for i in range(4)]
+    )
+    builds2 = [e.prefix_builds for e in fleet.engines]
+
+    aff_builds = sum(builds1) - sum(builds0)
+    rr_builds = sum(builds2) - sum(builds1)
+    # Affinity: one cold build per distinct prefix, fleet-wide; the
+    # second arrival is a warm hit on the SAME replica.
+    assert aff_builds == 4, (builds0, builds1)
+    assert aff.affinity_hits == 8
+    assert aff.affinity_fallbacks == 0
+    # Round-robin: the second arrival lands on the OTHER replica,
+    # which pays the prefill again — 2x the cold builds.
+    assert rr_builds == 8, (builds1, builds2)
+    assert rr_builds > aff_builds
+    # And the counter is exported per replica for the bench to scrape.
+    async with httpx.AsyncClient() as c:
+        snaps = [
+            (await c.get(_url(s.port) + "/metrics")).json()
+            for s in fleet.servers
+        ]
+    assert [
+        s["counters"]["generate.prefix_builds"] for s in snaps
+    ] == builds2
+    # Prefix hits happened only where builds were avoided.
+    assert sum(
+        s["counters"]["generate.prefix_hits"] for s in snaps
+    ) >= 4
+
+
+async def test_drain_redistributes_without_remapping(fleet):
+    """One replica drains: the router's cached health poll observes
+    it, new work for its slice falls back to the live replica, the
+    live replica's OWN affinity slice never moves (HRW no-remap), and
+    nothing needs a failover (the poll catches it before a connect
+    does)."""
+    router = Router(
+        [("127.0.0.1", s.port) for s in fleet.servers],
+        health_poll_s=0.05,
+    )
+    front = Server(build_router_app(router), host="127.0.0.1", port=0)
+    await front.start()
+    try:
+        victim, survivor = router.replicas
+        fleet.router = router  # prefix_preferring reads router state
+        vic_prefix = fleet.prefix_preferring(victim, "victim")
+        sur_prefix = fleet.prefix_preferring(survivor, "survivor")
+        vic_eng = fleet.engine_for(victim)
+        sur_eng = fleet.engine_for(survivor)
+
+        async with httpx.AsyncClient(timeout=60.0) as c:
+            async def gen(prefix):
+                r = await c.post(
+                    _url(front.port) + "/generate",
+                    json={
+                        "text": " go", "prefix": prefix,
+                        "max_new_tokens": 2,
+                    },
+                )
+                assert r.status_code == 200, r.text
+            # Warm both slices: each lands on its preferred replica.
+            await gen(vic_prefix)
+            await gen(sur_prefix)
+            assert vic_eng.requests == 1 and sur_eng.requests == 1
+
+            # Drain the victim; the poll (50 ms cadence) must flip it.
+            await vic_eng.drain(0.05)
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if router.replicas[0].state == "draining":
+                    break
+            assert router.replicas[0].state == "draining"
+
+            hits_before = router.affinity_hits
+            # The victim's slice redistributes to the survivor...
+            await gen(vic_prefix)
+            # ...and the survivor's own slice stays put (no remap).
+            await gen(sur_prefix)
+        assert vic_eng.requests == 1          # no new work while draining
+        assert sur_eng.requests == 3
+        assert router.affinity_hits == hits_before + 1  # survivor's key
+        assert router.affinity_fallbacks >= 1           # victim's key
+        assert router.failovers == 0  # the poll caught it, not a failure
+    finally:
+        await front.stop()
+        await router.stop()
+
+
+async def test_router_faults_conserve_replica_pages():
+    """The acceptance sweep for the router↔replica hop on PAGED
+    replicas: ``router_forward`` raise at submit, raise mid-stream,
+    and delay — every stream ends in a terminal frame, and the
+    replicas' page refcounts return to baseline (no request that
+    died on the hop may leak its KV pages)."""
+    engines = [
+        _engine(kv_page_size=8, kv_pages=24) for _ in range(2)
+    ]
+    servers = []
+    for eng in engines:
+        srv = Server(
+            build_app(eng, admission_control=False),
+            host="127.0.0.1", port=0,
+        )
+        await srv.start()
+        servers.append(srv)
+    router = Router([("127.0.0.1", s.port) for s in servers])
+    front = Server(build_router_app(router), host="127.0.0.1", port=0)
+    await front.start()
+    try:
+        async with httpx.AsyncClient(timeout=60.0) as c:
+            for spec in (
+                "router_forward:raise",           # at submit → failover
+                "router_forward:after=1:raise",   # mid-stream → frame
+                "router_forward:delay=0.01",      # slows, never breaks
+            ):
+                with faults.active(spec):
+                    r = await c.post(
+                        _url(front.port) + "/generate",
+                        json={
+                            "text": "fault sweep", "stream": True,
+                            "max_new_tokens": 8,
+                        },
+                    )
+                assert r.status_code == 200, (spec, r.text)
+                frames = [
+                    json.loads(ln)
+                    for ln in r.content.decode().strip().splitlines()
+                ]
+                # Always a terminal frame: the replica's done frame,
+                # or the router's upstream_error frame (mid-stream
+                # raise tears the upstream connection).
+                assert (
+                    frames[-1].get("done") is True
+                    or frames[-1].get("code") == "upstream_error"
+                ), (spec, frames[-1])
+        # Page conservation on every replica: cancelled/faulted relays
+        # release their rows' pages like any client disconnect.
+        for eng in engines:
+            for _ in range(100):
+                if eng.kv_pages_in_use == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.kv_pages_in_use == 0
+            assert int(eng.pool.ref[1:].sum()) == 0
+        # And the fleet serves fresh work afterward.
+        async with httpx.AsyncClient(timeout=60.0) as c:
+            ok = await c.post(
+                _url(front.port) + "/generate",
+                json={"text": "after the sweep", "max_new_tokens": 4},
+            )
+        assert ok.status_code == 200 and ok.json()["token_ids"]
+    finally:
+        await front.stop()
+        for s in servers:
+            await s.stop()
+
+
+# ---------------------------------------------------------------------------
+# The spawned-process CLI topology (slow profile: real processes, real
+# SIGTERM, supervisor respawn — minutes, not tier-1 seconds).
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+@pytest.mark.heavy
+def test_cli_router_topology_sigterm_drain(tmp_path):
+    """The full ``--router`` lifecycle as processes: spawn, health,
+    affinity serving, SIGTERM-drain of one replica observed via the
+    router's poll, in-flight stream completion, and supervisor
+    respawn back to a 2-live fleet."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from mlapi_tpu.checkpoint import save_checkpoint
+
+    ck = tmp_path / "gpt_ck"
+    save_checkpoint(
+        ck, _PARAMS, step=1,
+        config={
+            "model": "gpt_lm", "model_kwargs": CFG,
+            "tokenizer": ByteTokenizer().fingerprint(),
+        },
+    )
+    port = _free_port()
+    env = dict(
+        os.environ, MLAPI_TPU_PLATFORM="cpu", MLAPI_TPU_WARMUP="minimal",
+    )
+    sup = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlapi_tpu.serving",
+            "--checkpoint", str(ck), "--port", str(port),
+            "--router", "--replicas", "2",
+            "--health-poll-s", "0.2", "--drain-timeout-s", "8",
+            "--no-admission-control",
+        ],
+        env=env,
+    )
+
+    def get(p, path, timeout=5.0):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{p}{path}", timeout=timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def post(p, path, body, timeout=60.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p}{path}",
+            data=json.dumps(body).encode(),
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        # Both replicas polled live behind the router.
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                pytest.fail(f"supervisor died rc={sup.returncode}")
+            try:
+                h = get(port, "/healthz", timeout=2)
+                if h.get("status") == "ok" and h.get("replicas_live") == 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        else:
+            pytest.fail("router fleet never became healthy")
+
+        # Serving through the router works; repeated prefixes affine.
+        status, out = post(
+            port, "/generate",
+            {"text": "the quick", "prefix": "cli sys", "max_new_tokens": 4},
+        )
+        assert status == 200 and out["token_ids"]
+
+        # Aim a stream at a KNOWN replica, then SIGTERM that replica
+        # mid-stream: drain must let the stream finish.
+        names = [f"127.0.0.1:{port + 1}", f"127.0.0.1:{port + 2}"]
+        victim_name = None
+        vic_prefix = None
+        for i in range(1000):
+            p = f"drill prompt {i}"
+            if hrw_order(p.encode()[:64], names)[0] == names[0]:
+                victim_name, vic_prefix = names[0], p
+                break
+        assert victim_name is not None
+        victim_port = port + 1
+        victim_pid = get(victim_port, "/healthz")["pid"]
+
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        body = json.dumps(
+            {
+                "text": " run", "prefix": vic_prefix,
+                "max_new_tokens": 48, "stream": True,
+            }
+        )
+        conn.request(
+            "POST", "/generate", body,
+            {"content-type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = resp.readline()  # at least one frame in flight
+        assert first.strip()
+        os.kill(victim_pid, signal.SIGTERM)
+        rest = resp.read()  # drain lets the stream run to completion
+        conn.close()
+        lines = (first + rest).decode().strip().splitlines()
+        frames = [json.loads(ln) for ln in lines]
+        assert frames[-1].get("done") is True, frames[-1]
+
+        # The router observed the drain/death and kept serving: the
+        # victim's slice redistributes (same prefix, still 200).
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            h = get(port, "/healthz")
+            if h["replicas_live"] < 2 or h["replicas_draining"] > 0:
+                break
+            time.sleep(0.3)
+        status, out = post(
+            port, "/generate",
+            {"text": " go", "prefix": vic_prefix, "max_new_tokens": 4},
+        )
+        assert status == 200 and out["token_ids"]
+
+        # The supervisor respawns the dead replica; the poll folds it
+        # back in (fresh engine boot: generous deadline).
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                h = get(port, "/healthz", timeout=2)
+                if h.get("replicas_live") == 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        else:
+            pytest.fail("drained replica never respawned to live")
+
+        # Aggregated metrics carry the story: summed engine counters
+        # plus router counters. (The respawned replica's counters
+        # restarted from zero with its process — aggregation sums
+        # what the CURRENT fleet reports, so only the survivor's
+        # traffic is guaranteed visible.)
+        m = get(port, "/metrics")
+        assert m["counters"]["router.forwarded"] >= 3
+        assert m["counters"]["generate.requests"] >= 1
+        assert m["counters"]["router.affinity_hits"] >= 1
+        assert m["gauges"]["router.replicas_live"] == 2
+    finally:
+        sup.send_signal(signal.SIGTERM)
+        try:
+            sup.wait(30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+            sup.wait(10)
