@@ -1009,6 +1009,149 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _sched_report(ck: str, env: dict) -> dict:
+    """Subprocess: continuous-batching scheduler v2 on the SAME
+    checkpoint (BENCH_GEN_SCHED=1). Claim classes per the variance
+    rule:
+
+    - **Interleaving — counter-asserted.** With the scheduler on, a
+      window-incompatible arrival runs as a SECOND live batch with
+      its units interleaved (``sched_batches_live_max == 2``,
+      ``sched_units_*`` moving); off, it waits for the running batch
+      (all sched counters 0). Greedy streams asserted IDENTICAL
+      between modes, in-subprocess — the structural consequence of
+      both modes draining the same unit generator.
+    - **Incompatible-arrival TTFT + running-stream inter-token —
+      measured, alternated inside ONE window.** The workload legacy
+      handles worst: a long-budget stream occupies the engine and a
+      bucket-incompatible request arrives behind it. Scheduler-off
+      it waits out most of the run (carry/late admission);
+      scheduler-on it lanes immediately. The long stream's own
+      inter-token gap is the cost side of the trade and is reported
+      alongside (both subject to VARIANCE_NOTE on this box).
+    """
+    src = f"""
+import asyncio, json, time
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.text import ByteTokenizer
+
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+# buckets (16, 64): the 100-char prompt lands in a 128-wide bucket,
+# and 128 + 136 > 256 = max_positions makes the pair window-
+# incompatible — the shape legacy serves worst (carry / very late
+# admission) and the scheduler serves as a second concurrent lane.
+kw = dict(tokenizer=tok, chunk=8, fused_single=False,
+          kv_page_size=16, prompt_buckets=(16, 64), max_wait_ms=0.0)
+LONG_N, SHORT_N = 136, 8
+report = {{}}
+
+async def collect(r, stamps=None):
+    out = []
+    while True:
+        item = await r.queue.get()
+        if item is None:
+            return out
+        if isinstance(item, Exception):
+            raise item
+        if stamps is not None:
+            stamps.append((time.perf_counter(), len(item["token_ids"])))
+        out.extend(item["token_ids"])
+
+async def one_round(eng):
+    stamps = []
+    ra = await eng.submit("warm me up", max_new_tokens=LONG_N,
+                          stream=True)
+    t0 = time.perf_counter()
+    rb = await eng.submit("y" * 100, max_new_tokens=SHORT_N,
+                          stream=True)
+    first_b = asyncio.create_task(rb.queue.get())
+    a_task = asyncio.create_task(collect(ra, stamps))
+    fb = await first_b
+    if isinstance(fb, Exception):
+        raise fb
+    ttft_b = (time.perf_counter() - t0) * 1e3
+    out_b = list(fb["token_ids"])
+    while True:
+        item = await rb.queue.get()
+        if item is None:
+            break
+        if isinstance(item, Exception):
+            raise item
+        out_b.extend(item["token_ids"])
+    out_a = await a_task
+    gaps = [
+        (stamps[i][0] - stamps[i - 1][0]) * 1e3 / max(1, stamps[i][1])
+        for i in range(1, len(stamps))
+    ]
+    return ttft_b, gaps, (out_a, out_b)
+
+async def measure():
+    engines = {{}}
+    for mode in (True, False):
+        engines[mode] = TextGenerationEngine(
+            model, params, scheduler=mode, sched_max_batches=2, **kw)
+        await engines[mode].start()
+    try:
+        ref = {{}}
+        for mode in (True, False):     # compile round, off the clock
+            _, _, ref[mode] = await one_round(engines[mode])
+        assert ref[True] == ref[False]  # streams identical on vs off
+        ts = {{True: ([], []), False: ([], [])}}
+        for _ in range(4):              # alternated: ONE window
+            for mode in (True, False):
+                ttft, gaps, outs = await one_round(engines[mode])
+                assert outs == ref[mode], mode
+                ts[mode][0].append(ttft)
+                ts[mode][1].extend(gaps)
+        return engines, ts
+    finally:
+        for e in engines.values():
+            await e.stop()
+
+engines, ts = asyncio.run(measure())
+on, off = engines[True], engines[False]
+# Counter-asserted concurrency (never wall-clock): the incompatible
+# arrival ran as a second live batch with units interleaved.
+assert on.sched_batches_live_max == 2, on.sched_batches_live_max
+assert on.sched_units_decode > 0 and on.sched_units_prefill > 0
+assert off.sched_units_decode == 0 and off.sched_batches_live_max == 0
+q = lambda xs, f: round(sorted(xs)[min(len(xs) - 1,
+                                       int(f * len(xs)))], 2)
+report["sched_on_incompat_ttft_p50_ms"] = q(ts[True][0], 0.5)
+report["sched_on_incompat_ttft_p95_ms"] = q(ts[True][0], 0.95)
+report["sched_off_incompat_ttft_p50_ms"] = q(ts[False][0], 0.5)
+report["sched_off_incompat_ttft_p95_ms"] = q(ts[False][0], 0.95)
+report["sched_on_intertoken_p50_ms"] = q(ts[True][1], 0.5)
+report["sched_on_intertoken_p95_ms"] = q(ts[True][1], 0.95)
+report["sched_off_intertoken_p50_ms"] = q(ts[False][1], 0.5)
+report["sched_off_intertoken_p95_ms"] = q(ts[False][1], 0.95)
+report["sched_units"] = dict(
+    prefill=on.sched_units_prefill, decode=on.sched_units_decode,
+    spec=on.sched_units_spec, admit=on.sched_units_admit,
+    compact=on.sched_units_compact)
+report["sched_batches_live_max"] = on.sched_batches_live_max
+report["sched_streams_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"sched_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def _router_report(ck: str, env: dict) -> dict:
     """Scale-out router block (``BENCH_GEN_ROUTER=1``): TWO real
     engine replica processes on the SAME checkpoint behind the
@@ -1376,8 +1519,15 @@ def bench_generate() -> None:
                     "generate.kv_prefix_restore_",
                     "generate.kv_prefix_spill_",
                     "generate.kv_tier_", "generate.kv_entry_",
+                    # Scheduler v2 (r15): per-unit-type dispatch
+                    # counters — all zero with --scheduler off, the
+                    # interleaving evidence with it on.
+                    "generate.sched_",
                 ))
             })
+            pool_g["sched_batches_live_max"] = after.get(
+                "gauges", {}
+            ).get("generate.sched_batches_live_max", 0)
             pool_g["draining"] = after.get("gauges", {}).get(
                 "generate.draining", 0
             )
@@ -1427,6 +1577,12 @@ def bench_generate() -> None:
             # both cache formats, restore-hit vs cold-prefill TTFT
             # alternated in one window.
             kv_extras.update(_tier_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_SCHED") == "1":
+            # Scheduler v2: incompatible-arrival TTFT + running-stream
+            # inter-token, scheduler on vs off alternated in one
+            # window; interleaving asserted from sched_* counters and
+            # streams asserted identical in-subprocess.
+            kv_extras.update(_sched_report(ck, server_env))
         if os.environ.get("BENCH_GEN_ROUTER") == "1":
             # Scale-out router: 2 engine replicas, repeated-prefix
             # workload, affinity vs forced round-robin alternated in

@@ -154,6 +154,10 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
         cmd += ["--no-prefill-interleave"]
+    if getattr(args, "scheduler", False):
+        cmd += ["--scheduler",
+                "--sched-max-batches",
+                str(getattr(args, "sched_max_batches", 2))]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -600,6 +604,28 @@ def main(argv=None) -> None:
              "own batch",
     )
     parser.add_argument(
+        "--scheduler", action=argparse.BooleanOptionalAction,
+        default=False,
+        help="continuous-batching scheduler v2: run up to "
+             "--sched-max-batches decode batches CONCURRENTLY, "
+             "interleaved at typed-unit granularity (prefill chunk / "
+             "decode chunk / spec round / admission / compaction) on "
+             "one device stream, prioritized by deadline slack with "
+             "TTFT/inter-token targets fed from the live latency "
+             "reservoirs — bucket-incompatible traffic no longer "
+             "waits out the running batch. Greedy streams are pinned "
+             "token-identical scheduler-on vs off. Watch "
+             "generate.sched_units_* / sched_batches_live on "
+             "/metrics. Generative checkpoints only",
+    )
+    parser.add_argument(
+        "--sched-max-batches", type=int, default=2,
+        help="with --scheduler: how many batches may be live at once "
+             "(lanes). Paged engines additionally gate new lanes on "
+             "the pool's free-page budget "
+             "(generate.sched_pages_deferred counts waits)",
+    )
+    parser.add_argument(
         "--draft-checkpoint", default=None,
         help="speculative decoding: a smaller same-tokenizer "
              "checkpoint whose proposals the target verifies in one "
@@ -781,6 +807,8 @@ def main(argv=None) -> None:
         kv_tier_disk_dir=args.kv_tier_disk_dir,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
+        scheduler=args.scheduler,
+        sched_max_batches=args.sched_max_batches,
         mesh=mesh,
         fused_batch={"auto": "auto", "on": True, "off": False}[
             args.fused_batch

@@ -66,6 +66,39 @@ def _validate_deadline_ms(value) -> None:
         )
 
 
+def _is_router_replica() -> bool:
+    """Is this server a router replica (the only deployment where a
+    trusted party stamps ``x-mlapi-router-depth``)? Spawned replicas
+    carry ``MLAPI_TPU_REPLICA=1``; externally-launched fleets export
+    ``MLAPI_TPU_REPLICAS`` (the same discovery convention the router
+    reads). A non-replica server IGNORES the header outright — an
+    arbitrary client must not be able to inject fleet pressure into
+    admission control / the brownout ladder. (The router additionally
+    strips client-sent copies on its forward path, so within a fleet
+    only the router's own value ever arrives.)"""
+    import os
+
+    return os.environ.get("MLAPI_TPU_REPLICA") == "1" or bool(
+        os.environ.get("MLAPI_TPU_REPLICAS")
+    )
+
+
+def _router_depth(request) -> int:
+    """The fleet-backlog gauge a fronting router stamps on forwarded
+    requests (``x-mlapi-router-depth``; 0 for direct traffic — a
+    stale fleet spike must not keep shedding after the router is
+    gone). Scans the raw ASGI header list for the one key instead of
+    decoding the full header dict — ``/predict``'s hot path
+    deliberately never pays the lazy full-header decode."""
+    for k, v in request.scope.get("headers", []):
+        if k == b"x-mlapi-router-depth":
+            try:
+                return max(0, int(v))
+            except (TypeError, ValueError):
+                return 0
+    return 0
+
+
 def _overloaded_http(e: OverloadedError) -> HTTPError:
     """Overload → immediate 503 with a Retry-After hint. Shedding at
     the door keeps latency bounded for the requests that ARE admitted;
@@ -203,8 +236,12 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
         label: json.dumps(label).encode() for label in engine.vocab.labels
     }
 
+    is_replica = _is_router_replica()
+
     @app.post("/predict")
-    async def predict(features: schema):  # type: ignore[valid-type]
+    async def predict(features: schema, request):  # type: ignore[valid-type]
+        if is_replica:
+            batcher.router_queue_depth = _router_depth(request)
         if engine.kind == "text":
             row = engine.encode(features.text)
         elif order:
@@ -297,8 +334,15 @@ def _install_generate(app: App, engine) -> None:
         hits = [(i, s) for s in stops if (i := text.find(s)) != -1]
         return min(hits, key=lambda h: (h[0], -len(h[1])), default=None)
 
+    is_replica = _is_router_replica()
+
     @app.post("/generate")
-    async def generate(req: schema):  # type: ignore[valid-type]
+    async def generate(req: schema, request):  # type: ignore[valid-type]
+        # Router backpressure (r15): the gauge feeds the admission
+        # estimate and brownout ladder — replica deployments only
+        # (the header is untrusted from arbitrary direct callers).
+        if is_replica:
+            engine.router_queue_depth = _router_depth(request)
         n_new = (
             req.max_new_tokens
             if req.max_new_tokens is not None
@@ -684,6 +728,9 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["gauges"]["batcher.queue_depth"] = batcher.queue_depth
             snap["gauges"]["batcher.inflight"] = batcher.inflight
             snap["gauges"]["batcher.draining"] = int(batcher.draining)
+            snap["gauges"]["batcher.router_queue_depth"] = (
+                batcher.router_queue_depth
+            )
         elif engine.kind == "generative":
             snap["counters"]["generate.requests"] = engine.requests
             snap["counters"]["generate.batch_calls"] = engine.batch_calls
@@ -781,7 +828,52 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.faults_injected"] = (
                 engine.faults_injected
             )
+            # Continuous-batching scheduler v2 (r15): per-unit-type
+            # dispatch counters over the typed-unit queue — the
+            # counters the concurrency claims are asserted from
+            # (interleaving = two lanes' units both moving in one
+            # window, never wall-clock). All zero with --scheduler
+            # off. sched_units_admit is RESERVED in the taxonomy but
+            # stays 0 for now: concurrent lanes supersede the legacy
+            # mid-batch admission staging (an arrival becomes its own
+            # lane instead of scattering into a running batch), so no
+            # admit units dispatch until in-lane admission returns.
+            snap["counters"]["generate.sched_units_prefill"] = (
+                engine.sched_units_prefill
+            )
+            snap["counters"]["generate.sched_units_decode"] = (
+                engine.sched_units_decode
+            )
+            snap["counters"]["generate.sched_units_spec"] = (
+                engine.sched_units_spec
+            )
+            snap["counters"]["generate.sched_units_admit"] = (
+                engine.sched_units_admit
+            )
+            snap["counters"]["generate.sched_units_compact"] = (
+                engine.sched_units_compact
+            )
+            snap["counters"]["generate.sched_deadline_preempts"] = (
+                engine.sched_deadline_preempts
+            )
+            snap["counters"]["generate.sched_pages_deferred"] = (
+                engine.sched_pages_deferred
+            )
             snap.setdefault("gauges", {})
+            snap["gauges"]["generate.sched_queue_depth"] = (
+                engine.sched_queue_depth
+            )
+            snap["gauges"]["generate.sched_batches_live"] = (
+                engine.sched_batches_live
+            )
+            snap["gauges"]["generate.sched_batches_live_max"] = (
+                engine.sched_batches_live_max
+            )
+            # Fleet pressure the fronting router last reported
+            # (x-mlapi-router-depth; 0 for direct traffic).
+            snap["gauges"]["generate.router_queue_depth"] = (
+                engine.router_queue_depth
+            )
             snap["gauges"]["generate.draining"] = int(engine.draining)
             snap["gauges"]["generate.queue_depth"] = engine.queue_depth
             # Chunked-prefill interleaving: chunks still queued for

@@ -21,9 +21,18 @@ its own method:
                         decode boundary (paged engines; r10)
 ``_maybe_shrink``       compaction along the warmed halving chain
 ``_decode_chunk``       one chained chunk dispatch + drain policy
-``run``                 the loop: pf-activation → admission →
-                        liveness → spec re-engage → resize →
-                        pf-chunk → chunk, then terminators
+``units``               the loop AS A GENERATOR of typed schedulable
+                        units (prefill/decode/spec/admit/compact):
+                        pf-activation → admission → liveness → spec
+                        re-engage → resize → pf-chunk → chunk, then
+                        terminators — yielding after each unit of
+                        device work so the engine-level scheduler
+                        (``serving/scheduler.py``, r15) can interleave
+                        several batches' units on one device stream
+``run``                 scheduler-off entry: drain ``units()`` to
+                        exhaustion (identical code either way — the
+                        scheduler-on/off token-identity contract is
+                        structural)
 ======================  ================================================
 
 Invariants the stages share (and why the state is one object):
@@ -1518,8 +1527,26 @@ class BatchRun:
     # -- the loop -----------------------------------------------------
 
     def run(self) -> None:
+        # Scheduler-off entry: drain the unit generator to
+        # exhaustion. Scheduler-on (serving/scheduler.py) advances the
+        # SAME generator one unit at a time, interleaved with other
+        # batches' units — the two modes execute identical code, which
+        # is what makes the scheduler-on/off token-identity contract
+        # structural rather than a matter of careful duplication.
+        for _ in self.units():
+            pass
+
+    def units(self):
+        """The batch lifecycle as a stream of TYPED SCHEDULABLE UNITS:
+        yields one of ``"prefill"``, ``"decode"``, ``"spec"``,
+        ``"admit"``, ``"compact"`` after each unit of device work, so
+        an engine-level scheduler can interleave several batches'
+        units on one device stream. Cleanup/error semantics live here
+        (generator ``finally`` runs on exhaustion, raise, AND
+        ``close()``), so a scheduler that kills a lane mid-flight
+        still releases its pages."""
         try:
-            self._run()
+            yield from self._units()
         except BaseException:
             if self._pf is not None:
                 # The interleaved joiner was unstaged but never
@@ -1535,9 +1562,14 @@ class BatchRun:
             # shareable ACROSS batches.
             self._paged_cleanup()
 
-    def _run(self) -> None:
+    def _units(self):
         eng, reqs, chain = self.eng, self.reqs, self.chain
         self._spec_handoff()
+        if self.spec_eligible or self.spec_batched:
+            # The formation-time speculative phase ran (it yields
+            # internally at round boundaries when candidates or other
+            # scheduler lanes wait — engine._spec_should_yield).
+            yield "spec"
 
         if self.first_chunk is not None:
             # The deferred first token rides the chain as a width-1
@@ -1560,6 +1592,7 @@ class BatchRun:
                 # table-row assignment) before this boundary's
                 # admission/scheduling.
                 self._pf_activate()
+                yield "admit"
             # Deadline sweep at the chunk boundary: an expired row
             # gets its terminal DeadlineExceeded frame and cancels
             # exactly like a disconnect — it leaves ``live`` below,
@@ -1570,6 +1603,7 @@ class BatchRun:
             pending_n = 0
             if self.admit and eng._admit:
                 pending_n = self._admit_waiting()
+                yield "admit"
             live = [
                 i for i, r in enumerate(reqs)
                 if not self._sdone(i) and not r.cancelled
@@ -1593,8 +1627,11 @@ class BatchRun:
                 if self._pf is not None:
                     # Nothing to stall: finish the interleaved prefill
                     # back-to-back and activate its row — it becomes
-                    # the batch's only live member.
+                    # the batch's only live member. One unit: with no
+                    # live rows in THIS batch there is nothing its
+                    # chunks can stall (other lanes wait one flush).
                     self._pf_flush()
+                    yield "prefill"
                     continue
                 # Every remaining consumer disconnected, finished, or
                 # is fully covered by in-flight chunks: deliver what's
@@ -1628,6 +1665,7 @@ class BatchRun:
             ):
                 chain.invalidate()
                 self._try_spec()
+                yield "spec"
                 if self.done[0]:
                     continue
             # The final chunk may be remainder-sized: when
@@ -1643,13 +1681,19 @@ class BatchRun:
             # An active interleaved prefill suppresses compaction
             # (its row plan pins device row indices) — fold it into
             # the pending count the shrink policy already respects.
+            b_before = self.b_cur
             self._maybe_shrink(
                 live, pending_n + (1 if self._pf is not None else 0)
             )
+            if self.b_cur != b_before:
+                yield "compact"
             if self._pf is not None:
                 # At most ONE prefill-chunk dispatch ahead of this
                 # boundary's decode chunk — the interleaving bound.
+                pfc = eng.prefill_chunks
                 self._pf_step(live)
+                if eng.prefill_chunks != pfc:
+                    yield "prefill"
             if self.pool is not None:
                 # Map the chunk's write range to pool pages (and push
                 # any table change to the device mirrors) BEFORE the
@@ -1658,6 +1702,7 @@ class BatchRun:
                 self._ensure_pages(size, live)
             self._decode_chunk(size, live)
             self._pf_consec = 0
+            yield "decode"
         chain.drain()
         # Safety net: every waiter MUST get a terminator. The
         # collector/admission only group window-compatible requests,

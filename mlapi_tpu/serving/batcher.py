@@ -161,6 +161,11 @@ class MicroBatcher:
         self.inflight = 0
         self.shed_draining = 0
         self.deadline_expired = 0
+        # Fleet backlog a fronting router last stamped on a forwarded
+        # request (x-mlapi-router-depth; 0 direct) — classification
+        # replicas surface the same backpressure gauge the generative
+        # engine feeds into its admission estimate (r15).
+        self.router_queue_depth = 0
 
     @property
     def queue_depth(self) -> int:

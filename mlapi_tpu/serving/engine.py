@@ -151,6 +151,8 @@ class InferenceEngine:
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
+        scheduler: bool = False,
+        sched_max_batches: int = 2,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -348,6 +350,8 @@ class InferenceEngine:
                 prefill_interleave=prefill_interleave,
                 kv_tier_bytes=kv_tier_bytes,
                 kv_tier_disk_dir=kv_tier_disk_dir,
+                scheduler=scheduler,
+                sched_max_batches=sched_max_batches,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
@@ -357,6 +361,7 @@ class InferenceEngine:
                          if kv_page_size else {}),
                       **({"kv_tier_bytes": kv_tier_bytes}
                          if kv_tier_bytes else {}),
+                      **({"scheduler": True} if scheduler else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
@@ -371,6 +376,12 @@ class InferenceEngine:
                 "kv_tier_bytes/kv_tier_disk_dir apply to generative "
                 f"checkpoints (they cache prefix KV); "
                 f"{type(inner).__name__} has none"
+            )
+        if scheduler:
+            raise ValueError(
+                "scheduler applies to generative checkpoints (it "
+                f"interleaves decode batches); {type(inner).__name__} "
+                "has no decode loop"
             )
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
@@ -600,6 +611,8 @@ class TextGenerationEngine:
         prefill_interleave: bool = True,
         kv_tier_bytes: int = 0,
         kv_tier_disk_dir: str | None = None,
+        scheduler: bool = False,
+        sched_max_batches: int = 2,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -939,6 +952,35 @@ class TextGenerationEngine:
         self.deadline_expired_decode = 0
         self.brownout_spec_suppressed = 0
         self.brownout_tokens_clamped = 0
+        # Continuous-batching scheduler v2 (r15, serving/scheduler.py):
+        # one typed-unit queue (prefill chunk / decode chunk / spec
+        # round / admission / compaction) across up to
+        # ``sched_max_batches`` CONCURRENT BatchRuns, SLO-prioritized
+        # by deadline slack with TTFT/ITL targets fed from the
+        # LatencyStats reservoirs. Off (default): the one-live-batch
+        # collector loop, bit for bit. The scheduler object itself is
+        # created by start() and torn down by stop().
+        self.scheduler_enabled = bool(scheduler)
+        self.sched_max_batches = max(1, int(sched_max_batches))
+        self.sched = None
+        # Per-unit-type dispatch counters + queue observability
+        # (exported on /metrics as sched_*; all zero with the
+        # scheduler off).
+        self.sched_units_prefill = 0
+        self.sched_units_decode = 0
+        self.sched_units_spec = 0
+        self.sched_units_admit = 0
+        self.sched_units_compact = 0
+        self.sched_deadline_preempts = 0
+        self.sched_pages_deferred = 0
+        self.sched_batches_live_max = 0
+        # Router backpressure (r15 satellite): the fleet backlog the
+        # router observed when it forwarded the last request here
+        # (x-mlapi-router-depth, EXCLUDING this replica's own share).
+        # Feeds admission_estimate_ms and the brownout ladder so a
+        # replica sheds/degrades on FLEET pressure, not just its own
+        # queue; stays 0 without a router in front.
+        self.router_queue_depth = 0
         # TTFT / inter-token reservoirs, recorded at the push seam.
         from mlapi_tpu.serving.requests import LatencyStats
 
@@ -961,8 +1003,26 @@ class TextGenerationEngine:
     @property
     def queue_depth(self) -> int:
         base = self._queue.qsize() if self._queue is not None else 0
+        # Scheduler mode: groups the collector has formed but the
+        # scheduler has not yet laned are still WAITING work — without
+        # this term they would vanish from backpressure, admission
+        # estimates, and the router's scrape the moment the collector
+        # popped them (/healthz queue_depth must reflect the typed-unit
+        # queue, not just the submit queue).
+        sched = self.sched.backlog if self.sched is not None else 0
         with self._alock:
-            return base + len(self._admit) + len(self._deferred)
+            return base + len(self._admit) + len(self._deferred) + sched
+
+    @property
+    def sched_queue_depth(self) -> int:
+        """Typed-unit queue depth: one runnable unit per live lane
+        plus one formation unit per pending group (0, scheduler
+        off)."""
+        return self.sched.queue_depth if self.sched is not None else 0
+
+    @property
+    def sched_batches_live(self) -> int:
+        return self.sched.batches_live if self.sched is not None else 0
 
     # -- robustness: deadlines, admission control, brownout ---------------
 
@@ -995,13 +1055,20 @@ class TextGenerationEngine:
         one batch turnaround (p95 TTFT + the default token budget at
         the p95 inter-token rate), and the request then pays its own
         p95 TTFT. Returns 0 until traffic has populated the
-        reservoirs — a cold server never sheds on a guess."""
+        reservoirs — a cold server never sheds on a guess. Running as
+        a router replica, the router-scraped fleet backlog
+        (``router_queue_depth`` — everyone ELSE's queued work) rides
+        into the backlog term: affinity means a re-arriving prefix
+        cannot go elsewhere, so fleet pressure is this replica's
+        future queue wait too (ROADMAP item-3 remainder: router
+        backpressure feeding the item-1 scheduler)."""
         s = self.latency.summary()
         ttft = s["ttft_p95_ms"] or 0.0
         itl = s["intertoken_p50_ms"] or 0.0
         batch_ms = ttft + self.default_max_new_tokens * itl
         backlog = (
             self.queue_depth + self.prefill_chunk_queue_depth
+            + self.router_queue_depth
         ) / max(1, self.max_batch)
         return backlog * batch_ms + ttft
 
@@ -1010,10 +1077,14 @@ class TextGenerationEngine:
         ``max_queue`` (clamp token budgets, suppress speculation), 2
         at >= 75% (additionally evict idle prefix page sets). The
         levers degrade work per request BEFORE the queue-full shed
-        fires — Snap ML's degrade-per-tier, not fall-over-globally."""
+        fires — Snap ML's degrade-per-tier, not fall-over-globally.
+        The router-scraped fleet backlog counts as pressure too (at
+        most one local queue's worth, so a huge fleet spike engages
+        the ladder without instantly pinning every replica at rung
+        2)."""
         if not self.admission_control:
             return 0
-        q = self.queue_depth
+        q = self.queue_depth + min(self.router_queue_depth, self.max_queue)
         if q * 4 >= self.max_queue * 3:
             return 2
         if q * 2 >= self.max_queue:
@@ -1045,6 +1116,7 @@ class TextGenerationEngine:
                 and not self._carry
                 and self._running is None
                 and self._forming is None
+                and (self.sched is None or self.sched.idle)
             ):
                 return
             await asyncio.sleep(0.05)
@@ -1064,6 +1136,13 @@ class TextGenerationEngine:
         # Cancel-only (no clear) — the collector owns the list and
         # drops cancelled rows at its next formation.
         leftovers += list(self._carry)
+        if self.sched is not None:
+            # The typed-unit queue: pending groups are popped (they
+            # will never be laned), live lanes' requests are
+            # cancel-only — each lane notices at its next unit
+            # boundary exactly like a disconnect and releases its
+            # pages on the way out.
+            leftovers += self.sched.sweep_requests()
         running = self._running
         if running is not None:
             leftovers += list(running)
@@ -1084,7 +1163,10 @@ class TextGenerationEngine:
         # Give the decode thread a moment to notice the cancels and
         # finish the batch — bounded, never a hang.
         grace = loop.time() + 2.0
-        while self._running is not None and loop.time() < grace:
+        while (
+            self._running is not None
+            or (self.sched is not None and not self.sched.idle)
+        ) and loop.time() < grace:
             await asyncio.sleep(0.05)
 
     @property
@@ -1450,6 +1532,41 @@ class TextGenerationEngine:
         )
         return prompt, n_pad, temps, topk, topp, keys
 
+    def _form_batch(self, reqs: list, admit: bool,
+                    fused_ok: bool = True):
+        """The formation preamble shared by ``_run_batch``
+        (scheduler-off) and the unit scheduler's lane start — ONE
+        definition, because the scheduler-on/off identity contract
+        rests on both modes gating formation identically. Sweeps
+        queue-expired requests (terminal frame, never a device
+        dispatch), routes the fused whole-generation fast paths, and
+        returns the formed :class:`BatchRun` — or ``None`` when the
+        group fully resolved here (everyone expired, or a fused
+        program served it). Requests whose deadline passed during the
+        queue wait never reach the device; the sweep edits ``reqs``
+        in place (admission appends to this list object and error
+        delivery iterates it)."""
+        from mlapi_tpu.serving.batch_run import BatchRun
+
+        alive = [
+            r for r in reqs if not self._expire_if_due(r, "queued")
+        ]
+        if not alive:
+            return None
+        reqs[:] = alive
+        self.batch_calls += 1
+        if fused_ok and self.fused_single:
+            if (
+                len(reqs) == 1
+                and reqs[0].prefix_len == 0 and not reqs[0].stream
+                and not reqs[0].cancelled
+                and self.fused.try_run(reqs[0], admit)
+            ):
+                return None
+            if len(reqs) > 1 and self.fused.try_run_batch(reqs, admit):
+                return None
+        return BatchRun(self, reqs, admit)
+
     def _run_batch(self, reqs: list, admit: bool = False,
                    fused_ok: bool = True) -> None:
         """Serve one coalesced batch: the fused whole-generation fast
@@ -1466,34 +1583,11 @@ class TextGenerationEngine:
         Each gets the exception object; a ``None`` sentinel marks
         normal completion (pushed by the lifecycle stages).
         """
-        from mlapi_tpu.serving.batch_run import BatchRun
-
         try:
             self._running = reqs
-            # Requests whose deadline passed during the queue wait
-            # never reach the device: terminal frame now, row never
-            # formed. In place — admission appends to this list object
-            # and error delivery iterates it.
-            alive = [
-                r for r in reqs if not self._expire_if_due(r, "queued")
-            ]
-            if not alive:
-                return
-            reqs[:] = alive
-            self.batch_calls += 1
-            if fused_ok and self.fused_single:
-                if (
-                    len(reqs) == 1
-                    and reqs[0].prefix_len == 0 and not reqs[0].stream
-                    and not reqs[0].cancelled
-                    and self.fused.try_run(reqs[0], admit)
-                ):
-                    return
-                if len(reqs) > 1 and self.fused.try_run_batch(
-                    reqs, admit
-                ):
-                    return
-            BatchRun(self, reqs, admit).run()
+            run = self._form_batch(reqs, admit, fused_ok)
+            if run is not None:
+                run.run()
         except Exception as e:  # noqa: BLE001 — delivered to every waiter
             _log.error("generation batch of %d failed: %s", len(reqs), e)
             for r in reqs:
@@ -1508,6 +1602,12 @@ class TextGenerationEngine:
     async def start(self) -> None:
         if self._task is None:
             self._queue = asyncio.Queue(maxsize=self.max_queue)
+            if self.scheduler_enabled and self.sched is None:
+                from mlapi_tpu.serving.scheduler import UnitScheduler
+
+                self.sched = UnitScheduler(
+                    self, max_batches=self.sched_max_batches
+                )
             self._task = asyncio.create_task(
                 self._collect_loop(), name="genbatcher"
             )
@@ -1526,6 +1626,13 @@ class TextGenerationEngine:
                 # start() can bring up a fresh collector.
                 _log.warning("collector had died: %r", e)
             self._task = None
+        if self.sched is not None:
+            # Off the loop: stop() joins the dispatch thread, which
+            # may be mid-unit (device work takes as long as it takes).
+            sched, self.sched = self.sched, None
+            await asyncio.get_running_loop().run_in_executor(
+                None, sched.stop
+            )
         if self._queue is not None:
             while not self._queue.empty():
                 req = self._queue.get_nowait()
@@ -1537,9 +1644,16 @@ class TextGenerationEngine:
         round boundary — the handoff seam (tests patch this to force
         a deterministic mid-phase handoff; in production a joiner can
         land during the phase's first compiles, in which case
-        yielding before round one is the correct behavior)."""
+        yielding before round one is the correct behavior). Under the
+        unit scheduler, OTHER runnable lanes/pending groups end the
+        phase the same way: a spec round is one unit, and a solo
+        phase must not monopolize the dispatch thread while another
+        batch has work."""
         with self._alock:
-            return bool(self._admit)
+            if self._admit:
+                return True
+        s = self.sched
+        return s is not None and s.queue_depth > 1
 
     def _compatible(self, group: list, r) -> bool:
         """Can ``r`` join ``group`` without clamping anyone? The batch
@@ -1574,6 +1688,9 @@ class TextGenerationEngine:
         return p_len + bucket + n_new <= self.model.max_positions
 
     async def _collect_loop(self) -> None:
+        if self.sched is not None:
+            await self._collect_loop_sched()
+            return
         loop = asyncio.get_running_loop()
         # self._carry (window-incompatible leftovers, served next) is
         # initialized in __init__ and cleared in the finally below —
@@ -1726,6 +1843,154 @@ class TextGenerationEngine:
             # handler awaiting ``gen.queue.get()`` on a queued request
             # would otherwise hang forever after an unexpected
             # collector death).
+            err = RuntimeError("generation engine stopped")
+            queued = []
+            if get is not None:
+                if get.done() and not get.cancelled():
+                    queued.append(get.result())
+                else:
+                    get.cancel()
+            if self._queue is not None:
+                while not self._queue.empty():
+                    queued.append(self._queue.get_nowait())
+            with self._alock:
+                queued += self._admit + self._deferred
+                self._admit.clear()
+                self._deferred.clear()
+            for r in (*reqs, *self._carry, *queued):
+                try:
+                    r.push(err)
+                except Exception:
+                    pass
+            self._carry = []
+
+    async def _collect_loop_sched(self) -> None:
+        """The collector with the unit scheduler in front: forms
+        window-compatible groups exactly like the legacy loop but
+        NEVER blocks on a running batch — each formed group hands off
+        to :class:`~mlapi_tpu.serving.scheduler.UnitScheduler` (up to
+        ``sched_max_batches`` concurrent BatchRuns, interleaved at
+        unit granularity) and collection continues immediately, so
+        bucket-incompatible traffic runs concurrently instead of
+        taking serial ``_carry`` turns. Differences from the legacy
+        loop, on purpose:
+
+        - The ``_admit``/``_deferred`` staging lists stay empty here —
+          an arrival that would have joined a RUNNING batch forms (or
+          joins) a new group and the scheduler interleaves the
+          batches' units (so ``sched_units_admit`` reads 0: reserved
+          in the taxonomy until in-lane admission returns). The
+          window-fill and terminal-frame sweep below deliberately
+          MIRROR the legacy loop line for line rather than sharing a
+          helper: the wait/cancel dance's race comments there are
+          load-bearing, and only the legacy loop multiplexes the pop
+          against a running batch future — keep the two in sync when
+          touching either.
+        - The carry seed is picked by DEADLINE SLACK, not FIFO — the
+          r12 ``_carry[0]`` head-of-line fix: a tight-deadline
+          window-incompatible request no longer waits behind every
+          earlier carried one.
+        - Backpressure: the scheduler's pending backlog is bounded at
+          one ``max_batch`` like ``_admit`` was, so ``max_queue``
+          keeps meaning something during long runs."""
+        loop = asyncio.get_running_loop()
+        reqs: list = []
+        get = None  # in-flight queue pop (outer so the finally sees it)
+        try:
+            while True:
+                with self._alock:
+                    self._carry = (
+                        self._deferred + self._admit + self._carry
+                    )
+                    self._deferred.clear()
+                    self._admit.clear()
+                if self._carry:
+                    # Deadline-slack pick (absolute deadlines compare
+                    # directly); deadline-less carries keep FIFO order
+                    # behind every deadlined one.
+                    seed_i = min(
+                        range(len(self._carry)),
+                        key=lambda i: (
+                            self._carry[i].deadline is None,
+                            self._carry[i].deadline or 0.0,
+                            i,
+                        ),
+                    )
+                    reqs = [self._carry.pop(seed_i)]
+                    self._forming = reqs
+                    rest: list = []
+                    for r in self._carry:
+                        if (
+                            len(reqs) < self.max_batch
+                            and self._compatible(reqs, r)
+                        ):
+                            reqs.append(r)
+                        else:
+                            rest.append(r)
+                    self._carry = rest
+                else:
+                    reqs = [await self._queue.get()]
+                    # No await between the pop resuming and this
+                    # assignment (drain visibility — same contract as
+                    # the legacy loop).
+                    self._forming = reqs
+                    faults.fire("collector_pop")
+                if self.max_wait_s > 0:
+                    deadline = loop.time() + self.max_wait_s
+                    while len(reqs) < self.max_batch:
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        # Same race-free wait/cancel dance as the
+                        # legacy loop (see its comments for why NOT
+                        # asyncio.wait_for).
+                        get = asyncio.ensure_future(self._queue.get())
+                        done, _ = await asyncio.wait(
+                            {get}, timeout=timeout
+                        )
+                        if not done:
+                            get.cancel()
+                            await asyncio.wait({get})
+                            if get.cancelled():
+                                get = None
+                                break
+                        nxt = get.result()
+                        get = None
+                        if self._compatible(reqs, nxt):
+                            reqs.append(nxt)
+                        else:
+                            self._carry.append(nxt)
+                            break  # keep the window short
+                else:
+                    while (
+                        len(reqs) < self.max_batch
+                        and not self._queue.empty()
+                    ):
+                        nxt = self._queue.get_nowait()
+                        if self._compatible(reqs, nxt):
+                            reqs.append(nxt)
+                        else:
+                            self._carry.append(nxt)
+                            break
+                # Bounded handoff: once a full batch's worth of formed
+                # requests is pending in the scheduler, stop draining
+                # the bounded queue — stalled arrivals then fill it
+                # and shed as 503s, exactly like the _admit bound.
+                while (
+                    self.sched is not None
+                    and self.sched.backlog >= self.max_batch
+                ):
+                    await asyncio.sleep(0.005)
+                if self.sched is None:
+                    raise RuntimeError("scheduler stopped")
+                self.sched.submit(reqs)
+                reqs = []
+                self._forming = None
+        finally:
+            self._forming = None
+            # Terminal frames for everything claimed, queued, or
+            # carried (the scheduler's own stop() handles what was
+            # already handed to it).
             err = RuntimeError("generation engine stopped")
             queued = []
             if get is not None:

@@ -56,6 +56,13 @@ POINTS = (
     "stream_push",     # a token chunk entering a request's queue
     "tier_spill",      # KV tier: registering an evicted prefix blob
     "tier_restore",    # KV tier: applying a blob back to device
+    # The unit-dispatch seam (serving/scheduler.py, r15): fires once
+    # before EVERY scheduler unit — lane formation included. A raise
+    # kills that one lane (its generator's finally releases its
+    # pages; its waiters get the error as their terminal frame) while
+    # every other lane streams on; a delay slows one unit, bounding
+    # how long any single batch can stall the queue in a drill.
+    "sched_unit",
     # The router↔replica hop (serving/router.py): fires once per
     # forward attempt BEFORE the first request byte is written (a
     # raise there triggers the single failover hop with no duplicate

@@ -136,6 +136,13 @@ class PagePool:
         self._entries: collections.OrderedDict[object, np.ndarray] = (
             collections.OrderedDict()
         )
+        # Donation epoch (r15, unit scheduler): bumped by the
+        # scheduler after every unit that may have donated the pool
+        # arrays through a dispatch, so CONCURRENT lanes know their
+        # cache pytree is stale and re-bind from ``layers`` before
+        # their next unit. Only the scheduler's single dispatch
+        # thread reads or writes it — no lock.
+        self.epoch = 0
         # Counters (exported via the engine's /metrics block).
         self.cow_copies = 0
         self.entry_evictions = 0

@@ -484,6 +484,23 @@ class Router:
         r.state = DOWN
 
     # -- forwarding --------------------------------------------------------
+    def external_depth(self, r: ReplicaState) -> int:
+        """Fleet backlog EXCLUDING ``r``'s own share (scraped queue
+        depths + router-side inflight of every OTHER replica): the
+        backpressure signal forwarded to the replica on each request
+        (``x-mlapi-router-depth``). Affinity means a replica's
+        repeated prefixes cannot be served elsewhere, so fleet
+        pressure is its future queue wait too — the replica feeds
+        this into ``admission_estimate_ms()`` and the brownout
+        ladder (ROADMAP item-3 → item-1 coupling). DOWN replicas are
+        excluded: their scraped depth is frozen at the last
+        successful poll, and a crashed replica's stale backlog must
+        not keep the survivors shedding/browning out forever."""
+        return max(0, sum(
+            x.queue_depth + x.inflight
+            for x in self.replicas if x is not r and x.state != DOWN
+        ))
+
     def _build_upstream(self, request: Request, r: ReplicaState) -> bytes:
         target = request.scope.get("raw_path") or request.path.encode()
         if isinstance(target, str):  # ASGI test transports pass str
@@ -500,9 +517,19 @@ class Router:
         )
         head += b"host: %s\r\n" % r.name.encode()
         for k, v in request.scope.get("headers", []):
-            if k.lower() not in _HOP_HEADERS:
+            # x-mlapi-router-depth is router-authored below; a copy of
+            # a client-sent (or upstream-router-sent) one would let
+            # callers spoof fleet pressure into the replica's
+            # admission estimate.
+            if k.lower() not in _HOP_HEADERS and k.lower() != (
+                b"x-mlapi-router-depth"
+            ):
                 head += k + b": " + v + b"\r\n"
         head += b"content-length: %d\r\n" % len(request.body)
+        # Router backpressure rides every forwarded request: the
+        # fleet's backlog as this router sees it, minus the target's
+        # own share (it knows its own queue better than our poll).
+        head += b"x-mlapi-router-depth: %d\r\n" % self.external_depth(r)
         head += b"connection: close\r\n\r\n"
         return bytes(head) + request.body
 

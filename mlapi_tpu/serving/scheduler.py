@@ -1,0 +1,680 @@
+"""Continuous-batching scheduler v2: one typed-unit queue across
+concurrent batches (r15; ROADMAP item 1).
+
+Before this module the engine ran exactly ONE live :class:`BatchRun`
+at a time: the collector formed a batch, handed it to an executor
+thread, and every request that missed the window waited in ``_carry``
+for the whole run to finish — dispatch boundaries idled while queued
+work existed. r10 already made prefill chunks *schedulable units*
+inside one batch and noted "the same schedulable-unit machinery
+applies across batches"; this module is that generalization, the
+vLLM-style continuous-batching shape.
+
+Design:
+
+- **Lanes.** Each formed request group becomes a *lane*: a
+  :class:`~mlapi_tpu.serving.batch_run.BatchRun` plus its ``units()``
+  generator. The generator yields one of the five typed units —
+  ``prefill`` chunk, ``decode`` chunk, ``spec`` round/phase, ``admit``
+  (joiner install), ``compact`` (batch resize) — after each unit of
+  device work. Scheduler-off, ``run()`` drains the same generator, so
+  the two modes execute identical code and greedy streams are
+  token-identical by construction (pinned across the 8-config matrix
+  in ``tests/test_scheduler.py``).
+- **One dispatch thread.** All lanes advance on THIS thread, one unit
+  at a time — the device stream stays serial (the same property the
+  single decode-executor gave), only the *order* across batches is now
+  a policy decision. No dispatch boundary idles while any lane or
+  pending group has work.
+- **SLO-aware policy.** Every candidate (a runnable lane, or starting
+  a pending group — its formation prefill) gets an URGENCY in seconds:
+  the minimum deadline slack of its live requests when any carries a
+  deadline (the r12 machinery), else a relaxed constant that tightens
+  from the r10 LatencyStats reservoirs — a deadline-less pending group
+  that has waited past ~2x the observed TTFT p95 competes like a
+  near-due deadline (TTFT target), and a deadline-less running lane
+  competes at the inter-token p50 scale once it has work outstanding
+  (ITL target). Minimum urgency wins; exact ties fall back to
+  least-recently-dispatched, which makes equal-priority lanes
+  alternate strictly — the interleaving the tests pin from counters.
+  Choosing a deadlined candidate OVER the fairness choice counts as a
+  deadline preemption (``sched_deadline_preempts``). Across candidate
+  TYPES, a live lane whose slack is inside ~one formation's worth of
+  work blocks new group starts (formation is a whole batch prefill —
+  the one unit big enough to blow a near-due deadline); otherwise
+  pending groups start eagerly (their formation IS their TTFT).
+- **Page-budget arbitration.** Concurrent paged lanes share one
+  :class:`~mlapi_tpu.serving.paged_pool.PagePool`. Two rules keep them
+  from starving each other: (1) every lane RESERVES its worst-case
+  footprint from the BATCH geometry (rows re-pack to the group's max
+  bucket and live rows map the same decode spans:
+  ``ceil((prefix + group_bucket + group_n_new + chunk)/page)`` per
+  row, fixed at start), and a pending group only STARTS while other
+  lanes are live if its
+  own worst case plus the live reservations fit the pool — lanes
+  allocate per chunk, so free pages at start wildly undercount what a
+  live lane will still take. Otherwise it waits, counted in
+  ``sched_pages_deferred``, and starts when a lane releases; with no
+  lanes live it starts unconditionally (the single-batch semantics,
+  loud ``PagePoolExhausted`` if truly too big). (2) The pool's device
+  arrays are DONATED through every paged
+  dispatch, so after each unit the scheduler writes the advancing
+  lane's arrays back (``pool.layers``) and bumps ``pool.epoch``; a
+  lane whose epoch is stale re-binds its cache pytree from the pool +
+  its own table before its next unit. All on the one dispatch thread —
+  no locking, just the rebind.
+- **Deadlines and faults.** The r12 ``_expire_if_due`` sweeps run
+  inside ``units()`` at every boundary exactly as before (the
+  ``deadline_expired_*`` counters keep ticking), and every existing
+  ``serving/faults.py`` point fires from the same seams. One NEW
+  point, ``sched_unit``, fires before each unit dispatch (including a
+  lane's formation): a raise kills THAT lane only — its generator is
+  closed (pages released by the generator's ``finally``), its waiters
+  get the error as their terminal frame, and the other lanes stream
+  on.
+
+The collector (``engine._collect_loop_sched``) forms groups exactly
+as before but never blocks on a running batch: groups hand off here
+and collection continues, so bucket-incompatible traffic runs as
+concurrent interleaved lanes instead of serial ``_carry`` turns.
+Pending groups are started in urgency order — the r12
+``_carry[0]``-FIFO head-of-line pick is gone.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from mlapi_tpu.serving import faults
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.scheduler")
+
+UNIT_KINDS = ("prefill", "decode", "spec", "admit", "compact")
+
+# Urgency (seconds) of work nobody is waiting on with a deadline and
+# the reservoirs don't yet flag as SLO-risky: large enough that ANY
+# real deadline outranks it, finite so the ordering stays total.
+_RELAXED_S = 3600.0
+
+
+class _Group:
+    """A formed request group waiting for a lane slot."""
+
+    __slots__ = ("reqs", "t_submit", "deferred_counted")
+
+    def __init__(self, reqs: list):
+        self.reqs = reqs
+        self.t_submit = time.perf_counter()
+        # One ``sched_pages_deferred`` tick per deferral EPISODE (a
+        # group blocked on the page budget), not per re-evaluation —
+        # the gate is re-checked every dispatch-loop iteration.
+        self.deferred_counted = False
+
+
+class _Lane:
+    """One live BatchRun and its unit generator."""
+
+    __slots__ = (
+        "lane_id", "run", "gen", "last_pick", "pool_epoch", "reserved",
+    )
+
+    def __init__(self, lane_id: int, run, gen, pick_seq: int,
+                 reserved: int = 0):
+        self.lane_id = lane_id
+        self.run = run
+        self.gen = gen
+        self.last_pick = pick_seq
+        self.pool_epoch = -1  # forces a first-unit rebind check
+        # Worst-case page footprint (ceil((bucket + n_new)/page) per
+        # row), fixed at lane start — the arbitration unit.
+        self.reserved = reserved
+
+    @property
+    def reqs(self) -> list:
+        return self.run.reqs
+
+
+def _min_slack(reqs, now: float) -> float | None:
+    """Smallest deadline slack (s) among live deadlined requests, or
+    ``None`` when nobody carries a deadline."""
+    best = None
+    for r in reqs:
+        d = getattr(r, "deadline", None)
+        if d is None or getattr(r, "cancelled", False):
+            continue
+        s = d - now
+        if best is None or s < best:
+            best = s
+    return best
+
+
+class UnitScheduler:
+    """The engine-level typed-unit queue over concurrent BatchRuns.
+
+    Owned by :class:`~mlapi_tpu.serving.engine.TextGenerationEngine`
+    when constructed with ``scheduler=True`` (``--scheduler``);
+    created by ``engine.start()``, torn down by ``engine.stop()``.
+    """
+
+    def __init__(self, eng, max_batches: int = 2):
+        self.eng = eng
+        self.max_batches = max(1, int(max_batches))
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending: list[_Group] = []
+        self._lanes: list[_Lane] = []
+        # The group CLAIMED off _pending but not yet a lane (its
+        # formation prefill is running on the dispatch thread): in
+        # neither list, yet very much in-flight — idle/backlog/
+        # queue_depth and drain's sweep must see it, or drain can
+        # declare the engine idle with a batch mid-formation.
+        self._forming_group: _Group | None = None
+        self._stopped = False
+        self._pick_seq = 0
+        self._lane_seq = 0
+        # LatencyStats.summary() sorts both reservoirs; the policy
+        # only needs it at reservoir-drift granularity — cache it for
+        # a window of picks instead of sorting per dispatched unit.
+        self._summary_cache = None
+        self._summary_seq = -1000
+        # Bounded unit trace (lane_id, kind) — the counters-derived
+        # interleaving evidence the tests (and post-mortems) read;
+        # never wall-clock.
+        self.trace: collections.deque = collections.deque(maxlen=2048)
+        self._thread = threading.Thread(
+            target=self._loop, name="unitsched", daemon=True
+        )
+        self._thread.start()
+
+    # -- intake / shutdown (event-loop side) ---------------------------
+
+    def submit(self, reqs: list) -> None:
+        """Hand a formed group to the unit queue (collector thread)."""
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("scheduler stopped")
+            self._pending.append(_Group(reqs))
+            self._work.notify_all()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Stop the dispatch thread; anything still pending or live
+        gets the engine-stopped error as its terminal frame (parity
+        with the collector's ``finally``)."""
+        with self._work:
+            self._stopped = True
+            self._work.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    # -- observability -------------------------------------------------
+
+    @property
+    def backlog(self) -> int:
+        """Requests formed but not yet running — the piece of the
+        submit queue that moved here (pending groups + the one mid-
+        formation); counted into ``engine.queue_depth`` so
+        backpressure, admission estimates and the router's scrape
+        keep seeing it."""
+        with self._lock:
+            n = sum(len(g.reqs) for g in self._pending)
+            if self._forming_group is not None:
+                n += len(self._forming_group.reqs)
+            return n
+
+    @property
+    def queue_depth(self) -> int:
+        """Typed-unit queue depth: one runnable unit per live lane
+        plus one formation unit per pending/forming group."""
+        with self._lock:
+            return (
+                len(self._pending) + len(self._lanes)
+                + (1 if self._forming_group is not None else 0)
+            )
+
+    @property
+    def batches_live(self) -> int:
+        with self._lock:
+            return len(self._lanes)
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return (
+                not self._pending
+                and not self._lanes
+                and self._forming_group is None
+            )
+
+    def sweep_requests(self) -> list:
+        """Drain's budget-exhausted sweep: pop every pending group's
+        requests (they will never be laned) and list — cancel-only,
+        the generators own them — every live lane's plus the group
+        mid-formation (its lane notices the cancels at its first
+        boundary). The caller pushes terminal frames and cancels;
+        cancelled lane rows finish at their next boundary exactly
+        like disconnects."""
+        with self._lock:
+            out: list = []
+            for g in self._pending:
+                out += g.reqs
+            self._pending.clear()
+            for lane in self._lanes:
+                out += list(lane.run.reqs)
+            if self._forming_group is not None:
+                out += list(self._forming_group.reqs)
+            return out
+
+    # -- the dispatch loop ---------------------------------------------
+
+    def _loop(self) -> None:
+        eng = self.eng
+        while True:
+            with self._work:
+                while (
+                    not self._stopped
+                    and not self._lanes
+                    and not self._pending
+                ):
+                    self._work.wait(timeout=0.1)
+                if self._stopped:
+                    break
+            try:
+                started = self._maybe_start()
+                lane = self._pick()
+                if lane is not None:
+                    self._advance(lane)
+                elif not started:
+                    # Pending work blocked on the page budget with
+                    # every lane idle-free: wait for a release tick.
+                    time.sleep(0.002)
+            except BaseException:  # noqa: BLE001 — scheduler must survive
+                _log.exception("unit scheduler internal error")
+                time.sleep(0.01)
+        # Stopped: deliver the collector's error contract to whatever
+        # is still here (normal shutdown drains first, so this is the
+        # crash/stop() path).
+        err = RuntimeError("generation engine stopped")
+        with self._lock:
+            pending, self._pending = self._pending, []
+            lanes, self._lanes = self._lanes, []
+        for lane in lanes:
+            try:
+                # close() throws GeneratorExit into a STARTED
+                # generator, whose finally write-backs its cache —
+                # re-bind first so a stale lane never writes
+                # donation-consumed buffers over the live pool (the
+                # same rebind-before-teardown ordering _advance
+                # uses).
+                self._rebind_pool(lane)
+            except BaseException:
+                _log.exception("stop-path rebind failed")
+            try:
+                lane.gen.close()
+            except BaseException:
+                pass
+            try:
+                # A never-advanced generator's close() runs no finally
+                # — release the lane's pages directly (idempotent).
+                # Only the lane holding the pool's current binding
+                # (epoch match — true after the rebind above) may
+                # write its arrays back.
+                pool = lane.run.pool
+                lane.run._paged_cleanup(
+                    write_back=pool is None
+                    or lane.pool_epoch == pool.epoch
+                )
+            except BaseException:
+                _log.exception("lane cleanup failed")
+            self._deliver_error(lane.run.reqs, err)
+        for g in pending:
+            self._deliver_error(g.reqs, err)
+
+    @staticmethod
+    def _deliver_error(reqs, err) -> None:
+        for r in reqs:
+            if getattr(r, "cancelled", False):
+                continue
+            try:
+                r.push(err)
+            except Exception:  # a dead consumer must not mask others
+                pass
+
+    # -- policy --------------------------------------------------------
+
+    def _urgency_group(self, g: _Group, now: float, summary) -> float:
+        slack = _min_slack(g.reqs, now)
+        if slack is not None:
+            return slack
+        # TTFT feed (r10 reservoirs): a deadline-less group that has
+        # queued past ~2x the observed TTFT p95 starts competing like
+        # a near-due deadline; cold reservoirs keep it relaxed.
+        ttft = (summary["ttft_p95_ms"] or 0.0) / 1e3
+        if ttft > 0.0 and (now - g.t_submit) > 2.0 * ttft:
+            return ttft
+        return _RELAXED_S
+
+    def _urgency_lane(self, lane: _Lane, now: float, summary) -> float:
+        slack = _min_slack(lane.run.reqs, now)
+        if slack is not None:
+            return slack
+        # ITL feed: a deadline-less RUNNING lane competes at the
+        # inter-token p50 scale (its consumers are waiting a token
+        # gap, not a TTFT) — equal for all such lanes, so the
+        # least-recently-picked tie-break alternates them strictly.
+        itl = (summary["intertoken_p50_ms"] or 0.0) / 1e3
+        return itl if itl > 0.0 else _RELAXED_S
+
+    def _pick(self) -> _Lane | None:
+        """Minimum-urgency lane; exact ties go least-recently-picked
+        (fair alternation). A pick that overrides fairness because of
+        a real deadline counts as a preemption."""
+        now = time.perf_counter()
+        with self._lock:
+            lanes = list(self._lanes)
+        if not lanes:
+            return None
+        if len(lanes) == 1:
+            chosen = lanes[0]
+        else:
+            summary = self._cached_summary()
+            scored = [
+                (self._urgency_lane(ln, now, summary), ln.last_pick, ln)
+                for ln in lanes
+            ]
+            scored.sort(key=lambda t: (t[0], t[1]))
+            chosen = scored[0][2]
+            fair = min(scored, key=lambda t: t[1])[2]
+            if chosen is not fair and _min_slack(
+                chosen.run.reqs, now
+            ) is not None:
+                self.eng.sched_deadline_preempts += 1
+        self._pick_seq += 1
+        chosen.last_pick = self._pick_seq
+        return chosen
+
+    # -- lane lifecycle ------------------------------------------------
+
+    def _page_need(self, reqs) -> int:
+        """Worst-case pool footprint of a group, from the BATCH
+        geometry BatchRun will actually build: rows re-pack to the
+        GROUP's max bucket and every live row maps the same
+        ``[pos, pos+chunk)`` decode spans, so the per-row span is the
+        group's — prefix region + group bucket + the group's token
+        budget, chunk-rounded, plus the batched-spec headroom when a
+        draft is attached. Prefix sharing and early finishes only
+        make the real usage smaller (over-reservation costs a
+        deferred start, never a mid-decode exhaustion)."""
+        eng = self.eng
+        page = eng.pool.page
+        span = (
+            max(r.prefix_len for r in reqs)
+            + max(len(r.row) for r in reqs)
+            + max(r.n_new for r in reqs)
+            + eng.chunk
+            + (eng.spec_k + 1 if eng.draft_model is not None else 0)
+        )
+        return len(reqs) * -(-span // page)
+
+    def _claim_next_group(self) -> _Group | None:
+        """Pop the most-urgent pending group that passes the
+        page-budget gate — selection and pop under ONE lock hold, so
+        a concurrent drain sweep or collector submit can never shift
+        indices between the vetting and the pop.
+
+        The gate: the group's worst-case footprint plus every live
+        lane's RESERVATION must fit the pool, so concurrent lanes
+        cannot grow each other into a mid-decode
+        ``PagePoolExhausted`` (lanes allocate per chunk, so free
+        pages at start wildly undercount what a live lane will still
+        take). Prefix-entry pages don't count against the budget —
+        they are evictable on demand. With no lanes live a group
+        starts unconditionally (single-batch semantics — a loud
+        reject beats silent starvation when the pool is simply too
+        small)."""
+        now = time.perf_counter()
+        pool = self.eng.pool
+        with self._lock:
+            n_pending = len(self._pending)
+            if not n_pending or len(self._lanes) >= self.max_batches:
+                return None
+        # The reservoir work lives OUTSIDE the lock — submit's
+        # admission estimate, /healthz, and /metrics contend on it
+        # via backlog/queue_depth. A single pending group skips the
+        # scoring entirely (it wins unopposed).
+        summary = self._cached_summary() if n_pending > 1 else None
+        with self._lock:
+            if not self._pending or len(self._lanes) >= self.max_batches:
+                return None
+            if summary is not None and len(self._pending) > 1:
+                order = sorted(
+                    enumerate(self._pending),
+                    key=lambda t: (
+                        self._urgency_group(t[1], now, summary), t[0]
+                    ),
+                )
+            else:
+                order = list(enumerate(self._pending))
+            held = sum(ln.reserved for ln in self._lanes)
+            for _, g in order:
+                if (
+                    pool is None
+                    or not self._lanes
+                    or self._page_need(g.reqs) + held
+                    <= pool.pages_total
+                ):
+                    self._pending.remove(g)
+                    # Claimed: visible to idle/backlog/sweep via the
+                    # forming slot until the lane exists.
+                    self._forming_group = g
+                    return g
+                if not g.deferred_counted:
+                    # Once per deferral episode, not per re-check.
+                    g.deferred_counted = True
+                    self.eng.sched_pages_deferred += 1
+            return None
+
+    def _cached_summary(self):
+        """The LatencyStats snapshot at pick granularity: recomputed
+        every 32 picks (or on first use) instead of per unit —
+        ``summary()`` sorts both reservoirs, and the policy only
+        needs it at reservoir-drift resolution. Equal-urgency
+        tie-breaks are unaffected (all deadline-less candidates read
+        the SAME cached value)."""
+        if (
+            self._summary_cache is None
+            or self._pick_seq - self._summary_seq >= 32
+        ):
+            self._summary_cache = self.eng.latency.summary()
+            self._summary_seq = self._pick_seq
+        return self._summary_cache
+
+    def _urgent_lane_blocks_start(self) -> bool:
+        """Cross-candidate-type priority: a live lane whose deadline
+        slack is inside ~one formation's worth of work (2x the
+        observed TTFT p95, floor 250 ms cold) outranks STARTING a new
+        group — formation is a whole batch prefill, the one unit big
+        enough to blow a near-due deadline. Starts resume once the
+        tight lane finishes or expires (bounded: it is within its own
+        slack of doing either)."""
+        with self._lock:
+            if not self._pending or not self._lanes:
+                return False
+            lanes = list(self._lanes)
+        now = time.perf_counter()
+        slack = None
+        for ln in lanes:
+            s = _min_slack(ln.run.reqs, now)
+            if s is not None and (slack is None or s < slack):
+                slack = s
+        if slack is None:
+            return False
+        ttft = (self._cached_summary()["ttft_p95_ms"] or 0.0) / 1e3
+        return slack < 2.0 * max(ttft, 0.125)
+
+    def _maybe_start(self) -> bool:
+        """Start pending groups (urgency order) while lane slots and
+        the page budget allow — unless a live lane's deadline slack
+        outranks a formation (see :meth:`_urgent_lane_blocks_start`).
+        Formation — the group's prefill — runs here, on the dispatch
+        thread, as the lane's first unit."""
+        started = False
+        while True:
+            if self._urgent_lane_blocks_start():
+                return started
+            g = self._claim_next_group()
+            if g is None:
+                return started
+            try:
+                self._start_lane(g)
+            finally:
+                with self._lock:
+                    self._forming_group = None
+            started = True
+
+    def _start_lane(self, g: _Group) -> None:
+        """Formation as a unit: the engine's shared formation
+        preamble (``_form_batch`` — the SAME expiry sweep and fused
+        gates ``_run_batch`` applies, one definition so the two modes
+        can never diverge; a fused whole-generation program is ONE
+        uninterruptible unit — the RTT-floor lever, it builds
+        transient caches and never touches the pool), then the lane.
+        Failures deliver to every waiter, scoped to this group —
+        other lanes stream on."""
+        eng, reqs = self.eng, g.reqs
+        try:
+            faults.fire("sched_unit")
+            run = eng._form_batch(reqs, admit=True)
+            if run is None:
+                return  # everyone expired, or a fused program served it
+        except BaseException as e:  # noqa: BLE001 — delivered to waiters
+            if eng.pool is not None:
+                # A failed paged formation may have DONATED the pool
+                # arrays before dying; BatchRun.__init__'s cleanup
+                # rewrote pool.layers from its fresh cache but knows
+                # nothing of epochs — bump here or every live lane
+                # skips its rebind and dispatches deleted buffers
+                # (harmless over-bump when the failure preceded any
+                # donation: lanes re-bind to the same arrays).
+                eng.pool.epoch += 1
+            _log.error(
+                "scheduler formation of %d failed: %s", len(reqs), e
+            )
+            self._deliver_error(reqs, e)
+            return
+        eng.sched_units_prefill += 1  # formation IS the prefill unit
+        self._writeback_pool(run)
+        with self._lock:
+            self._lane_seq += 1
+            lane = _Lane(
+                self._lane_seq, run, run.units(), self._pick_seq,
+                reserved=(
+                    self._page_need(reqs)
+                    if eng.pool is not None else 0
+                ),
+            )
+            lane.pool_epoch = (
+                eng.pool.epoch if eng.pool is not None else -1
+            )
+            self._lanes.append(lane)
+            live = len(self._lanes)
+        self.trace.append((lane.lane_id, "prefill"))
+        if live > eng.sched_batches_live_max:
+            eng.sched_batches_live_max = live
+
+    def _rebind_pool(self, lane: _Lane) -> None:
+        """Another lane's donated dispatch consumed the pool arrays
+        this lane's cache pytree was bound to: re-bind from the pool's
+        current arrays + this lane's own page table. One dispatch
+        thread ⇒ no lock; the table upload is the only device work."""
+        run = lane.run
+        pool = run.pool
+        if pool is None or lane.pool_epoch == pool.epoch:
+            return
+        from mlapi_tpu.ops.quant import paged_cache_tree
+
+        run.cache = paged_cache_tree(pool.layers, run.tab[:run.b_cur])
+        run._tab_dirty = False
+        lane.pool_epoch = pool.epoch
+
+    def _writeback_pool(self, run) -> None:
+        """After a paged lane's unit: publish its (donation-fresh)
+        pool arrays so the next lane to dispatch re-binds against
+        them."""
+        pool = run.pool
+        if pool is None or getattr(run, "cache", None) is None:
+            return
+        from mlapi_tpu.ops.quant import paged_pools_of
+
+        pool.layers = paged_pools_of(run.cache)
+        pool.epoch += 1
+
+    def _advance(self, lane: _Lane) -> None:
+        """One unit of one lane: the heart of the queue."""
+        eng = self.eng
+        run = lane.run
+        err: BaseException | None = None
+        done = False
+        kind = None
+        try:
+            # Rebind BEFORE the fault point: if the injected raise
+            # closes this lane's generator, its cleanup writes the
+            # lane's cache back to the pool — which must be the
+            # CURRENT arrays, not the stale pytree another lane's
+            # donation consumed (write-back of deleted buffers would
+            # poison every surviving lane).
+            self._rebind_pool(lane)
+            faults.fire("sched_unit")
+            kind = next(lane.gen)
+        except StopIteration:
+            done = True
+        except BaseException as e:  # noqa: BLE001 — lane-scoped failure
+            err = e
+            done = True
+            try:
+                lane.gen.close()
+            except BaseException:
+                pass
+            # close() on a generator that never ran its FIRST next()
+            # (the fault fired before this lane's first unit) is a
+            # no-op — units()'s cleanup ``finally`` never executed, so
+            # release the formation's pages directly. Idempotent when
+            # the generator DID run its finally (tables already null,
+            # write-back repeats the same arrays). Write back only
+            # when this lane's cache is the pool's CURRENT binding
+            # (epoch match) — a stale pytree must never rebind
+            # donation-consumed buffers over the live pool.
+            try:
+                run._paged_cleanup(
+                    write_back=run.pool is None
+                    or lane.pool_epoch == run.pool.epoch
+                )
+            except BaseException:
+                _log.exception("lane cleanup failed")
+        if run.pool is not None:
+            if not done:
+                self._writeback_pool(run)  # bumps the epoch
+            else:
+                # The generator's cleanup already wrote the final
+                # arrays back on exhaustion/close; bump the epoch
+                # here so surviving lanes re-bind. One write-back =
+                # one bump, always.
+                run.pool.epoch += 1
+            lane.pool_epoch = run.pool.epoch
+        if kind is not None:
+            counter = f"sched_units_{kind}"
+            setattr(eng, counter, getattr(eng, counter) + 1)
+            self.trace.append((lane.lane_id, kind))
+        if err is not None:
+            _log.error(
+                "scheduler lane of %d failed: %s", len(run.reqs), err
+            )
+            self._deliver_error(run.reqs, err)
+        if done:
+            with self._work:
+                try:
+                    self._lanes.remove(lane)
+                except ValueError:
+                    pass
+                self._work.notify_all()

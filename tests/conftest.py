@@ -105,9 +105,15 @@ _CACHE_FAMILIES = {
     # prefill/decode programs test_paged_kv built, plus only its own
     # restore scatter — sharing the window saves the whole 4-config
     # compile ladder a second time (~15 s).
+    # + the scheduler module (r15): same CFG and engine shapes again —
+    # scheduler-on drives the SAME compiled prefill/decode programs
+    # (the unit generator changes dispatch ORDER, never shapes), so
+    # sharing the window costs it only its own handful of tier
+    # variants instead of the whole ladder.
     "paged-family": frozenset({
         "test_paged_kv",
         "test_paged_kv_tier",
+        "test_scheduler",
     }),
 }
 _last_cache_group = [None]

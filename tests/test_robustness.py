@@ -736,8 +736,14 @@ async def _matrix_traffic(eng, tier_leg: bool = False) -> list:
 # test_router.py pins raise-at-submit (single failover, no duplicate
 # submit), raise-mid-stream (well-formed terminal frame), and delay
 # (slowed, byte-complete); test_router_e2e.py pins page-refcount
-# conservation on real paged replicas under the same faults.
-_ENGINE_POINTS = tuple(p for p in faults.POINTS if p != "router_forward")
+# conservation on real paged replicas under the same faults. The
+# unit-dispatch seam (`sched_unit`, crossed only with --scheduler on)
+# likewise has its own matrix in test_scheduler.py: a raise kills one
+# lane with pages conserved while the other lane streams on.
+_ENGINE_POINTS = tuple(
+    p for p in faults.POINTS
+    if p not in ("router_forward", "sched_unit")
+)
 
 
 @pytest.mark.parametrize("action", ["raise", "delay=0.02"])
