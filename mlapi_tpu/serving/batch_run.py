@@ -623,7 +623,11 @@ class BatchRun:
                 self.cache, kv, jnp.asarray(tab1), jnp.int32(0)
             )
         if srcs:
-            self.eng.pool.cow_copies += len(srcs)
+            # Under the pool lock: cow_copies is scraped by /metrics
+            # from the event loop while this decode-thread increment
+            # runs (mlapi-lint MLA002, fixed r16).
+            with self.eng.pool.lock:
+                self.eng.pool.cow_copies += len(srcs)
             self.cache = paged_cow_fn()(
                 self.cache,
                 jnp.asarray(np.asarray(srcs, np.int32)),

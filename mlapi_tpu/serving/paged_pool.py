@@ -285,7 +285,12 @@ class PagePool:
                 # eviction.
                 self._evicting -= 1
                 self._release_locked(np.asarray(pages))
-        self.entry_evictions += 1
+                # Counted under the lock: evictions run concurrently
+                # from the decode thread (alloc pressure) and the
+                # event loop (brownout evict_idle) — a bare += here
+                # lost updates under exactly the load /metrics is
+                # read to diagnose (mlapi-lint MLA002, fixed r16).
+                self.entry_evictions += 1
         _log.debug(
             "evicted prefix page set (%d pages) under pool pressure%s",
             len(pages),

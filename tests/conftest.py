@@ -53,10 +53,18 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
-        "slow: multi-minute spawned-process drills (e.g. the --router "
-        "SIGTERM/respawn topology test) excluded from the tier-1 "
-        "window by its time budget (-m 'not slow'); run explicitly "
-        "with pytest -m slow.",
+        "slow: excluded from the tier-1 window by its time budget "
+        "(-m 'not slow'); run explicitly with pytest -m slow. Two "
+        "populations: multi-minute spawned-process drills (e.g. the "
+        "--router SIGTERM/respawn topology test), and — since the "
+        "r16 buyback — the five in-suite churn/long-tail soaks whose "
+        "per-test measured call times (5.5 + 4.8 + 7.2 + 7.1 + "
+        "12.6 s, noted at each demotion site) were pushing the suite "
+        "against the 870 s window (r14/r15 both timed out there with "
+        "zero failures). The soaks duplicate tier-1 functional "
+        "coverage at larger iteration counts, so demoting them "
+        "regains ~37 s (~31 s net of the new test_static_analysis "
+        "module) without dropping any invariant from the window.",
     )
 
 

@@ -1,0 +1,11 @@
+"""MLA005 fixture export surface: the snapshot-store shapes the rule
+extracts exported names from. Exports exactly ``generate.requests``
+and ``generate.queue_depth`` — anything else scraped or documented in
+the fixture set is drift."""
+
+
+async def metrics():
+    snap = {"counters": {}, "gauges": {}}
+    snap["counters"]["generate.requests"] = 1
+    snap["gauges"]["generate.queue_depth"] = 2
+    return snap

@@ -1,0 +1,47 @@
+"""MLA001 fixture: the r13 ``restore_entry`` poisoning shape, minimal.
+
+Parsed by the linter, NEVER imported — the ``import jax`` below is
+AST scenery. ``# EXPECT(MLA001)`` marks the exact line the rule must
+flag; tests/test_static_analysis.py asserts the finding set equals
+the marker set.
+"""
+
+import jax
+
+
+def _restore_fn():
+    def _run(pools, payload):
+        return pools
+
+    return jax.jit(_run, donate_argnums=(0,))
+
+
+class Pool:
+    def restore_poisoned(self, blob):
+        # The historical bug: the donated dispatch consumes
+        # self.layers, then a fallback path reads it.
+        out = _restore_fn()(self.layers, blob)
+        n = len(self.layers)  # EXPECT(MLA001)
+        return out, n
+
+    def restore_written_back(self, blob):
+        # The documented discipline: same-statement write-back.
+        self.layers = _restore_fn()(self.layers, blob)
+        return self.layers
+
+    def restore_rebound_later(self, blob):
+        out = _restore_fn()(self.layers, blob)
+        self.layers = out  # rebind before any read: clean
+        return len(self.layers)
+
+
+def local_jit_closure(params, opt_state, batch):
+    # The make_train_step shape: a closure calls the enclosing
+    # frame's jitted binding; the CALLER reassigns — reads in a
+    # sibling frame must not be charged to this one.
+    step = jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+
+    def run(p, o, b):
+        return step(p, o, b)
+
+    return run(params, opt_state, batch)

@@ -1,0 +1,9 @@
+"""MLA003 fixture faults module: three declared points —
+``alloc`` is fired (fx_seams) and drilled (t/fx_scrape), ``ghost``
+is never fired anywhere, ``undrilled`` fires but no test arms it."""
+
+POINTS = (
+    "alloc",
+    "ghost",      # EXPECT(MLA003)
+    "undrilled",  # EXPECT(MLA003)
+)

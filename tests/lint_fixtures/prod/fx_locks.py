@@ -1,0 +1,43 @@
+"""MLA002 fixture: the entry_evictions/cow_copies shapes r16's first
+clean-tree run actually found — a registered attribute mutated
+outside its lock, self-scoped and cross-module, plus every deliberate
+exception (``_locked`` convention, ``__init__``, inline allow,
+baseline entry)."""
+
+import threading
+
+
+class PagePool:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._free = []
+        self.entry_evictions = 0  # __init__ is exempt: construction
+
+    def bad_free(self, page):
+        self._free.append(page)  # EXPECT(MLA002)
+
+    def bad_counter(self):
+        self.entry_evictions += 1  # EXPECT(MLA002)
+
+    def good_free(self, page):
+        with self.lock:
+            self._free.append(page)
+
+    def _drop_locked(self, page):
+        self._free.append(page)  # caller holds the lock: clean
+
+    def allowed_bump(self):
+        # lint: allow(MLA002): fixture — proves inline suppression syntax
+        self.entry_evictions += 1
+
+    def baselined_bump(self):
+        self.entry_evictions += 1  # suppressed via fx_baseline.txt
+
+
+def cross_module_bad(pool, n):
+    pool.cow_copies += n  # EXPECT(MLA002)
+
+
+def cross_module_good(pool, n):
+    with pool.lock:
+        pool.cow_copies += n

@@ -1,0 +1,23 @@
+"""MLA004 fixture: the two router-purity violations (a jax import,
+a blocking call on the event loop) next to the documented
+run_in_executor escape hatch."""
+
+import asyncio
+import time
+
+import jax  # EXPECT(MLA004)
+
+
+async def handler():
+    time.sleep(0.1)  # EXPECT(MLA004)
+    return jax
+
+
+def _poll_blocking():
+    time.sleep(0.5)  # handed to run_in_executor below: clean
+    return 1
+
+
+async def ok_handler():
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, _poll_blocking)

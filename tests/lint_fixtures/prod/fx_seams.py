@@ -1,0 +1,28 @@
+"""MLA003 fixture seams: fire-before-mutation discipline plus the
+unknown-point typo. ``KVTier`` is a registry class name on purpose —
+its ``spill_count`` is guarded state the ordering check watches."""
+
+import threading
+
+from somewhere import faults  # parse-only
+
+
+class KVTier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spill_count = 0
+
+    def spill_ok(self, blob):
+        # The documented ordering: the seam fires FIRST, so an
+        # injected raise leaves state untouched.
+        faults.fire("alloc")
+        with self._lock:
+            self.spill_count += 1
+
+    def spill_fires_too_late(self, blob):
+        with self._lock:
+            self.spill_count += 1
+        faults.fire("undrilled")  # EXPECT(MLA003)
+
+    def typo(self):
+        faults.fire("allocc")  # EXPECT(MLA003)

@@ -1,0 +1,33 @@
+"""MLA006 fixture: the ADVICE r05 flake shape (elapsed-vs-constant
+assert) in an unmarked test, the exempt soak, and the legal wait
+bound."""
+
+import time
+
+import pytest
+
+
+def test_fast_path_flaky():
+    t0 = time.perf_counter()
+    do_work()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.5  # EXPECT(MLA006)
+
+
+def test_direct_clock_compare_flaky():
+    t0 = time.perf_counter()
+    do_work()
+    assert time.perf_counter() - t0 < 1.0  # EXPECT(MLA006)
+
+
+@pytest.mark.heavy
+def test_soak_may_time_itself():
+    t0 = time.perf_counter()
+    do_work()
+    assert time.perf_counter() - t0 < 60.0  # exempt: heavy
+
+
+def test_wait_guard_is_legal():
+    deadline = time.monotonic() + 10.0
+    while still_busy():
+        assert time.monotonic() < deadline  # clock-vs-clock: a wait

@@ -1,0 +1,11 @@
+"""MLA005/MLA003 fixture test-side: scrapes one exported name (clean)
+and one that nothing exports (drift), and arms the first fault point
+only — the other two declared points stay uncovered on purpose."""
+
+FAULT_MATRIX = ["alloc:after=1:raise"]
+
+
+def read_metrics(snap):
+    good = snap["counters"]["generate.requests"]
+    bad = snap["gauges"]["generate.queue_len"]  # EXPECT(MLA005)
+    return good, bad

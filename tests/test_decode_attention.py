@@ -393,6 +393,8 @@ def test_metrics_exports_decode_bytes():
 
 
 @pytest.mark.heavy  # in-suite soak — fast profile: -m 'not heavy'
+@pytest.mark.slow  # 12.6 s measured call — r16 tier-1 buyback (conftest);
+# the 64-token agreement pin runs in tier-1, this is the long tail.
 def test_flash_int8_greedy_agreement_256_tokens():
     """The acceptance pin: teacher-forced greedy top-1 agreement of
     ``kv_quant="int8", decode_attn_impl="flash"`` vs the

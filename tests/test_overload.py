@@ -82,7 +82,6 @@ async def test_batcher_sheds_fast_when_queue_full():
     await b.start()
     row = np.zeros(4, np.float32)
     try:
-        t0 = asyncio.get_running_loop().time()
         tasks = [asyncio.create_task(b.submit(row)) for _ in range(32)]
         await asyncio.sleep(0.05)  # let the collector drain what it can
         rejected = [
@@ -90,10 +89,21 @@ async def test_batcher_sheds_fast_when_queue_full():
             for t in tasks
             if t.done() and isinstance(t.exception(), OverloadedError)
         ]
-        elapsed = asyncio.get_running_loop().time() - t0
         assert rejected, "no request was shed at 4x queue capacity"
         assert b.rejected == len(rejected)
-        assert elapsed < 1.0, "shedding must be immediate, not a timeout"
+        # Immediacy from task state, not wall-clock (mlapi-lint
+        # MLA006, the ADVICE r05 flake class): the device is wedged,
+        # so NOTHING can complete by being processed — every task
+        # that finished inside the 50 ms window must be a shed, and
+        # the device must not have returned a single batch. A
+        # timeout-style shed path would leave all 32 tasks pending
+        # here (rejected would be empty) instead of failing a clock
+        # bound.
+        assert all(
+            isinstance(t.exception(), OverloadedError)
+            for t in tasks if t.done()
+        ), "a task completed by processing while the device was wedged"
+        assert eng.batch_sizes == [], "the wedged device returned a batch"
         assert b.queue_depth <= 8
     finally:
         eng.gate.set()

@@ -542,6 +542,8 @@ def test_disk_dir_without_budget_is_loud(gpt_params, tmp_path):
 
 
 @pytest.mark.heavy
+@pytest.mark.slow  # 4.8 s measured call — r16 tier-1 buyback (conftest);
+# the spill/restore/eviction seams stay covered by the per-seam tests.
 def test_tier_churn_soak(gpt_params):
     """Alternate spill seams, restores, budget evictions, and plain
     traffic for a while: every stream stays identical to its first

@@ -612,6 +612,9 @@ async def test_router_depth_header_ignored_off_replica(
 
 
 @pytest.mark.heavy
+@pytest.mark.slow  # 7.2 s measured call — r16 tier-1 buyback (conftest);
+# the 16-config identity matrix and unit-counter tests keep tier-1
+# scheduler coverage.
 async def test_scheduler_churn_soak(gpt_params):
     """Mixed-shape churn through the scheduler: short/long prompts,
     mixed budgets, a few deadlines — every stream terminates properly

@@ -1,0 +1,202 @@
+"""mlapi-lint: invariant-aware static analysis for this repo.
+
+Three consecutive PRs (r12, r13, r15) each shipped — and only caught
+in review — the same bug families: reading a donation-consumed jax
+buffer after a donated dispatch, mutating shared pool/tier/scheduler
+state outside its lock, or placing a fault-injection point after the
+state mutation it is supposed to guard. Those invariants are
+load-bearing across ~20 serving modules but lived only in reviewers'
+heads and DESIGN.md prose. This package mechanizes them as named,
+fixture-tested AST rules so each review-caught class is a CI failure
+instead (DESIGN.md §22 maps every rule to the incident it encodes).
+
+Design constraints, in order:
+
+- **Pure AST.** ``import jax`` is forbidden here (asserted by the
+  tier-1 test): the linter must run anywhere, instantly, with no
+  device, no XLA, no compile. Everything is ``ast`` + ``tokenize``
+  over source text.
+- **Repo-specific on purpose.** The rules encode THIS codebase's
+  contracts (``tools/lint/config.py`` is the registry: which
+  attributes are lock-guarded, which factories donate, which module
+  must stay async-pure). A generic linter cannot know that
+  ``PagePool._free`` is decode-thread-shared; this one does.
+- **Heuristic, lexical, and honest about it.** The analyses are
+  intraprocedural and lexical (no dataflow across calls, no loop
+  back-edges). That is exactly the shape of every historical
+  incident this package encodes — and anything it cannot see, it
+  must stay silent about rather than cry wolf. False positives are
+  handled by inline suppressions or the baseline file, each with a
+  mandatory written justification.
+
+Run as ``python -m tools.lint`` (CI: ``--format=github``); the tier-1
+suite runs the same entry point in ``tests/test_static_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to an exact ``file:line``.
+
+    ``symbol`` is the dotted enclosing scope (``Class.method`` or
+    ``""`` for module level) — the line-drift-stable anchor baseline
+    entries match on.
+    """
+
+    rule: str
+    file: str  # repo-relative posix path
+    line: int
+    message: str
+    symbol: str = ""
+
+    def render(self) -> str:
+        where = f"{self.file}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.rule} {where}{sym}: {self.message}"
+
+    def render_github(self) -> str:
+        """GitHub Actions annotation format (future CI mode)."""
+        return (
+            f"::error file={self.file},line={self.line},"
+            f"title={self.rule}::{self.message}"
+        )
+
+
+class SourceFile:
+    """One parsed python file: AST + per-line comments + raw lines.
+
+    Parsed once, shared by every rule. Comments come from
+    ``tokenize`` (the AST drops them) because the inline-suppression
+    syntax lives in comments.
+    """
+
+    def __init__(self, root: Path, path: Path):
+        self.abspath = path
+        self.path = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        try:
+            self.tree: ast.Module | None = ast.parse(self.text)
+        except SyntaxError:
+            self.tree = None
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.text).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, SyntaxError):
+            # Unparseable files (WIP syntax errors) degrade to
+            # comment-less, same as the ast.parse fallback above —
+            # the linter must never crash on the tree it scans.
+            pass
+        self._scopes: list[tuple[int, int, str]] | None = None
+        self._parents: dict | None = None
+
+    def parents(self) -> dict:
+        """Lazy child->parent map over the whole tree, computed once
+        per file (several rules need ancestry walks; rebuilding the
+        map per rule would re-walk every AST per rule)."""
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                import ast as _ast
+
+                for node in _ast.walk(self.tree):
+                    for child in _ast.iter_child_nodes(node):
+                        self._parents[child] = node
+        return self._parents
+
+    def symbol_at(self, line: int) -> str:
+        """Deepest enclosing ``Class.method`` scope containing
+        ``line`` (innermost span wins)."""
+        if self._scopes is None:
+            self._scopes = []
+            if self.tree is not None:
+                self._walk_scopes(self.tree, ())
+        best = ""
+        best_span = None
+        for lo, hi, name in self._scopes:
+            if lo <= line <= hi:
+                span = hi - lo
+                if best_span is None or span <= best_span:
+                    best, best_span = name, span
+        return best
+
+    def _walk_scopes(self, node: ast.AST, prefix: tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                qual = prefix + (child.name,)
+                self._scopes.append(
+                    (child.lineno, child.end_lineno or child.lineno,
+                     ".".join(qual))
+                )
+                self._walk_scopes(child, qual)
+            else:
+                self._walk_scopes(child, prefix)
+
+
+@dataclass
+class Project:
+    """The scanned tree: parsed python files plus raw doc texts."""
+
+    root: Path
+    files: list[SourceFile] = field(default_factory=list)
+    docs: dict[str, str] = field(default_factory=dict)  # path -> text
+
+    def get(self, relpath: str) -> SourceFile | None:
+        for f in self.files:
+            if f.path == relpath:
+                return f
+        return None
+
+    def matching(self, prefix: str) -> list[SourceFile]:
+        """Files whose repo-relative path starts with ``prefix``."""
+        return [f for f in self.files if f.path.startswith(prefix)]
+
+
+def load_project(cfg) -> Project:
+    """Collect + parse every file the config names (each file parsed
+    exactly once even when globs overlap)."""
+    proj = Project(root=cfg.root)
+    seen: set[str] = set()
+    for pattern in cfg.py_globs:
+        for path in sorted(cfg.root.glob(pattern)):
+            rel = path.relative_to(cfg.root).as_posix()
+            if rel in seen or not path.is_file():
+                continue
+            if any(rel.startswith(ex) for ex in cfg.exclude_prefixes):
+                continue
+            seen.add(rel)
+            proj.files.append(SourceFile(cfg.root, path))
+    for doc in cfg.doc_files:
+        p = cfg.root / doc
+        if p.is_file():
+            proj.docs[doc] = p.read_text(encoding="utf-8")
+    return proj
+
+
+def run_rules(proj: Project, cfg, rule_ids: set[str] | None = None):
+    """Run every (selected) rule; returns raw findings, pre-
+    suppression, sorted by location."""
+    from tools.lint.rules import ALL_RULES
+
+    findings: list[Finding] = []
+    for rule in ALL_RULES:
+        if rule_ids is not None and rule.id not in rule_ids:
+            continue
+        findings.extend(rule.run(proj, cfg))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

@@ -1,0 +1,106 @@
+"""CLI: ``python -m tools.lint`` — run every rule, honor
+suppressions, report with exit codes CI can gate on.
+
+Exit codes: 0 clean, 1 findings, 2 configuration error (malformed or
+stale suppression). ``--format=github`` emits GitHub Actions
+``::error`` annotations for future CI; the default is the
+``RULE file:line [symbol]: message`` lines the tier-1 test parses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+# `python -m tools.lint` from the repo root already has the root on
+# sys.path; a direct `python tools/lint/__main__.py` does not.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+from tools.lint import load_project, run_rules  # noqa: E402
+from tools.lint.baseline import (  # noqa: E402
+    SuppressionError,
+    apply_suppressions,
+)
+from tools.lint.config import Config  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="invariant-aware static analysis for this repo",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="finding output format (github = Actions annotations)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule IDs/titles and exit",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore baseline + inline suppressions (triage mode)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from tools.lint.rules import ALL_RULES
+
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    t0 = time.perf_counter()
+    cfg = Config()
+    proj = load_project(cfg)
+    rule_ids = (
+        {r.strip() for r in args.rules.split(",")} if args.rules else None
+    )
+    if rule_ids is not None:
+        from tools.lint.rules import ALL_RULES
+
+        known = {r.id for r in ALL_RULES}
+        unknown = rule_ids - known
+        if unknown:
+            # A typo'd --rules selecting nothing would exit 0 having
+            # checked nothing — a gate that silently passed.
+            print(
+                f"lint: unknown rule id(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}",
+                file=sys.stderr,
+            )
+            return 2
+    findings = run_rules(proj, cfg, rule_ids)
+    if args.no_baseline:
+        reported, suppressed = findings, []
+    else:
+        try:
+            reported, suppressed = apply_suppressions(
+                proj, cfg, findings, rule_ids
+            )
+        except SuppressionError as e:
+            print(f"lint: suppression error: {e}", file=sys.stderr)
+            return 2
+
+    for f in reported:
+        print(
+            f.render_github() if args.format == "github" else f.render()
+        )
+    dt = time.perf_counter() - t0
+    print(
+        f"lint: {len(proj.files)} files, {len(reported)} finding(s)"
+        f"{f', {len(suppressed)} suppressed' if suppressed else ''}"
+        f" in {dt:.2f}s",
+        file=sys.stderr,
+    )
+    return 1 if reported else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Suppressions: inline comments and the baseline file.
+
+Two mechanisms, both requiring a WRITTEN justification (a suppression
+whose reason nobody recorded is indistinguishable from a bug nobody
+fixed):
+
+- **Inline**: ``# lint: allow(MLA002): <why>`` on the finding's line
+  or the line directly above it. For deliberate single-site patterns
+  (the claim-under-lock/spill-outside shape) where the justification
+  belongs next to the code.
+- **Baseline file** (``tools/lint/baseline.txt``): one entry per
+  line, ``<RULE> <file>::<symbol> -- <why>``, matching findings by
+  (rule, file, enclosing scope) so entries survive line drift. For
+  whole-pattern false positives the rule cannot express.
+
+Both are STRICT: an inline allow with an empty reason, a malformed
+baseline line, or a baseline entry that matched nothing this run
+(stale — the code it excused is gone) are themselves errors. The
+baseline can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tools.lint import Finding
+
+_INLINE_RE = re.compile(
+    r"#\s*lint:\s*allow\((?P<rules>[A-Z0-9, ]+)\)\s*:\s*(?P<why>\S.*)"
+)
+_BASELINE_RE = re.compile(
+    r"^(?P<rule>MLA\d{3})\s+(?P<file>\S+)::(?P<symbol>\S*)\s+--\s+"
+    r"(?P<why>\S.*)$"
+)
+
+
+class SuppressionError(Exception):
+    """Malformed or stale suppression — exit code 2."""
+
+
+def _inline_allows(sf, line: int) -> set[str]:
+    """Rule IDs allowed at ``line`` by a well-formed inline comment on
+    the line or the one above."""
+    allowed: set[str] = set()
+    for ln in (line, line - 1):
+        comment = sf.comments.get(ln)
+        if not comment:
+            continue
+        m = _INLINE_RE.search(comment)
+        if m:
+            allowed.update(
+                r.strip() for r in m.group("rules").split(",")
+            )
+        elif "lint: allow" in comment:
+            raise SuppressionError(
+                f"{sf.path}:{ln}: malformed inline suppression "
+                f"{comment!r} — want `# lint: allow(MLA0xx): reason` "
+                f"with a non-empty reason"
+            )
+    return allowed
+
+
+def load_baseline(path) -> list[dict]:
+    entries = []
+    if not path.is_file():
+        return entries
+    for i, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _BASELINE_RE.match(line)
+        if not m:
+            raise SuppressionError(
+                f"{path.name}:{i}: malformed baseline entry {line!r} "
+                f"— want `MLA0xx path::Class.symbol -- justification`"
+            )
+        entries.append({**m.groupdict(), "line": i, "used": False})
+    return entries
+
+
+def apply_suppressions(proj, cfg, findings: list[Finding],
+                       rule_ids: set[str] | None = None):
+    """Split findings into (reported, suppressed); raises
+    SuppressionError on malformed/stale suppressions. Staleness is
+    only judged for entries whose rule actually RAN this invocation
+    (``rule_ids``; None = all) — a ``--rules MLA001`` triage run must
+    not condemn the MLA002 baseline as stale."""
+    entries = load_baseline(proj.root / cfg.baseline_file)
+    reported: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in findings:
+        sf = proj.get(f.file)
+        if sf is not None and f.rule in _inline_allows(sf, f.line):
+            suppressed.append(f)
+            continue
+        hit = None
+        for e in entries:
+            if (
+                e["rule"] == f.rule
+                and e["file"] == f.file
+                and e["symbol"] == f.symbol
+            ):
+                hit = e
+                break
+        if hit is not None:
+            hit["used"] = True
+            suppressed.append(f)
+        else:
+            reported.append(f)
+    stale = [
+        e for e in entries
+        if not e["used"]
+        and (rule_ids is None or e["rule"] in rule_ids)
+    ]
+    if stale:
+        lines = ", ".join(
+            f"{cfg.baseline_file}:{e['line']} ({e['rule']} "
+            f"{e['file']}::{e['symbol']})"
+            for e in stale
+        )
+        raise SuppressionError(
+            f"stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
+            f"(matched no finding this run — delete, the excused code "
+            f"is gone): {lines}"
+        )
+    return reported, suppressed

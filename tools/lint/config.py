@@ -1,0 +1,182 @@
+"""The repo-contract registry the rules check against.
+
+Everything repo-specific lives HERE, not in the rule logic: which
+attributes are lock-guarded and by which lock, which callables donate
+their arguments, which module must stay async-pure, where the fault
+points and metric exports live. A new shared structure (or a new
+serving module) extends this file; the rules themselves stay generic
+over the registry.
+
+The registries are also what the tier-1 fixture tests parameterize:
+``tests/test_static_analysis.py`` builds a Config pointed at
+``tests/lint_fixtures/`` and asserts each rule flags its minimal
+historical-bug repro at the exact ``file:line``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+@dataclass(frozen=True)
+class LockSpec:
+    """One class's lock discipline: mutations of ``attrs`` (on
+    ``self``) must happen lexically inside ``with self.<lock>`` for a
+    lock named in ``locks``, or inside a method whose name ends in
+    ``_locked`` (the documented caller-holds-the-lock convention)."""
+
+    locks: frozenset[str]
+    attrs: frozenset[str]
+
+
+# -- MLA002: lock-guarded shared state -------------------------------------
+#
+# The shared-mutable registry. Deliberately NOT listed:
+# - PagePool.layers / PagePool.epoch — single-dispatch-thread by
+#   contract (the donation rule's domain, not the lock rule's).
+# - UnitScheduler._pick_seq/_lane_seq/_summary_cache/_summary_seq and
+#   the engine's sched_* counters — dispatch-thread-only by design
+#   (DESIGN §21); registering them would force locks the one-writer
+#   model does not need.
+LOCK_REGISTRY: dict[str, LockSpec] = {
+    "PagePool": LockSpec(
+        locks=frozenset({"lock", "_evict_cond"}),
+        attrs=frozenset({
+            "ref", "_free", "_entries", "_evicting",
+            # Counters: incremented from the decode thread AND the
+            # event loop (brownout evict_idle, admission shed paths),
+            # scraped by /metrics — a bare += is a lost update.
+            "cow_copies", "entry_evictions", "exhaustions",
+        }),
+    ),
+    "KVTier": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            "_blobs", "_bytes", "_seq", "_meta",
+            "spill_count", "spill_bytes", "spill_failures",
+            "restore_hits", "restore_misses", "restore_bytes",
+            "restore_failures", "evictions",
+        }),
+    ),
+    "UnitScheduler": LockSpec(
+        locks=frozenset({"_lock", "_work"}),  # _work wraps _lock
+        attrs=frozenset({
+            "_pending", "_lanes", "_forming_group", "_stopped",
+        }),
+    ),
+    "LatencyStats": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({"_ttft_ms", "_itl_ms"}),
+    ),
+    "MetricsRegistry": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({"_counters", "_histograms"}),
+    ),
+    "Counter": LockSpec(
+        locks=frozenset({"_lock"}), attrs=frozenset({"value"})
+    ),
+    "Histogram": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({"count", "total", "_reservoir"}),
+    ),
+}
+
+# Attribute names distinctive enough to check OUTSIDE their class's
+# own methods (e.g. ``self.eng.pool.cow_copies += n`` from
+# batch_run): a mutation of ``<base>.<attr>`` for these must sit
+# inside ``with <base>.lock``-family for the SAME base expression.
+# Generic names (value, count, ref, total) stay self-scoped — the
+# cross-module check would drown in unrelated matches.
+DISTINCTIVE_ATTRS: dict[str, frozenset[str]] = {
+    "cow_copies": frozenset({"lock"}),
+    "entry_evictions": frozenset({"lock"}),
+    "exhaustions": frozenset({"lock"}),
+    "_free": frozenset({"lock"}),
+    "_entries": frozenset({"lock"}),
+    "_blobs": frozenset({"_lock"}),
+    "spill_failures": frozenset({"_lock"}),
+    "restore_failures": frozenset({"_lock"}),
+}
+
+# Methods on guarded attributes that mutate the container. Reads
+# (len, iteration, .get) stay free — the rule is MUTATION discipline.
+MUTATING_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popitem",
+    "popleft", "remove", "clear", "update", "add", "discard",
+    "setdefault", "move_to_end", "sort",
+})
+
+# -- MLA004: async purity --------------------------------------------------
+# Modules that run ON the event loop and must not import jax or call
+# blocking primitives outside run_in_executor.
+ASYNC_PURE_MODULES = ("mlapi_tpu/serving/router.py",)
+
+# (module, attr) call pairs that block the calling thread.
+BLOCKING_CALLS = frozenset({
+    ("time", "sleep"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("socket", "socket"), ("socket", "create_connection"),
+    ("os", "system"), ("os", "popen"),
+    ("urllib.request", "urlopen"), ("request", "urlopen"),
+    ("requests", "get"), ("requests", "post"),
+})
+# Bare builtins that block (sync file IO on the event loop).
+BLOCKING_BUILTINS = frozenset({"open"})
+
+# -- MLA005: metrics -------------------------------------------------------
+# Dotted metric tokens. Brace shorthand in docs
+# (``generate.shed_{queue_full,...}``) stops the match at the brace,
+# leaving a prefix the satisfiability check handles; file-path
+# lookalikes (``batcher.py::...``) are filtered in the rule.
+METRIC_NAME_RE = r"(?:generate|batcher|router|replica|http)\.[A-Za-z0-9_]+(?:\.[A-Za-z0-9_]+)*"
+# Families whose exported names are constructed dynamically (router
+# relabels replica gauges, sums arbitrary replica counters; http
+# route labels are f-strings). A scraped/doc name under these
+# prefixes is satisfiable by construction.
+DYNAMIC_METRIC_PREFIXES = ("replica.", "router.", "http.")
+
+# -- default scan set ------------------------------------------------------
+DEFAULT_PY_GLOBS = (
+    "mlapi_tpu/**/*.py",
+    "tests/**/*.py",
+    "tools/**/*.py",
+    "bench.py",
+)
+# The fixtures are DELIBERATE violations (the negative tests); the
+# clean-tree run must not see them. datasets/docs_corpus holds
+# corpus text, not code.
+DEFAULT_EXCLUDES = (
+    "tests/lint_fixtures/",
+    "mlapi_tpu/datasets/docs_corpus/",
+)
+
+
+@dataclass
+class Config:
+    root: Path = REPO_ROOT
+    py_globs: tuple[str, ...] = DEFAULT_PY_GLOBS
+    exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDES
+    # Role anchors (repo-relative); rules no-op when absent so a
+    # fixture Config can exercise one rule in isolation.
+    faults_module: str = "mlapi_tpu/serving/faults.py"
+    latency_stats_module: str = "mlapi_tpu/serving/requests.py"
+    # Where fire() seams live / where donation+locks apply.
+    production_prefix: str = "mlapi_tpu/"
+    serving_prefix: str = "mlapi_tpu/serving/"
+    # Where fault-matrix coverage and metric scrapes are read from.
+    test_prefix: str = "tests/"
+    bench_files: tuple[str, ...] = ("bench.py",)
+    doc_files: tuple[str, ...] = ("README.md", "docs/DESIGN.md")
+    async_pure_modules: tuple[str, ...] = ASYNC_PURE_MODULES
+    lock_registry: dict = field(
+        default_factory=lambda: dict(LOCK_REGISTRY)
+    )
+    distinctive_attrs: dict = field(
+        default_factory=lambda: dict(DISTINCTIVE_ATTRS)
+    )
+    baseline_file: str = "tools/lint/baseline.txt"

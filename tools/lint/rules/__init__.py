@@ -1,0 +1,21 @@
+"""Rule registry: stable IDs -> implementations.
+
+IDs are append-only (a baseline entry or suppression names them;
+renumbering would orphan every written justification).
+"""
+
+from tools.lint.rules.donation import DonationRule
+from tools.lint.rules.hygiene import TestHygieneRule
+from tools.lint.rules.locks import LockRule
+from tools.lint.rules.metrics_consistency import MetricsRule
+from tools.lint.rules.router_purity import RouterPurityRule
+from tools.lint.rules.seams import SeamRule
+
+ALL_RULES = (
+    DonationRule(),       # MLA001
+    LockRule(),           # MLA002
+    SeamRule(),           # MLA003
+    RouterPurityRule(),   # MLA004
+    MetricsRule(),        # MLA005
+    TestHygieneRule(),    # MLA006
+)

@@ -1,0 +1,171 @@
+"""Shared AST plumbing for the rules: expression fingerprints,
+parent/scope maps, lock-enclosure and mutation detection.
+
+Everything here is lexical and intraprocedural on purpose — see the
+package docstring. The helpers return LINE-ANCHORED facts; the rules
+turn them into findings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def fingerprint(node: ast.AST) -> str:
+    """Structural identity of an expression, ignoring Load/Store
+    context — ``self.layers`` as a read and as an assignment target
+    fingerprint identically."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{fingerprint(node.value)}.{node.attr}"
+    if isinstance(node, ast.Subscript):
+        return f"{fingerprint(node.value)}[]"
+    if isinstance(node, ast.Call):
+        return f"{fingerprint(node.func)}()"
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    return ast.dump(node, annotate_fields=False, include_attributes=False)
+
+
+def ancestors(node: ast.AST, parents: dict) -> list[ast.AST]:
+    out = []
+    while node in parents:
+        node = parents[node]
+        out.append(node)
+    return out
+
+
+def enclosing_function(node: ast.AST, parents: dict):
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """``self.eng.pool.lock`` -> ["self","eng","pool","lock"]; None
+    for anything that is not a pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def inside_with_lock(node: ast.AST, parents: dict, base_fp: str,
+                     lock_names: frozenset[str]) -> bool:
+    """Is ``node`` lexically inside ``with <base>.<lock>`` (or
+    ``with <base>.<lock>:``-condition) where ``<base>`` fingerprints
+    to ``base_fp`` and ``<lock>`` is a registered lock name?"""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                ctx = item.context_expr
+                if (
+                    isinstance(ctx, ast.Attribute)
+                    and ctx.attr in lock_names
+                    and fingerprint(ctx.value) == base_fp
+                ):
+                    return True
+    return False
+
+
+class MutationSite:
+    """One mutation of ``<base>.<attr>``: line + the base expression's
+    fingerprint + the mutated node (for enclosure walks)."""
+
+    __slots__ = ("node", "line", "base_fp", "attr", "how")
+
+    def __init__(self, node, line, base_fp, attr, how):
+        self.node = node
+        self.line = line
+        self.base_fp = base_fp
+        self.attr = attr
+        self.how = how  # "assign" | "augassign" | "call" | "np-at" | "subscript"
+
+
+def _attr_target(node: ast.AST):
+    """(base_node, attr) if node is Attribute; descend one Subscript
+    level so ``self.ref[pages] = 1`` mutates ``self.ref``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.value, node.attr
+    return None
+
+
+def find_mutations(func: ast.AST, attrs: frozenset[str],
+                   shallow: bool = False):
+    """Every mutation of ``<anything>.<attr>`` for ``attr`` in
+    ``attrs`` within ``func``: assignments (incl. one subscript
+    level), aug-assignments, mutating container-method calls, and
+    ``np.add.at/np.subtract.at`` on the attribute. ``shallow`` skips
+    nested function bodies — for callers that iterate every function
+    (nested included) and must charge each mutation to its INNERMOST
+    frame exactly once."""
+    sites: list[MutationSite] = []
+
+    def note(node, tgt, how):
+        hit = _attr_target(tgt)
+        if hit is None:
+            return
+        base, attr = hit
+        if attr in attrs:
+            sites.append(MutationSite(
+                node, node.lineno, fingerprint(base), attr, how
+            ))
+
+    for node in (walk_shallow(func) if shallow else ast.walk(func)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    note(node, el, "assign")
+        elif isinstance(node, (ast.AugAssign,)):
+            note(node, node.target, "augassign")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                note(node, t, "assign")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                from tools.lint.config import MUTATING_METHODS
+
+                if f.attr in MUTATING_METHODS:
+                    note(node, f.value, "call")
+                # np.add.at(self.ref, ...) / np.subtract.at(...)
+                elif f.attr == "at" and node.args:
+                    note(node, node.args[0], "np-at")
+    return sites
+
+
+def walk_shallow(func: ast.AST):
+    """Walk ``func``'s own nodes WITHOUT descending into nested
+    function/lambda bodies — intraprocedural analyses must not see a
+    sibling closure's reads as this frame's (the make_train_step
+    false-positive shape)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def decorator_names(node) -> set[str]:
+    """Flattened dotted names of a def's decorators
+    (``pytest.mark.heavy`` -> "pytest.mark.heavy")."""
+    out: set[str] = set()
+    for dec in getattr(node, "decorator_list", ()):  # pragma: no branch
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = attr_chain(target)
+        if chain:
+            out.add(".".join(chain))
+    return out

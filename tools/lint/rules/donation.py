@@ -1,0 +1,256 @@
+"""MLA001 — donation discipline (the r12/r13/r15 poisoning class).
+
+A ``jax.jit(..., donate_argnums=...)`` program CONSUMES the buffers
+bound at its donated positions: after the call those arrays are
+deleted, and any later read dies on deleted buffers — at dispatch
+time, far from the bug. Three PRs in a row shipped exactly this shape
+(a fallback path reading ``pool.layers`` a failed donated restore had
+consumed; a stale lane pytree written back over the live pool) and
+each was only caught in review.
+
+The rule, lexical and intraprocedural like every incident it encodes:
+
+1. **Factory pass (whole tree).** A function whose body returns
+   ``jax.jit(f, donate_argnums=(...))`` is a *donating factory*; its
+   name maps to the donated positional indices of the returned
+   callable. Local ``g = jax.jit(f, donate_argnums=...)`` bindings
+   register the same way within their function and any nested
+   closure (the ``make_train_step`` shape) — but each frame's
+   read/rebind analysis never crosses a function boundary.
+2. **Call-site pass.** ``factory(...)(a0, a1, ...)`` (or a local
+   jitted name called directly) donates the argument expressions at
+   the registered indices. For each donated Name/Attribute argument:
+
+   - the call statement itself rebinding the expression
+     (``x = fac()(x, ...)`` — tuple targets count) is the documented
+     write-back: fine;
+   - otherwise, a lexically later READ of the same expression in the
+     same function, BEFORE a rebind event — a (re)assignment of the
+     expression, a ``<base>.epoch`` bump, or a call to a
+     ``*rebind*``/``*writeback*``/``*_paged_cleanup*`` helper — is a
+     poisoning read: flagged at the read's line.
+
+Control flow is ignored (no loop back-edges, no cross-function
+dataflow) — the historical bugs were all lexically visible, and a
+rule that guessed at more would need suppressing everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.rules import common
+
+_REBIND_HINTS = ("rebind", "writeback", "write_back", "paged_cleanup")
+
+
+def _donate_indices(call: ast.Call) -> tuple[int, ...] | None:
+    """``jax.jit(f, donate_argnums=...)`` -> the donated indices."""
+    chain = common.attr_chain(call.func)
+    if chain is None or chain[-1] != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                return tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return None
+
+
+def _collect_factories(files) -> dict[str, tuple[int, ...]]:
+    factories: dict[str, tuple[int, ...]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Return)
+                    and isinstance(sub.value, ast.Call)
+                ):
+                    idx = _donate_indices(sub.value)
+                    if idx:
+                        prev = factories.get(node.name, ())
+                        factories[node.name] = tuple(
+                            sorted(set(prev) | set(idx))
+                        )
+    return factories
+
+
+class DonationRule:
+    id = "MLA001"
+    title = "donated buffers must not be read after dispatch"
+
+    def run(self, proj, cfg):
+        files = [
+            f for f in proj.files
+            if f.path.startswith(cfg.production_prefix)
+        ]
+        factories = _collect_factories(files)
+        findings: list[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            self._visit_scope(sf, sf.tree, factories, {},
+                              sf.parents(), findings)
+        return findings
+
+    # -- per-function analysis ----------------------------------------
+
+    def _visit_scope(self, sf, scope, factories, inherited, parents,
+                     findings):
+        """Recurse function-by-function, carrying jit bindings down
+        the closure chain (``jitted = jax.jit(...)`` in an enclosing
+        function is callable from a nested one), while each frame's
+        read/rebind analysis stays strictly intraprocedural
+        (``walk_shallow``)."""
+        local = dict(inherited)
+        for node in common.walk_shallow(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                idx = _donate_indices(node.value)
+                if idx:
+                    local[node.targets[0].id] = idx
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(
+                self._check_function(sf, scope, factories, local,
+                                     parents)
+            )
+        for node in common.walk_shallow(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit_scope(sf, node, factories, local, parents,
+                                  findings)
+
+    def _check_function(self, sf, func, factories, local, parents):
+        findings = []
+        for node in common.walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            idx = self._donating_call(node, factories, local)
+            if idx is None:
+                continue
+            stmt = self._enclosing_stmt(node, parents)
+            if stmt is None:
+                continue
+            for i in idx:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                fp = common.fingerprint(arg)
+                if self._rebound_in_stmt(stmt, fp):
+                    continue
+                hit = self._read_before_rebind(
+                    func, stmt, fp
+                )
+                if hit is not None:
+                    findings.append(Finding(
+                        rule=self.id,
+                        file=sf.path,
+                        line=hit,
+                        message=(
+                            f"`{fp}` is read after being donated to a "
+                            f"donate_argnums dispatch at line "
+                            f"{stmt.lineno} with no write-back/epoch "
+                            f"rebind in between — the buffer is "
+                            f"consumed (r12/r13/r15 poisoning class)"
+                        ),
+                        symbol=sf.symbol_at(hit),
+                    ))
+        return findings
+
+    @staticmethod
+    def _donating_call(node: ast.Call, factories, local):
+        # factory(...)(args) — outer call whose func is a call of a
+        # known factory name.
+        f = node.func
+        if isinstance(f, ast.Call):
+            chain = common.attr_chain(f.func)
+            if chain and chain[-1] in factories:
+                return factories[chain[-1]]
+            return None
+        # jitted-name(args) — local jax.jit binding called directly.
+        if isinstance(f, ast.Name) and f.id in local:
+            return local[f.id]
+        return None
+
+    @staticmethod
+    def _enclosing_stmt(node, parents):
+        for anc in [node] + common.ancestors(node, parents):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return None
+
+    @staticmethod
+    def _rebound_in_stmt(stmt, fp: str) -> bool:
+        """The donating statement assigns the donated expression
+        (directly or inside a tuple target): the documented same-
+        statement write-back."""
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                if common.fingerprint(el) == fp:
+                    return True
+        return False
+
+    @staticmethod
+    def _read_before_rebind(func, stmt, fp: str) -> int | None:
+        """First line > the donating statement that READS ``fp``
+        before any rebind event; None when the first event is a
+        rebind (or there are no events)."""
+        start = stmt.end_lineno or stmt.lineno
+        base = fp.rsplit(".", 1)[0] if "." in fp else fp
+        events: list[tuple[int, str]] = []  # (line, "read"|"rebind")
+        for node in common.walk_shallow(func):
+            line = getattr(node, "lineno", None)
+            if line is None or line <= start:
+                continue
+            # Rebind events -------------------------------------------------
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    els = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for el in els:
+                        efp = common.fingerprint(el)
+                        if efp == fp or efp == f"{base}.epoch":
+                            events.append((line, "rebind"))
+            if isinstance(node, ast.Call):
+                chain = common.attr_chain(node.func)
+                if chain and any(
+                    h in chain[-1] for h in _REBIND_HINTS
+                ):
+                    events.append((line, "rebind"))
+            # Read events ---------------------------------------------------
+            if (
+                isinstance(node, (ast.Name, ast.Attribute))
+                and isinstance(getattr(node, "ctx", None), ast.Load)
+                and common.fingerprint(node) == fp
+            ):
+                events.append((line, "read"))
+        events.sort()
+        for line, kind in events:
+            if kind == "rebind":
+                return None
+            return line
+        return None

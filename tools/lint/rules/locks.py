@@ -1,0 +1,143 @@
+"""MLA002 — lock discipline over registered shared state.
+
+The serving stack's shared-mutable surfaces — PagePool counters and
+freelists, KVTier byte/entry accounting, UnitScheduler queue/forming
+slots, the latency reservoirs — are mutated from at least two threads
+(decode/dispatch thread, event loop, registration threads). Their
+classes document one lock each; a mutation that slips outside it is a
+lost update or a torn container at load, invisible in single-threaded
+tests. This rule makes "mutations of registered attributes happen
+inside ``with self.<lock>``" checkable.
+
+Two detection modes, both over ``tools/lint/config.py``'s registry:
+
+- **Self-scoped.** Inside methods of a registered class, every
+  mutation of ``self.<attr>`` for a registered attr must be lexically
+  inside ``with self.<lock>`` for one of the class's registered lock
+  names (Condition wrappers like ``_work``/``_evict_cond`` that share
+  the lock are registered alongside it).
+- **Cross-module.** For the handful of DISTINCTIVE attribute names
+  (``cow_copies``, ``_free``, ``_blobs``, ...), a mutation of
+  ``<base>.<attr>`` anywhere in production code must sit inside
+  ``with <base>.<lock>`` for the SAME base expression — this is what
+  catches ``self.eng.pool.cow_copies += n`` from another module.
+
+Deliberate exceptions, encoded rather than suppressed ad hoc:
+
+- ``__init__`` bodies (construction precedes sharing);
+- methods whose name ends in ``_locked`` (the repo's documented
+  caller-holds-the-lock convention, e.g. ``_release_locked``);
+- the claim-under-lock/spill-outside pattern is already shaped this
+  way in the registry: the spill path's tier/counter work happens on
+  popped (invisible) state, and the counters it does touch are
+  registered so the rule FORCES them back under the lock — that is
+  rule-driven fix r16 shipped, not a false positive.
+
+Anything genuinely single-writer stays OUT of the registry (see the
+config's comment) instead of being suppressed at every site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.rules import common
+
+
+class LockRule:
+    id = "MLA002"
+    title = "registered shared state must be mutated under its lock"
+
+    def run(self, proj, cfg):
+        findings: list[Finding] = []
+        for sf in proj.files:
+            if not sf.path.startswith(cfg.production_prefix):
+                continue
+            if sf.tree is None:
+                continue
+            parents = sf.parents()
+            findings.extend(self._self_scoped(sf, cfg, parents))
+            findings.extend(self._cross_module(sf, cfg, parents))
+        return findings
+
+    # -- mode 1: methods of registered classes -------------------------
+
+    def _self_scoped(self, sf, cfg, parents):
+        findings = []
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            spec = cfg.lock_registry.get(cls.name)
+            if spec is None:
+                continue
+            for meth in cls.body:
+                if not isinstance(
+                    meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if meth.name == "__init__" or meth.name.endswith(
+                    "_locked"
+                ):
+                    continue
+                for site in common.find_mutations(meth, spec.attrs):
+                    if site.base_fp != "self":
+                        continue
+                    if common.inside_with_lock(
+                        site.node, parents, "self", spec.locks
+                    ):
+                        continue
+                    findings.append(Finding(
+                        rule=self.id,
+                        file=sf.path,
+                        line=site.line,
+                        message=(
+                            f"`self.{site.attr}` ({site.how}) mutated "
+                            f"outside `with self."
+                            f"{min(spec.locks, key=lambda n: (len(n), n))}` in "
+                            f"{cls.name}.{meth.name} — registered "
+                            f"shared state (see tools/lint/config.py)"
+                        ),
+                        symbol=sf.symbol_at(site.line),
+                    ))
+        return findings
+
+    # -- mode 2: distinctive attrs anywhere ----------------------------
+
+    def _cross_module(self, sf, cfg, parents):
+        findings = []
+        attrs = frozenset(cfg.distinctive_attrs)
+        for func in ast.walk(sf.tree):
+            if not isinstance(
+                func, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if func.name == "__init__" or func.name.endswith("_locked"):
+                continue
+            # shallow: ast.walk above visits nested defs as their own
+            # functions, so a deep scan here would report a closure's
+            # mutation twice (once for each enclosing frame).
+            for site in common.find_mutations(func, attrs, shallow=True):
+                if site.base_fp == "self":
+                    # self-scoped mode owns these (registry class) —
+                    # or the attr lives on an unregistered class,
+                    # where `self.<distinctive>` would double-report.
+                    continue
+                locks = cfg.distinctive_attrs[site.attr]
+                if common.inside_with_lock(
+                    site.node, parents, site.base_fp, locks
+                ):
+                    continue
+                findings.append(Finding(
+                    rule=self.id,
+                    file=sf.path,
+                    line=site.line,
+                    message=(
+                        f"`{site.base_fp}.{site.attr}` ({site.how}) "
+                        f"mutated outside `with {site.base_fp}."
+                        f"{sorted(locks)[0]}` — cross-module access "
+                        f"to registered shared state"
+                    ),
+                    symbol=sf.symbol_at(site.line),
+                ))
+        return findings

@@ -1,0 +1,126 @@
+"""MLA004 — router async purity.
+
+``serving/router.py`` runs ON the event loop and fronts the whole
+fleet: one blocking call freezes every concurrent relay, the health
+poll, and the drain path at once (and ``import jax`` would pull a
+device runtime into a process whose whole point is having none —
+its docstring promises both). The contract held by review so far;
+this rule pins it.
+
+Flags, in the configured async-pure modules:
+
+- any ``import jax`` / ``from jax import ...`` (including inside
+  functions — lazy imports count);
+- any CALL of a blocking primitive (``time.sleep``, sync
+  ``subprocess``/``socket``/``os.system``, builtin ``open``) unless
+  the call sits inside a SYNC nested function handed to
+  ``run_in_executor`` (the documented escape hatch —
+  ``_fire_async`` passes ``faults.fire`` uncalled, which needs no
+  exemption because there is no call node).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.config import BLOCKING_BUILTINS, BLOCKING_CALLS
+from tools.lint.rules import common
+
+
+def _executor_fn_names(tree) -> set[str]:
+    """Names of functions/lambdas referenced as run_in_executor
+    arguments (``loop.run_in_executor(None, fn, *args)``)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = common.attr_chain(node.func)
+        if not chain or chain[-1] != "run_in_executor":
+            continue
+        for arg in node.args[1:]:
+            c = common.attr_chain(arg)
+            if c:
+                out.add(c[-1])
+    return out
+
+
+class RouterPurityRule:
+    id = "MLA004"
+    title = "async-pure modules: no jax import, no blocking calls"
+
+    def run(self, proj, cfg):
+        findings: list[Finding] = []
+        for rel in cfg.async_pure_modules:
+            sf = proj.get(rel)
+            if sf is None or sf.tree is None:
+                continue
+            parents = sf.parents()
+            executor_fns = _executor_fn_names(sf.tree)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        root = alias.name.split(".")[0]
+                        if root == "jax":
+                            findings.append(self._f(
+                                sf, node.lineno,
+                                "`import jax` in an async-pure module "
+                                "— the router serves fleets with no "
+                                "device runtime by contract",
+                            ))
+                elif isinstance(node, ast.ImportFrom):
+                    root = (node.module or "").split(".")[0]
+                    if root == "jax":
+                        findings.append(self._f(
+                            sf, node.lineno,
+                            "`from jax import ...` in an async-pure "
+                            "module",
+                        ))
+                elif isinstance(node, ast.Call):
+                    label = self._blocking(node)
+                    if label is None:
+                        continue
+                    if self._under_executor_fn(
+                        node, parents, executor_fns
+                    ):
+                        continue
+                    findings.append(self._f(
+                        sf, node.lineno,
+                        f"blocking call `{label}` on the event loop — "
+                        f"wrap in run_in_executor (one blocked loop "
+                        f"freezes every relay and the health poll)",
+                    ))
+        return findings
+
+    @staticmethod
+    def _blocking(node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
+            return f.id
+        chain = common.attr_chain(f)
+        if chain and len(chain) >= 2:
+            mod, attr = ".".join(chain[:-1]), chain[-1]
+            if (mod, attr) in BLOCKING_CALLS or (
+                (chain[-2], attr) in BLOCKING_CALLS
+            ):
+                return f"{mod}.{attr}"
+        return None
+
+    @staticmethod
+    def _under_executor_fn(node, parents, executor_fns) -> bool:
+        for anc in common.ancestors(node, parents):
+            if isinstance(anc, ast.Lambda):
+                return True  # lambdas only run when invoked elsewhere
+            if isinstance(anc, ast.FunctionDef) and (
+                anc.name in executor_fns
+            ):
+                return True
+            if isinstance(anc, ast.AsyncFunctionDef):
+                return False  # reached the event-loop frame: blocking
+        return False
+
+    def _f(self, sf, line, msg):
+        return Finding(
+            rule=self.id, file=sf.path, line=line, message=msg,
+            symbol=sf.symbol_at(line),
+        )

@@ -1,0 +1,209 @@
+"""MLA003 — fault-seam ordering and coverage.
+
+``serving/faults.py`` is the chaos-drill contract: every POINTS entry
+is a named seam tests can arm, and the engine's recovery invariants
+are only as strong as (a) the point actually firing at its seam,
+(b) firing BEFORE the state mutation it guards (a fire placed after
+the mutation "tests" a failure mode that leaves state already
+corrupted — the r13 tier_spill review comment), and (c) at least one
+test arming it. All three decay silently as code moves; this rule
+pins them.
+
+Checks:
+
+1. **Known points only.** Every ``faults.fire("<p>")`` /
+   ``_fire_async("<p>")`` argument must be a POINTS member (a typo'd
+   point never fires and the drill silently tests nothing — the same
+   loudness argument ``faults.parse`` makes for spec strings).
+2. **Every point fires.** Each POINTS entry must have >= 1 fire site
+   in production code.
+3. **Every point is drilled.** Each POINTS entry must appear in >= 1
+   test file's string constants (a ``faults.active`` spec, an
+   ``MLAPI_FAULTS`` env, or a fault-matrix list).
+4. **Fire-before-mutation.** At each fire site, no lexically earlier
+   statement in the same function may have mutated a REGISTERED
+   shared attribute (MLA002's registry — the state whose
+   consistency the seam exists to drill). Lock state does not matter
+   here: ordering is the property.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.rules import common
+
+
+def _points(sf) -> dict[str, int]:
+    """POINTS tuple -> {name: lineno} from the faults module."""
+    out: dict[str, int] = {}
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "POINTS":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        for el in node.value.elts:
+                            if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str
+                            ):
+                                out[el.value] = el.lineno
+    return out
+
+
+def _fire_calls(sf):
+    """(call_node, point_name|None, line) for every fire-family call."""
+    if sf.tree is None:
+        return []
+    hits = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = common.attr_chain(node.func)
+        if not chain:
+            continue
+        name = chain[-1]
+        if name not in ("fire", "_fire_async"):
+            continue
+        if name == "fire" and not (
+            len(chain) >= 2 and chain[-2] == "faults"
+        ):
+            continue
+        point = None
+        if node.args and isinstance(node.args[0], ast.Constant) and (
+            isinstance(node.args[0].value, str)
+        ):
+            point = node.args[0].value
+        hits.append((node, point, node.lineno))
+    return hits
+
+
+class SeamRule:
+    id = "MLA003"
+    title = "fault points: known, fired, drilled, fire-before-mutation"
+
+    def run(self, proj, cfg):
+        faults_sf = proj.get(cfg.faults_module)
+        points = _points(faults_sf)
+        if not points:
+            return []  # no faults module in this scan set
+        findings: list[Finding] = []
+
+        prod = [
+            f for f in proj.files
+            if f.path.startswith(cfg.production_prefix)
+        ]
+        fired: dict[str, int] = {}
+        guarded_attrs = frozenset().union(
+            *(s.attrs for s in cfg.lock_registry.values())
+        ) | frozenset(cfg.distinctive_attrs)
+
+        for sf in prod:
+            if sf.path == cfg.faults_module or sf.tree is None:
+                continue
+            parents = sf.parents()
+            for node, point, line in _fire_calls(sf):
+                if point is None:
+                    continue
+                if point not in points:
+                    findings.append(Finding(
+                        rule=self.id, file=sf.path, line=line,
+                        message=(
+                            f"faults.fire({point!r}): unknown point — "
+                            f"not in serving/faults.py POINTS (a typo "
+                            f"here never fires; the drill silently "
+                            f"tests nothing)"
+                        ),
+                        symbol=sf.symbol_at(line),
+                    ))
+                    continue
+                fired.setdefault(point, 0)
+                fired[point] += 1
+                findings.extend(self._ordering(
+                    sf, node, point, line, parents, guarded_attrs
+                ))
+
+        # Coverage: every point fires somewhere...
+        for point, decl_line in points.items():
+            if not fired.get(point):
+                findings.append(Finding(
+                    rule=self.id, file=faults_sf.path, line=decl_line,
+                    message=(
+                        f"fault point {point!r} is declared but never "
+                        f"fired from any seam in production code"
+                    ),
+                    symbol="POINTS",
+                ))
+        # ...and is ARMED by at least one test. Two recognized arming
+        # shapes: (a) a literal clause — per the MLAPI_FAULTS grammar,
+        # comma-separated clauses whose first ``:``-field is the point
+        # (bare substring search would let a docstring merely
+        # MENTIONING the point satisfy the check — that vacuousness
+        # was itself a review catch); (b) the dynamic matrix — a test
+        # file that reads ``faults.POINTS`` and calls ``faults.arm``/
+        # ``faults.active`` arms every declared point by construction
+        # (test_robustness's parametrized conservation sweep). Delete
+        # the matrix and the POINTS reference disappears with it, so
+        # the check bites again.
+        armed: set[str] = set()
+        for sf in proj.files:
+            if not sf.path.startswith(cfg.test_prefix):
+                continue
+            if sf.tree is None:
+                continue
+            reads_points = False
+            arms = False
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    for clause in node.value.split(","):
+                        armed.add(clause.split(":")[0].strip())
+                elif isinstance(node, ast.Attribute) and (
+                    node.attr == "POINTS"
+                ):
+                    reads_points = True
+                elif isinstance(node, ast.Call):
+                    chain = common.attr_chain(node.func)
+                    if chain and chain[-1] in ("arm", "active"):
+                        arms = True
+            if reads_points and arms:
+                armed.update(points)
+        for point, decl_line in points.items():
+            if point not in armed:
+                findings.append(Finding(
+                    rule=self.id, file=faults_sf.path, line=decl_line,
+                    message=(
+                        f"fault point {point!r} is armed by no test "
+                        f"(no spec-shaped string in {cfg.test_prefix} "
+                        f"names it as a clause) — the seam is "
+                        f"undrilled"
+                    ),
+                    symbol="POINTS",
+                ))
+        return findings
+
+    def _ordering(self, sf, call, point, line, parents, guarded_attrs):
+        func = common.enclosing_function(call, parents)
+        if func is None:
+            return []
+        findings = []
+        for site in common.find_mutations(func, guarded_attrs):
+            if site.line < line:
+                findings.append(Finding(
+                    rule=self.id, file=sf.path, line=line,
+                    message=(
+                        f"faults.fire({point!r}) fires AFTER a "
+                        f"mutation of guarded state "
+                        f"`{site.base_fp}.{site.attr}` at line "
+                        f"{site.line} in the same function — an "
+                        f"injected failure here leaves the mutation "
+                        f"already applied, so the drill exercises a "
+                        f"corrupted-state path, not the seam"
+                    ),
+                    symbol=sf.symbol_at(line),
+                ))
+                break  # one finding per fire site
+        return findings
