@@ -150,6 +150,8 @@ def _forwarded_engine_flags(args) -> list:
         # exactly as it would single-process (main() also rejects it
         # before supervising).
         cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
+    if getattr(args, "kv_peer_fetch", False):
+        cmd += ["--kv-peer-fetch"]
     if not getattr(args, "prefill_page_native", True):
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
@@ -581,6 +583,20 @@ def main(argv=None) -> None:
              "geometry is dropped, never restored wrong",
     )
     parser.add_argument(
+        "--kv-peer-fetch", action="store_true", default=False,
+        help="peer-to-peer prefix-KV fetch between router replicas: "
+             "serve this replica's warm prefix blobs on GET "
+             "/kv/prefix (stored format — int8 KV crosses the wire "
+             "at half the bytes) and, on a local miss, fetch the "
+             "blob from the replica the router's x-mlapi-warm-peer "
+             "hint names instead of cold-prefilling — a failover, "
+             "drain, or depth overflow costs one host-to-host copy, "
+             "not an O(P^2) re-prefill. Off (default): bit-identical "
+             "to r16. Watch generate.kv_peer_fetch_hits / "
+             "kv_peer_serve_bytes on /metrics. Generative "
+             "checkpoints only",
+    )
+    parser.add_argument(
         "--prefill-page-native", action=argparse.BooleanOptionalAction,
         default=True,
         help="with --kv-page-size: prefill writes K/V straight into "
@@ -805,6 +821,7 @@ def main(argv=None) -> None:
         prefill_interleave=args.prefill_interleave,
         kv_tier_bytes=args.kv_tier_bytes,
         kv_tier_disk_dir=args.kv_tier_disk_dir,
+        kv_peer_fetch=args.kv_peer_fetch,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         scheduler=args.scheduler,

@@ -99,6 +99,23 @@ def _router_depth(request) -> int:
     return 0
 
 
+def _warm_peer(request) -> str | None:
+    """The warm-peer hint a fronting router stamps on any forward
+    that misses the request's HRW-preferred replica
+    (``x-mlapi-warm-peer: host:port`` — who is likely warm for this
+    prefix). Same raw-scope scan and same trust model as
+    ``_router_depth``: read only on router replicas, and the router
+    strips client-sent copies, so an arbitrary caller can never aim
+    this replica's KV fetches at a host of their choosing."""
+    for k, v in request.scope.get("headers", []):
+        if k == b"x-mlapi-warm-peer":
+            try:
+                return v.decode("latin-1").strip() or None
+            except Exception:
+                return None
+    return None
+
+
 def _overloaded_http(e: OverloadedError) -> HTTPError:
     """Overload → immediate 503 with a Retry-After hint. Shedding at
     the door keeps latency bounded for the requests that ARE admitted;
@@ -175,6 +192,14 @@ def build_app(
         engine.admission_control = bool(admission_control)
         engine.drain_timeout_s = float(drain_timeout_s)
         _install_generate(app, engine)
+        if getattr(engine, "kv_peer", None) is not None and (
+            _is_router_replica()
+        ):
+            # Replica-gated like the hint header itself: outside a
+            # router fleet there is no trusted hinter, and the
+            # endpoint would only be a cache-presence oracle handing
+            # raw KV bytes to arbitrary direct callers.
+            _install_kv_peer(app, engine)
     else:
         batcher = MicroBatcher(
             engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -343,6 +368,13 @@ def _install_generate(app: App, engine) -> None:
         # (the header is untrusted from arbitrary direct callers).
         if is_replica:
             engine.router_queue_depth = _router_depth(request)
+            # Warm-peer hint (r17): noted BEFORE submit so the encode
+            # thread's prefix miss can fetch the blob from the peer
+            # the router named instead of cold-prefilling.
+            if engine.kv_peer is not None and req.prefix:
+                wp = _warm_peer(request)
+                if wp:
+                    engine.kv_peer.note_hint(req.prefix, wp)
         n_new = (
             req.max_new_tokens
             if req.max_new_tokens is not None
@@ -543,6 +575,37 @@ def _install_generate(app: App, engine) -> None:
         if stopped is not None:
             out["stopped"] = stopped[1]
         return out
+
+
+def _install_kv_peer(app: App, engine) -> None:
+    """The internal replica↔replica KV endpoint (``--kv-peer-fetch``):
+    ``GET /kv/prefix?fp=<digest>`` serves this replica's blob for a
+    prefix fingerprint — stored-format bytes straight off the host
+    tier (or gathered from the device-resident entry's contiguous
+    KV), geometry header included (``serving/kv_peer.py`` wire
+    format). Deliberately a GET with no engine-submit gate: it keeps
+    answering while DRAINING, which is exactly the window a peer
+    needs the drained replica's slice. The resolve + serialize run on
+    an executor thread — the entry-KV gather is a device_get and must
+    not freeze the event loop."""
+    peer = engine.kv_peer
+
+    @app.get("/kv/prefix")
+    async def kv_prefix(request: Request):
+        from urllib.parse import parse_qs
+
+        qs = parse_qs(
+            (request.scope.get("query_string") or b"").decode("latin-1")
+        )
+        digest = (qs.get("fp") or [""])[0]
+        if not digest:
+            raise HTTPError(422, "missing fp=<fingerprint digest>")
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, peer.serve_wire, digest
+        )
+        if data is None:
+            raise HTTPError(404, "no warm KV for that fingerprint")
+        return Response(data, content_type="application/octet-stream")
 
 
 def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> None:
@@ -974,6 +1037,32 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
                 )
                 snap["gauges"]["generate.kv_tier_entries"] = (
                     engine.kv_tier_entries
+                )
+            if getattr(engine, "kv_peer", None) is not None:
+                # Peer-to-peer prefix-KV fetch (r17): wire traffic in
+                # and out, exact payload-byte arithmetic per blob
+                # (never wall-clock). fetch_hits moving while
+                # prefix_builds stays flat IS the transferred-warmth
+                # claim; the router SUMS these across replicas like
+                # every other generate counter, so the fleet dashboard
+                # reads total KV moved peer-to-peer directly.
+                snap["counters"]["generate.kv_peer_fetch_hits"] = (
+                    engine.kv_peer_fetch_hits
+                )
+                snap["counters"]["generate.kv_peer_fetch_misses"] = (
+                    engine.kv_peer_fetch_misses
+                )
+                snap["counters"]["generate.kv_peer_fetch_bytes"] = (
+                    engine.kv_peer_fetch_bytes
+                )
+                snap["counters"]["generate.kv_peer_fetch_failures"] = (
+                    engine.kv_peer_fetch_failures
+                )
+                snap["counters"]["generate.kv_peer_serve_count"] = (
+                    engine.kv_peer_serve_count
+                )
+                snap["counters"]["generate.kv_peer_serve_bytes"] = (
+                    engine.kv_peer_serve_bytes
                 )
         return snap
 

@@ -573,6 +573,14 @@ class BatchRun:
             # holds=b: every live row's reference is taken atomically
             # with the entry lookup — a concurrent LRU eviction of
             # this entry can then only drop the ENTRY's own hold.
+            # This call is ALSO where fleet warmth lands on the
+            # dispatch thread (r17): a peer-fetched blob was staged
+            # into the local tier at encode time (PrefixCache._restore,
+            # executor thread), so paged_entry's tier consult finds it
+            # HERE and restores pool pages through the alloc-first
+            # restore_entry path — the formation never does wire I/O,
+            # and a mid-restore failure conserves pages exactly like
+            # the r13 local-tier case.
             entry_pages, need_adopt = eng.prefix.paged_entry(
                 reqs[0].prefix_fp, reqs[0].prefix_kv, holds=self.b
             )

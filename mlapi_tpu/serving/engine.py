@@ -148,6 +148,7 @@ class InferenceEngine:
         prefill_interleave: bool = True,
         kv_tier_bytes: int = 0,
         kv_tier_disk_dir: str | None = None,
+        kv_peer_fetch: bool = False,
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -209,6 +210,14 @@ class InferenceEngine:
         streams are pinned token-identical across {evict → restore} vs
         {never evicted} (DESIGN §19). 0 (default) keeps the r12
         discard behavior bit for bit. Generative checkpoints only.
+
+        ``kv_peer_fetch=True`` lets router replicas exchange prefix-KV
+        blobs peer to peer (``serving/kv_peer.py``): a replica that
+        misses a prefix locally fetches the stored-format blob from
+        the router-hinted warm peer and restores it instead of
+        cold-prefilling, and serves its own warm blobs on ``GET
+        /kv/prefix`` (DESIGN §23). Off (default): bit-identical to
+        the flag never existing. Generative checkpoints only.
         """
         import dataclasses
 
@@ -350,6 +359,7 @@ class InferenceEngine:
                 prefill_interleave=prefill_interleave,
                 kv_tier_bytes=kv_tier_bytes,
                 kv_tier_disk_dir=kv_tier_disk_dir,
+                kv_peer_fetch=kv_peer_fetch,
                 scheduler=scheduler,
                 sched_max_batches=sched_max_batches,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
@@ -361,6 +371,8 @@ class InferenceEngine:
                          if kv_page_size else {}),
                       **({"kv_tier_bytes": kv_tier_bytes}
                          if kv_tier_bytes else {}),
+                      **({"kv_peer_fetch": True}
+                         if kv_peer_fetch else {}),
                       **({"scheduler": True} if scheduler else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
@@ -376,6 +388,12 @@ class InferenceEngine:
                 "kv_tier_bytes/kv_tier_disk_dir apply to generative "
                 f"checkpoints (they cache prefix KV); "
                 f"{type(inner).__name__} has none"
+            )
+        if kv_peer_fetch:
+            raise ValueError(
+                "kv_peer_fetch applies to generative checkpoints "
+                f"(they cache prefix KV); {type(inner).__name__} has "
+                f"none"
             )
         if scheduler:
             raise ValueError(
@@ -611,6 +629,8 @@ class TextGenerationEngine:
         prefill_interleave: bool = True,
         kv_tier_bytes: int = 0,
         kv_tier_disk_dir: str | None = None,
+        kv_peer_fetch: bool = False,
+        kv_peer_timeout_s: float = 5.0,
         scheduler: bool = False,
         sched_max_batches: int = 2,
     ):
@@ -805,6 +825,18 @@ class TextGenerationEngine:
             )
             if self.pool is not None:
                 self.pool.tier = self.kv_tier
+        # Peer-to-peer prefix-KV fetch (r17, serving/kv_peer.py): on
+        # a device-cache AND local-tier miss, fetch the prefix blob
+        # from the router-hinted warm peer (x-mlapi-warm-peer)
+        # instead of cold-prefilling, and serve this replica's own
+        # warm blobs on GET /kv/prefix. Off (the default): no
+        # endpoint, no hint map, no fetch — streams and counters
+        # bit-identical to r16.
+        self.kv_peer = None
+        if kv_peer_fetch:
+            from mlapi_tpu.serving.kv_peer import KVPeer
+
+            self.kv_peer = KVPeer(self, timeout_s=kv_peer_timeout_s)
         # Page-native prefill (r10): bucket prefill and admission write
         # K/V straight into pool pages through the page table — the
         # contiguous-then-adopt copy (one full extra write of
@@ -1407,6 +1439,36 @@ class TextGenerationEngine:
     @property
     def kv_tier_evictions(self) -> int:
         return self.kv_tier.evictions if self.kv_tier else 0
+
+    # -- peer-fetch accounting (state lives in serving/kv_peer.py) --------
+    # Byte counters are exact wire-payload arithmetic (every blob's
+    # ``num_pages x kv_page_bytes`` closed form), never wall-clock;
+    # all zero with --kv-peer-fetch off.
+    @property
+    def kv_peer_fetch_hits(self) -> int:
+        """Peer blobs APPLIED (entry rebuilt from the wire) — each
+        one a cold prefill the fleet's warmth made unnecessary."""
+        return self.kv_peer.fetch_hits if self.kv_peer else 0
+
+    @property
+    def kv_peer_fetch_misses(self) -> int:
+        return self.kv_peer.fetch_misses if self.kv_peer else 0
+
+    @property
+    def kv_peer_fetch_bytes(self) -> int:
+        return self.kv_peer.fetch_bytes if self.kv_peer else 0
+
+    @property
+    def kv_peer_fetch_failures(self) -> int:
+        return self.kv_peer.fetch_failures if self.kv_peer else 0
+
+    @property
+    def kv_peer_serve_count(self) -> int:
+        return self.kv_peer.serve_count if self.kv_peer else 0
+
+    @property
+    def kv_peer_serve_bytes(self) -> int:
+        return self.kv_peer.serve_bytes if self.kv_peer else 0
 
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property

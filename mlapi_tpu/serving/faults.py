@@ -71,6 +71,16 @@ POINTS = (
     # truncated stream). Call counts are shared across both seams —
     # ``after=N`` skips the submits to target the relay.
     "router_forward",
+    # Peer-to-peer prefix-KV fetch (serving/kv_peer.py, r17). Both
+    # points fire BEFORE any wire byte moves or any counter mutates,
+    # so an injected raise exercises the exact degradation contract:
+    # the fetching replica counts a fetch failure and falls back to
+    # the cold prefill with ``kv_pages_in_use`` conserved (the fetch
+    # never touched the pool — restore allocates first, later, on
+    # the dispatch thread); the serving replica's handler 500s and
+    # its tier/entries are untouched.
+    "peer_fetch",       # before the GET /kv/prefix wire request
+    "peer_serve",       # before a peer blob is resolved/serialized
 )
 
 ENV_VAR = "MLAPI_FAULTS"

@@ -1,0 +1,427 @@
+"""Peer-to-peer prefix-KV fetch between engine replicas.
+
+The r14 router gives the fleet ONE cold prefill per distinct prefix —
+but only while the affinity-preferred replica stays up and under its
+depth limit. Any failover, drain, or depth overflow lands the prefix
+on a replica whose caches have never seen it, and that replica pays
+the full O(P²) prefill again even though a peer still holds the exact
+stored-format bytes (device-resident prefix entry, or an r13 host-tier
+blob). This module promotes the tier blob into the fleet's
+TRANSFERABLE KV unit: a wire hop between replica tiers, so affinity
+becomes a soft hint and a replica death no longer costs its whole
+prefix working set (ROADMAP item 2, step one; the hierarchical-memory
+move Snap ML makes across DRAM/NVMe levels, taken across hosts).
+
+Topology — who knows what:
+
+- **The router knows warmth.** Its HRW affinity map already names the
+  replica most likely to hold a prefix; any forward to a
+  NON-preferred replica (p2c fallback, failover, depth overflow,
+  post-drain remap) carries ``x-mlapi-warm-peer: host:port`` naming
+  the HRW head (``Router.forward``). Replica-gated like
+  ``x-mlapi-router-depth`` — direct callers cannot aim a replica's
+  fetches at an arbitrary host.
+- **The serving replica knows bytes.** ``GET
+  /kv/prefix?fp=<digest>`` (``serving/app.py``, installed only with
+  ``--kv-peer-fetch``) serves the prefix's blob in its STORED format
+  — int8-halved payloads cross the wire at half the bytes for free —
+  from the host tier when spilled, else gathered from the
+  device-resident prefix entry's contiguous KV (safe from any
+  thread: entry KV is never donated). A GET works while DRAINING —
+  exactly the window where a peer needs the drained replica's slice.
+- **The fetching replica stays off the dispatch thread.** The fetch
+  runs inside ``PrefixCache._restore`` on the encode executor thread
+  (where the cold prefill it replaces would have run); the fetched
+  blob rebuilds the ``_PrefixEntry`` and is STAGED into the local
+  tier (``KVTier.stage``), so the dispatch-thread paged formation
+  restores pool pages through the existing alloc-first
+  ``PagePool.restore_entry`` path — a mid-fetch or mid-restore
+  failure conserves pages exactly and degrades to the r13 cold path.
+  No wire I/O ever touches the dispatch thread.
+
+Wire format (one blob): a single JSON header line —
+``{"v": 1, "page", "num_pages", "nbytes", "bucket", "lo", "used",
+"leaves": [[layer, name, shape, dtype], ...]}`` — followed by each
+leaf's raw C-order bytes in header order. The payload bytes are
+EXACTLY the ``num_pages × kv_page_bytes`` closed form (the same
+``ops/quant.kv_tree_bytes`` arithmetic the tier's counters use);
+``deserialize_blob`` validates every leaf's size and the total
+against the header, so a truncated or corrupt body is a counted MISS,
+never a wrong cache. Geometry against the LOCAL replica (bucket/page
+drift across builds or configs) is validated by the same ``_plan`` /
+``restore_entry`` checks every tier blob passes — a peer can never
+install bytes the local pool would not have produced itself.
+
+Failure grammar (``serving/faults.py``): ``peer_fetch`` fires before
+the wire request, ``peer_serve`` before the serve-side blob resolve —
+a raise at either point falls back to the cold prefill with pages
+conserved and the stream completing.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import threading
+
+import numpy as np
+
+from mlapi_tpu.serving import faults
+from mlapi_tpu.utils.logging import get_logger
+
+_log = get_logger("serving.kv_peer")
+
+WIRE_VERSION = 1
+# Header line length cap: a dozen layers of leaf manifests fit in a
+# few KB; anything larger is a corrupt/hostile response, refused
+# before allocation.
+_MAX_HEADER_BYTES = 1 << 20
+
+
+def fp_digest(fp: str) -> str:
+    """URL-safe fingerprint of a prefix string: blake2b-128 hex of
+    its UTF-8 bytes (prefix text is arbitrary — it cannot ride a URL
+    path raw, and the serving replica must not need the full text to
+    index its blobs)."""
+    return hashlib.blake2b(
+        fp.encode("utf-8", "surrogatepass"), digest_size=16
+    ).hexdigest()
+
+
+def serialize_blob(blob) -> bytes:
+    """A :class:`~mlapi_tpu.serving.kv_tier.KVTierBlob` → wire bytes:
+    JSON header line + concatenated raw leaf payloads in header
+    order. Payload bytes total exactly ``blob.nbytes`` (the
+    ``num_pages × kv_page_bytes`` closed form)."""
+    leaves = []
+    chunks = []
+    for ln in sorted(blob.payload):
+        for name in sorted(blob.payload[ln]):
+            a = np.ascontiguousarray(blob.payload[ln][name])
+            leaves.append([ln, name, list(a.shape), a.dtype.str])
+            chunks.append(a.tobytes())
+    header = json.dumps(
+        {
+            "v": WIRE_VERSION,
+            "page": blob.page,
+            "num_pages": blob.num_pages,
+            "nbytes": blob.nbytes,
+            "bucket": blob.bucket,
+            "lo": blob.lo,
+            "used": blob.used,
+            "leaves": leaves,
+        }
+    ).encode()
+    return header + b"\n" + b"".join(chunks)
+
+
+def deserialize_blob(fp, data: bytes):
+    """Wire bytes → a validated ``KVTierBlob`` for ``fp``. Raises
+    ``ValueError`` on ANY inconsistency — unparseable header, leaf
+    shapes that are not ``[num_pages, page, ...]``, a payload whose
+    size does not match the manifest, trailing bytes, or a byte total
+    that disagrees with the header's ``nbytes`` — so a corrupt wire
+    response is dropped as a counted miss, never installed."""
+    from mlapi_tpu.serving.kv_tier import KVTierBlob
+
+    nl = data.find(b"\n", 0, _MAX_HEADER_BYTES)
+    if nl < 0:
+        raise ValueError("no header line in peer blob")
+    try:
+        head = json.loads(data[:nl])
+    except Exception as e:
+        raise ValueError(f"unparseable peer blob header: {e}") from None
+    if not isinstance(head, dict) or head.get("v") != WIRE_VERSION:
+        raise ValueError(f"unknown peer blob version {head!r:.80}")
+    try:
+        page = int(head["page"])
+        num_pages = int(head["num_pages"])
+        nbytes = int(head["nbytes"])
+        # A meta-less blob cannot rebuild an entry and the serve side
+        # never emits one, so a None here is corruption — and int()
+        # turns it (or any non-numeric junk) into the TypeError this
+        # clause converts to the one documented exception type.
+        bucket = int(head["bucket"])
+        lo = int(head["lo"])
+        used = int(head["used"])
+        leaves = head["leaves"]
+        if not isinstance(leaves, list) or not leaves:
+            raise ValueError("leaf manifest is not a non-empty list")
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"incomplete peer blob header: {e}") from None
+    payload: dict = {}
+    off = nl + 1
+    total = 0
+    for leaf in leaves:
+        try:
+            ln, name, shape, dtype = leaf
+            shape = tuple(int(s) for s in shape)
+            dt = np.dtype(dtype)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad leaf manifest {leaf!r:.80}: {e}") from None
+        if (
+            len(shape) < 2
+            or shape[0] != num_pages
+            or shape[1] != page
+            or any(s <= 0 for s in shape)
+        ):
+            # Non-positive dims included: a negative dim would make
+            # ``size`` negative — defeating the truncation check
+            # below and letting ``off`` walk backward into already-
+            # consumed bytes (np.frombuffer treats a negative count
+            # as "the rest of the buffer", silently).
+            raise ValueError(
+                f"leaf {ln}/{name} shape {shape} is not "
+                f"[{num_pages}, {page}, ...] with positive dims"
+            )
+        size = int(np.prod(shape)) * dt.itemsize
+        if off + size > len(data):
+            raise ValueError("truncated peer blob payload")
+        payload.setdefault(ln, {})[name] = np.frombuffer(
+            data, dtype=dt, count=int(np.prod(shape)), offset=off
+        ).reshape(shape)
+        off += size
+        total += size
+    if off != len(data):
+        raise ValueError("trailing bytes after peer blob payload")
+    if total != nbytes:
+        raise ValueError(
+            f"peer blob payload is {total} bytes, header says {nbytes}"
+        )
+    return KVTierBlob(fp, payload, page, nbytes, bucket, lo, used)
+
+
+def _http_get(host: str, port: int, path: str,
+              timeout_s: float) -> tuple[int, bytes]:
+    """One bounded GET against a peer replica. Blocking by design —
+    every caller runs on an encode executor thread (the same place
+    the cold prefill it replaces would block), never the event loop
+    or the dispatch thread."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class KVPeer:
+    """Per-engine peer-fetch state: the warm-peer hint map the router
+    feeds, the fetch client, the serve-side blob resolver, and the
+    counters ``/metrics`` exports. Thread-safe: hints arrive from the
+    event loop (header scan), fetches run on encode executor threads,
+    serves on the app's executor."""
+
+    def __init__(self, engine, *, timeout_s: float = 5.0):
+        self.eng = engine
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        # fp_digest(fp) -> (host, port) of the replica the router
+        # last named warm for that prefix; bounded LRU. Keyed by the
+        # 32-char DIGEST, not the prefix text — hints are noted from
+        # the request header BEFORE any validation rejects the
+        # request, so text keys would let a caller pin up to
+        # hint_cap arbitrarily long strings in host RAM. The fetch
+        # path only ever needs the digest (it is what rides the
+        # wire), so nothing is lost.
+        self._hints: collections.OrderedDict = collections.OrderedDict()
+        self._hint_cap = 1024
+        # Counters (exported as generate.kv_peer_*). Hits/bytes count
+        # blobs APPLIED (an entry rebuilt from the fetch); misses
+        # count completed fetches that yielded nothing usable (404,
+        # corrupt wire body, local geometry drift); failures count
+        # transport errors, non-200/404 statuses, and injected
+        # ``peer_fetch`` faults — the legs that degrade to the cold
+        # prefill without ever having had usable bytes.
+        self.fetch_hits = 0
+        self.fetch_misses = 0
+        self.fetch_bytes = 0
+        self.fetch_failures = 0
+        self.serve_count = 0
+        self.serve_bytes = 0
+        # digest -> serialized wire image, small LRU. A prefix's blob
+        # bytes are DETERMINISTIC per engine config (same params +
+        # tokenizer -> the same stored-format KV, whether prefilled,
+        # tier-restored, or re-adopted — the r13 byte-identity pins),
+        # so the serialized image can be reused across peers: N-1
+        # replicas fetching one hot prefix cost ONE device gather +
+        # serialize, not N-1. Bounded tight (a few blobs) — this is a
+        # latency cache for the hot serve path, not a store.
+        self._serve_cache: collections.OrderedDict = (
+            collections.OrderedDict()
+        )
+        self._serve_cache_cap = 4
+
+    # -- warm-peer hints ------------------------------------------------
+    def note_hint(self, fp: str, peer: str) -> None:
+        """Record the router's warmth hint for ``fp``. Validated here
+        (host:port shape) so a malformed header can never become a
+        connect attempt later."""
+        host, _, port = peer.rpartition(":")
+        if not host or not port.isdigit():
+            return
+        key = fp_digest(fp)
+        with self._lock:
+            self._hints[key] = (host, int(port))
+            self._hints.move_to_end(key)
+            while len(self._hints) > self._hint_cap:
+                self._hints.popitem(last=False)
+
+    def hint_for(self, fp: str):
+        with self._lock:
+            return self._hints.get(fp_digest(fp))
+
+    def drop_hint(self, fp: str) -> None:
+        with self._lock:
+            self._hints.pop(fp_digest(fp), None)
+
+    # -- fetch (encode executor thread) ---------------------------------
+    # Patch point for in-process tests and drills: (host, port, path,
+    # timeout_s) -> (status, body).
+    _transport = staticmethod(_http_get)
+
+    def fetch(self, fp: str):
+        """Fetch ``fp``'s blob from its hinted warm peer, or ``None``
+        (no hint / miss / failure — every ``None`` means the caller
+        goes cold). The ``peer_fetch`` fault point fires before any
+        wire byte moves. Returns an UNVALIDATED-against-local-geometry
+        blob — the caller applies the same ``_plan`` check every tier
+        blob passes and reports the outcome via
+        :meth:`count_applied` / :meth:`count_miss`."""
+        digest = fp_digest(fp)
+        with self._lock:
+            hint = self._hints.get(digest)
+        if hint is None:
+            return None
+        host, port = hint
+        try:
+            faults.fire("peer_fetch")
+            status, body = self._transport(
+                host, port, f"/kv/prefix?fp={digest}",
+                self.timeout_s,
+            )
+        except Exception as e:
+            with self._lock:
+                self.fetch_failures += 1
+            _log.debug(
+                "peer fetch from %s:%d failed (%s); cold path",
+                host, port, e,
+            )
+            return None
+        if status == 404:
+            # The peer is not warm after all (evicted, restarted):
+            # drop the hint so the next miss does not re-pay the hop.
+            with self._lock:
+                self.fetch_misses += 1
+                self._hints.pop(digest, None)
+            return None
+        if status != 200:
+            with self._lock:
+                self.fetch_failures += 1
+            _log.debug(
+                "peer %s:%d answered %d for a KV fetch; cold path",
+                host, port, status,
+            )
+            return None
+        try:
+            return deserialize_blob(fp, body)
+        except Exception as e:
+            # ValueError is the documented corruption signal, but the
+            # contract here is the CALLER's: any body that does not
+            # parse is a counted miss and a cold prefill — never an
+            # exception escaping into the user's request.
+            with self._lock:
+                self.fetch_misses += 1
+            _log.debug("corrupt peer blob dropped as a miss: %s", e)
+            return None
+
+    def count_applied(self, nbytes: int) -> None:
+        """The fetched blob rebuilt an entry: the fetch is a hit and
+        its exact payload bytes count."""
+        with self._lock:
+            self.fetch_hits += 1
+            self.fetch_bytes += int(nbytes)
+
+    def count_miss(self) -> None:
+        """The fetched blob can never apply here (geometry drift vs
+        what a local build would produce today): a miss, like a
+        corrupt body — the bytes were real, just not ours."""
+        with self._lock:
+            self.fetch_misses += 1
+
+    # -- serve (app executor thread) ------------------------------------
+    def serve_wire(self, digest: str) -> bytes | None:
+        """Resolve a fingerprint digest against this replica's warm
+        state and return the blob's wire bytes, or ``None`` (404).
+        Sources, warmest-cheapest first: the host tier's blob (already
+        page-shaped host numpy — no device work), else the prefix
+        dict's device-resident entry gathered via its contiguous KV
+        (never donated, safe from any thread). The ``peer_serve``
+        fault point fires before anything is resolved; counters move
+        only after serialization succeeds."""
+        from mlapi_tpu.serving.kv_tier import (
+            payload_bytes,
+            payload_from_contiguous,
+        )
+
+        faults.fire("peer_serve")
+        with self._lock:
+            cached = self._serve_cache.get(digest)
+            if cached is not None:
+                self._serve_cache.move_to_end(digest)
+                self.serve_count += 1
+                self.serve_bytes += cached[1]
+                return cached[0]
+        eng = self.eng
+        tier = getattr(eng, "kv_tier", None)
+        fp = None
+        if tier is not None:
+            fp = next(
+                (
+                    f for f in tier.fingerprints()
+                    if isinstance(f, str) and fp_digest(f) == digest
+                ),
+                None,
+            )
+        blob = None
+        if fp is not None:
+            blob = tier.lookup(fp, count=False)
+            if blob is not None and blob.bucket is None:
+                # Spilled before any entry registration recorded its
+                # metadata: a peer cannot rebuild an entry from it —
+                # fall through to the entry scan below.
+                blob = None
+        if blob is None:
+            # Snapshot under the lock, hash OUTSIDE it: every
+            # /generate request's entry() fast path takes this same
+            # lock, and hashing N full prefix texts under it would
+            # serialize encode threads behind every peer probe.
+            with eng.prefix._lock:
+                candidates = list(eng.prefix._entries.items())
+            entry = next(
+                (e for f, e in candidates if fp_digest(f) == digest),
+                None,
+            )
+            if entry is None:
+                return None
+            from mlapi_tpu.serving.kv_tier import KVTierBlob
+
+            page = eng.pool.page if eng.pool is not None else entry.bucket
+            payload = payload_from_contiguous(entry.kv, page)
+            blob = KVTierBlob(
+                entry.fp, payload, page, payload_bytes(payload),
+                entry.bucket, entry.lo, entry.used,
+            )
+        data = serialize_blob(blob)
+        with self._lock:
+            self._serve_cache[digest] = (data, blob.nbytes)
+            self._serve_cache.move_to_end(digest)
+            while len(self._serve_cache) > self._serve_cache_cap:
+                self._serve_cache.popitem(last=False)
+            self.serve_count += 1
+            self.serve_bytes += blob.nbytes
+        return data

@@ -23,6 +23,12 @@ Wired at exactly two seams, both outside this file:
   tier on a device-cache miss; a hit rebuilds the entry / repopulates
   pool pages with ref-count/COW semantics unchanged on-device.
 
+Plus, since r17, the fleet seam (``serving/kv_peer.py``): the blob is
+the transferable KV unit between replicas — a peer's fetch serves
+these same stored-format bytes over the wire, and a fetched blob is
+:meth:`KVTier.stage`-d here so the local restore path applies it
+exactly like a local spill.
+
 Everything here is host metadata + numpy under one lock; no jax
 arrays are held (a blob pins host RAM or disk, never HBM). Byte
 accounting is exact dtype/shape arithmetic (``ops/quant
@@ -234,6 +240,24 @@ class KVTier:
         blob replaced or evicted mid-write just unlinks the fresh
         file)."""
         faults.fire("tier_spill")
+        return self._register(fp, payload, page, count_spill=True)
+
+    def stage(self, fp, payload: dict, page: int, *,
+              bucket: int, lo: int, used: int) -> int:
+        """Register a PEER-FETCHED blob (``serving/kv_peer.py``) so
+        the dispatch-thread paged formation finds it locally and
+        restores through the same alloc-first ``restore_entry`` path
+        every tier blob takes. Identical LRU/budget/disk mechanics to
+        :meth:`spill`, but no ``tier_spill`` fault fire and no
+        spill counters — nothing was evicted from THIS replica's
+        device; the ``kv_peer_fetch_*`` counters carry the story.
+        The peer blob's entry metadata rides in explicitly (the wire
+        header is the one place that knows it here)."""
+        self.note_meta(fp, bucket=bucket, lo=lo, used=used)
+        return self._register(fp, payload, page, count_spill=False)
+
+    def _register(self, fp, payload: dict, page: int,
+                  count_spill: bool) -> int:
         nbytes = payload_bytes(payload)
         with self._lock:
             meta = self._meta.get(fp)
@@ -265,8 +289,9 @@ class KVTier:
                 _, victim = self._blobs.popitem(last=False)  # LRU
                 self._discard_locked(victim)
                 self.evictions += 1
-            self.spill_count += 1
-            self.spill_bytes += nbytes
+            if count_spill:
+                self.spill_count += 1
+                self.spill_bytes += nbytes
         if path is not None:
             try:
                 np.savez(
@@ -317,17 +342,29 @@ class KVTier:
             except OSError:
                 pass
 
+    def fingerprints(self) -> list:
+        """A snapshot of the stored fingerprints (for the peer-serve
+        digest scan — ``serving/kv_peer.py``; blob counts are bounded
+        by the bytes budget, so a linear scan is cheap and runs on an
+        executor thread anyway)."""
+        with self._lock:
+            return list(self._blobs)
+
     # -- restore -------------------------------------------------------
-    def lookup(self, fp) -> KVTierBlob | None:
+    def lookup(self, fp, count: bool = True) -> KVTierBlob | None:
         """The blob for ``fp`` (LRU-touched), payload loaded back to
-        RAM if disk-backed; ``None`` counts a restore miss. The blob
-        stays resident — a restore is a cache READ, so a re-eviction
-        of the restored pages re-spills identical bytes (or cheaply
-        replaces them)."""
+        RAM if disk-backed; ``None`` counts a restore miss (pass
+        ``count=False`` for reads that are NOT restore attempts — the
+        peer-serve path, which must not pollute the restore counters
+        the r13 savings story is asserted from). The blob stays
+        resident — a restore is a cache READ, so a re-eviction of the
+        restored pages re-spills identical bytes (or cheaply replaces
+        them)."""
         with self._lock:
             stored = self._blobs.get(fp)
             if stored is None:
-                self.restore_misses += 1
+                if count:
+                    self.restore_misses += 1
                 return None
             self._blobs.move_to_end(fp)
             payload = stored.payload
@@ -354,7 +391,8 @@ class KVTier:
                     if self._blobs.get(fp) is stored:
                         self._blobs.pop(fp)
                         self._discard_locked(stored)
-                    self.restore_misses += 1
+                    if count:
+                        self.restore_misses += 1
                 return None
         return KVTierBlob(fp, payload, page, nbytes, bucket, lo, used)
 

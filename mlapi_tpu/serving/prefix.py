@@ -204,50 +204,106 @@ class PrefixCache:
             self.warm_shapes(entry)
         return entry
 
-    # -- host-tier seams (serving/kv_tier.py; no-ops when absent) ------
+    # -- host-tier + peer seams (kv_tier.py / kv_peer.py; no-ops when
+    # absent) -----------------------------------------------------------
     def _restore(self, text: str) -> _PrefixEntry | None:
-        """Tier consult on a device-cache miss: rebuild the entry from
-        its spilled blob — ``device_put`` of the stored-format payload,
-        ZERO prefill FLOPs (``builds`` does not move) — or ``None`` to
-        fall back to the cold build. Failure discipline: geometry or
-        metadata drift DROPS the blob (it can never apply) and goes
-        cold; a transient failure (including an injected
-        ``tier_restore`` raise) keeps the blob, counts
-        ``restore_failures``, and goes cold — either way the caller's
-        path is the normal prefill, never a half-built entry."""
+        """Warm-source consult on a device-cache miss, cheapest
+        first: the LOCAL tier blob, then (``--kv-peer-fetch``) a
+        router-hinted WARM PEER's blob over the wire — either way the
+        entry rebuilds by ``device_put`` of stored-format bytes, ZERO
+        prefill FLOPs (``builds`` does not move) — or ``None`` to
+        fall back to the cold build. Runs on the encode executor
+        thread, so the peer hop never touches the dispatch thread
+        (the cold prefill it replaces blocks this same thread for
+        longer). Failure discipline: geometry or metadata drift DROPS
+        a tier blob / counts a peer MISS (the bytes can never apply
+        here) and goes cold; a transient failure (including injected
+        ``tier_restore``/``peer_fetch`` raises) counts its seam's
+        failure counter and goes cold — either way the caller's path
+        is the normal prefill, never a half-built entry. A peer blob
+        that DOES apply is additionally staged into the local tier
+        (``KVTier.stage``) so the paged formation restores its pool
+        pages through the existing alloc-first
+        ``PagePool.restore_entry`` path on the dispatch thread."""
         from mlapi_tpu.serving import faults
 
         tier = getattr(self.eng, "kv_tier", None)
-        if tier is None:
+        peer = getattr(self.eng, "kv_peer", None)
+        if tier is not None:
+            # absent -> counted restore miss (the local-tier story)
+            blob = tier.lookup(text)
+            if blob is not None:
+                entry = None
+                try:
+                    faults.fire("tier_restore")
+                    entry = self._entry_from_blob(text, blob)
+                except Exception as e:
+                    tier.count_restore_failure()
+                    _log.debug(
+                        "tier entry restore failed (%s); cold prefill", e
+                    )
+                if entry is not None:
+                    if self.eng._strict_admit:
+                        self.warm_shapes(entry)
+                    tier.count_restore(blob)
+                    return entry
+                # Drifted (blob dropped) or transiently failed: the
+                # peer below may still beat the cold prefill.
+        if peer is None:
             return None
-        blob = tier.lookup(text)  # absent -> counted restore miss
+        blob = peer.fetch(text)  # miss/failure counted inside
         if blob is None:
             return None
         try:
-            faults.fire("tier_restore")
-            entry = self._entry_from_blob(text, blob)
+            entry = self._entry_from_blob(text, blob, drop=False)
         except Exception as e:
-            tier.count_restore_failure()
+            peer.count_miss()
             _log.debug(
-                "tier entry restore failed (%s); cold prefill", e
+                "peer blob failed to apply (%s); cold prefill", e
             )
             return None
-        if entry is not None:
-            if self.eng._strict_admit:
-                self.warm_shapes(entry)
-            tier.count_restore(blob)
+        if entry is None:
+            # Geometry drift vs what a local build would produce
+            # today (different bucket/page config than the peer):
+            # dropped as a miss, exactly like a corrupt wire body —
+            # and the hint goes too: config drift is persistent, so
+            # every future miss would re-transfer a full blob that
+            # provably can never apply (the same pure-loss argument
+            # as the 404 hint drop).
+            peer.count_miss()
+            peer.drop_hint(text)
+            return None
+        peer.count_applied(blob.nbytes)
+        if tier is not None:
+            try:
+                # Stage locally: the dispatch-thread paged_entry path
+                # then finds the blob in the LOCAL tier and restores
+                # pool pages alloc-first via restore_entry — no wire
+                # I/O on the dispatch thread, pages conserved on any
+                # failure. Best-effort: a staging failure only costs
+                # the adopt-path copy at formation.
+                tier.stage(
+                    text, blob.payload, blob.page,
+                    bucket=blob.bucket, lo=blob.lo, used=blob.used,
+                )
+            except Exception as e:
+                _log.debug("peer blob staging failed (%s)", e)
+        if self.eng._strict_admit:
+            self.warm_shapes(entry)
         return entry
 
-    def _entry_from_blob(self, text: str, blob) -> _PrefixEntry | None:
+    def _entry_from_blob(self, text: str, blob,
+                         drop: bool = True) -> _PrefixEntry | None:
         """Blob payload ``{layer: {leaf: [n, page, ...]}}`` → the
         ``[1, bucket]`` contiguous entry KV, byte-identical to the one
         the original build produced (the spill gathered exactly those
         bytes; slots past ``bucket`` in the final page are spill-time
         pool residue, sliced off here and never read). Returns
-        ``None`` — after dropping the blob — when the blob's recorded
-        geometry does not match what a cold build would produce
-        today."""
-        tier = self.eng.kv_tier
+        ``None`` when the blob's recorded geometry does not match
+        what a cold build would produce today — after dropping the
+        blob from the tier when ``drop`` (peer-fetched blobs pass
+        ``drop=False``: there is nothing local to drop, and the
+        caller counts the miss on the peer's own counters)."""
         if blob.bucket is None:
             # Spilled before any entry registration recorded its
             # metadata: pool-page restore still works (paged_entry),
@@ -260,9 +316,11 @@ class PrefixCache:
             or blob.used != len(ids)
             or blob.num_pages * blob.page < bucket
         ):
-            tier.drop(text)
+            if drop:
+                self.eng.kv_tier.drop(text)
             _log.debug(
-                "tier blob geometry drifted for %r; cold prefill", text
+                "%s blob geometry drifted for %r; cold prefill",
+                "tier" if drop else "peer", text,
             )
             return None
         kv = {

@@ -50,6 +50,16 @@ Failure semantics (the part a proxy one-liner gets wrong):
   ``{"error": ..., "code": "upstream_error"}`` frame — never a
   truncated stream.
 
+Warmth hinting (r17, ``serving/kv_peer.py``): affinity is a
+PREFERENCE, not a placement constraint — any forward that misses the
+key's HRW head (p2c fallback, failover, depth overflow, post-drain
+remap) carries ``x-mlapi-warm-peer: host:port`` naming that head, so
+a ``--kv-peer-fetch`` replica can pull the prefix KV from where it is
+warm instead of re-prefilling. The head is computed once per request
+over ALL replicas and threaded through the failover hop too (the
+second ``choose()`` excludes the failed replica and would otherwise
+forget who was preferred).
+
 Observability: the router's ``/metrics`` sums replica counters (the
 fleet-wide totals), labels per-replica gauges
 (``replica.<host:port>.<gauge>``), and adds its own
@@ -335,6 +345,7 @@ class Router:
         self.failovers = 0
         self.shed_no_replica = 0
         self.stream_upstream_errors = 0
+        self.warm_peer_hints = 0
 
     # -- discovery/keys ---------------------------------------------------
     def routing_key(self, body: bytes) -> bytes | None:
@@ -359,6 +370,20 @@ class Router:
         ]
 
     # -- the routing decision ---------------------------------------------
+    def preferred_for(self, key: bytes | None) -> ReplicaState | None:
+        """The HRW head for ``key`` over ALL configured replicas —
+        state-independent on purpose: it answers "who is most likely
+        WARM for this prefix", which survives the preferred replica
+        being down, draining, shedding, or over its depth limit (a
+        draining replica still serves ``GET /kv/prefix``; a down one
+        just costs the fetcher a fast refused connect). ``None``
+        under round-robin or without a key — there is no warmth map
+        to consult."""
+        if key is None or self.policy != "affinity":
+            return None
+        order = hrw_order(key, [r.name for r in self.replicas])
+        return next(r for r in self.replicas if r.name == order[0])
+
     def choose(
         self,
         key: bytes | None,
@@ -501,7 +526,8 @@ class Router:
             for x in self.replicas if x is not r and x.state != DOWN
         ))
 
-    def _build_upstream(self, request: Request, r: ReplicaState) -> bytes:
+    def _build_upstream(self, request: Request, r: ReplicaState,
+                        warm_peer: ReplicaState | None = None) -> bytes:
         target = request.scope.get("raw_path") or request.path.encode()
         if isinstance(target, str):  # ASGI test transports pass str
             target = target.encode()
@@ -517,12 +543,14 @@ class Router:
         )
         head += b"host: %s\r\n" % r.name.encode()
         for k, v in request.scope.get("headers", []):
-            # x-mlapi-router-depth is router-authored below; a copy of
-            # a client-sent (or upstream-router-sent) one would let
-            # callers spoof fleet pressure into the replica's
-            # admission estimate.
-            if k.lower() not in _HOP_HEADERS and k.lower() != (
-                b"x-mlapi-router-depth"
+            # x-mlapi-router-depth and x-mlapi-warm-peer are
+            # router-authored below; a copy of a client-sent (or
+            # upstream-router-sent) one would let callers spoof fleet
+            # pressure into the replica's admission estimate — or aim
+            # the replica's KV fetches at an arbitrary host.
+            if k.lower() not in _HOP_HEADERS and k.lower() not in (
+                b"x-mlapi-router-depth",
+                b"x-mlapi-warm-peer",
             ):
                 head += k + b": " + v + b"\r\n"
         head += b"content-length: %d\r\n" % len(request.body)
@@ -530,6 +558,13 @@ class Router:
         # fleet's backlog as this router sees it, minus the target's
         # own share (it knows its own queue better than our poll).
         head += b"x-mlapi-router-depth: %d\r\n" % self.external_depth(r)
+        if warm_peer is not None:
+            # Warmth hint (r17): this forward misses the key's
+            # HRW-preferred replica — name it, so the target can
+            # fetch the prefix KV from where it is warm instead of
+            # cold-prefilling (--kv-peer-fetch replicas; others
+            # ignore the header).
+            head += b"x-mlapi-warm-peer: %s\r\n" % warm_peer.name.encode()
         head += b"connection: close\r\n\r\n"
         return bytes(head) + request.body
 
@@ -541,7 +576,8 @@ class Router:
             if k not in _HOP_HEADERS
         }
 
-    async def _attempt(self, r: ReplicaState, request: Request) -> Response:
+    async def _attempt(self, r: ReplicaState, request: Request,
+                       warm_peer: ReplicaState | None = None) -> Response:
         """One forward attempt against one replica. Returns the relay
         response (unary fully read; streams as a relaying iterator).
         Raises :class:`_SubmitError` on pre-commit failures."""
@@ -574,7 +610,7 @@ class Router:
                 ) from None
             submitted = False
             try:
-                writer.write(self._build_upstream(request, r))
+                writer.write(self._build_upstream(request, r, warm_peer))
                 await writer.drain()
                 submitted = True
                 status, headers = await _read_response_head(reader)
@@ -699,6 +735,18 @@ class Router:
             r.inflight -= 1
             await _close_writer(writer)
 
+    def _hint_for(self, pref: ReplicaState | None,
+                  target: ReplicaState) -> ReplicaState | None:
+        """The warm-peer hint for one forward: the key's HRW head
+        whenever the target is NOT it (fallback, failover, depth
+        overflow, post-drain remap — every hop that loses warmth).
+        Counted, so the bench/e2e can assert hinting happened from
+        the router side."""
+        if pref is None or pref is target:
+            return None
+        self.warm_peer_hints += 1
+        return pref
+
     async def forward(
         self, request: Request, key: bytes | None = None
     ) -> Response:
@@ -707,6 +755,13 @@ class Router:
         never started work (connect failure, pre-submit injected
         fault, a whole-response 503)."""
         self.forwarded += 1
+        # The key's HRW head, computed ONCE over all replicas and
+        # threaded through BOTH attempts: the failover's second
+        # choose() has no memory of the preferred replica (it
+        # excludes the failed first and re-ranks the rest), so
+        # without this the warm-peer hint would not survive the
+        # retry hop — exactly the hop that needs it most.
+        pref = self.preferred_for(key)
         try:
             first = self.choose(key)
         except NoReplicaAvailable as e:
@@ -717,7 +772,9 @@ class Router:
                 headers={"retry-after": str(int(e.retry_after_s))},
             )
         try:
-            return await self._attempt(first, request)
+            return await self._attempt(
+                first, request, self._hint_for(pref, first)
+            )
         except _SubmitError as e1:
             if e1.retryable:
                 try:
@@ -736,7 +793,9 @@ class Router:
                         first.name, second.name, e1.detail,
                     )
                     try:
-                        return await self._attempt(second, request)
+                        return await self._attempt(
+                            second, request, self._hint_for(pref, second)
+                        )
                     except _SubmitError as e2:
                         return self._submit_error_response(e2, e1)
             return self._submit_error_response(e1)
@@ -843,6 +902,7 @@ class Router:
         counters["router.stream_upstream_errors"] = (
             self.stream_upstream_errors
         )
+        counters["router.warm_peer_hints"] = self.warm_peer_hints
         state_counts = self._state_counts()
         gauges["router.replicas_live"] = state_counts[LIVE]
         gauges["router.replicas_draining"] = state_counts[DRAINING]

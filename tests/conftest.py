@@ -118,7 +118,12 @@ _CACHE_FAMILIES = {
     # (the unit generator changes dispatch ORDER, never shapes), so
     # sharing the window costs it only its own handful of tier
     # variants instead of the whole ladder.
+    # + the kv_peer module (r17): identical CFG and the same
+    # {gpt, llama} x {none, int8} engine shapes at page 8 / chunk 2 —
+    # peer restores re-drive the programs the tier module compiled;
+    # only the wire hop is new, and it compiles nothing.
     "paged-family": frozenset({
+        "test_kv_peer",
         "test_paged_kv",
         "test_paged_kv_tier",
         "test_scheduler",

@@ -1,0 +1,694 @@
+"""Peer-to-peer prefix-KV fetch (``serving/kv_peer.py``, the r17
+wire hop between replica tiers; ``--kv-peer-fetch``).
+
+The contract, layer by layer:
+
+- **Wire format**: serialize → deserialize round-trips every leaf
+  byte-identically with the geometry header intact; payload bytes are
+  EXACTLY the ``num_pages × kv_page_bytes`` closed form for BOTH
+  cache formats; truncated/garbled/inconsistent bodies raise (and are
+  counted misses at the fetch seam, never installed).
+- **The serving stack**: a replica that misses a prefix locally
+  fetches the blob from its hinted warm peer on the encode executor
+  thread, rebuilds the entry with ZERO prefill FLOPs
+  (``PrefixCache.builds`` stays flat — the pinned counter, never
+  wall-clock), stages the blob into its local tier, and the
+  dispatch-thread paged formation restores pool pages through the
+  existing alloc-first ``PagePool.restore_entry`` path. Streams are
+  TOKEN-IDENTICAL peer-restored vs never-evicted across
+  {gpt-MHA, llama-GQA} × {none, int8}.
+- **Failure discipline**: geometry drift and corrupt wire bodies are
+  counted misses that go cold; injected ``peer_fetch``/``peer_serve``
+  raises are counted failures that go cold — all with
+  ``kv_pages_in_use`` conserved and streams completing; pool pressure
+  mid-restore rejects loudly with nothing half-installed (the staged
+  peer blob takes the same alloc-first path a local spill does).
+- **Topology**: the endpoint and the hint header are replica-gated;
+  an in-process 2-replica fleet behind the real router warm-starts a
+  drained replica's slice on the survivor with ``prefix_builds``
+  staying at 1 fleet-wide.
+
+Engines here reuse ``test_paged_kv``/``test_paged_kv_tier``'s
+tiny-model CFG so the jitted program factories are shared across the
+family (conftest ``paged-family``) instead of compiled again.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_page_bytes
+from mlapi_tpu.serving import faults
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.kv_peer import (
+    KVPeer,
+    deserialize_blob,
+    fp_digest,
+    serialize_blob,
+)
+from mlapi_tpu.serving.kv_tier import KVTierBlob, payload_bytes
+from mlapi_tpu.serving.paged_pool import PagePoolExhausted
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=160,
+    compute_dtype="float32",
+)
+
+
+def _model(kind="gpt_lm", kv_quant="none"):
+    kw = dict(CFG, kv_quant=kv_quant)
+    if kind == "llama_lm":
+        kw["num_kv_heads"] = 2  # GQA: 4 query heads over 2 KV heads
+    return get_model(kind, **kw)
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    return _model().init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return _model("llama_lm").init(jax.random.key(0))
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("chunk", 2)
+    kw.setdefault("fused_single", False)
+    kw.setdefault("kv_page_size", 8)
+    kw.setdefault("kv_tier_bytes", 1 << 24)
+    kw.setdefault("kv_peer_fetch", True)
+    return TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(), **kw
+    )
+
+
+def _wire(warm_engine):
+    """An in-process transport serving ``warm_engine``'s blobs — the
+    exact serve path (``KVPeer.serve_wire``, fault point included)
+    without a socket, so the fetch client, wire format, counters, and
+    restore path are all real."""
+
+    def transport(host, port, path, timeout_s):
+        digest = path.split("fp=", 1)[1]
+        data = warm_engine.kv_peer.serve_wire(digest)
+        return (200, data) if data is not None else (404, b"")
+
+    return transport
+
+
+def _link(cold_engine, warm_engine, fp):
+    """Hint ``cold_engine`` that ``warm_engine`` is warm for ``fp``,
+    over the in-process transport."""
+    cold_engine.kv_peer._transport = _wire(warm_engine)
+    cold_engine.kv_peer.note_hint(fp, "127.0.0.1:19")
+
+
+PRE = "You are a helpful bot."
+
+
+# --- wire format -------------------------------------------------------
+
+
+def test_wire_roundtrip_and_validation():
+    rng = np.random.default_rng(0)
+    payload = {
+        "layer_0": {
+            "k": rng.standard_normal((3, 8, 4, 8)).astype(np.float32),
+            "v": rng.standard_normal((3, 8, 4, 8)).astype(np.float32),
+        },
+        "layer_1": {
+            "k_q": rng.integers(-128, 127, (3, 8, 4, 8)).astype(np.int8),
+            "k_scale": rng.standard_normal((3, 8, 4, 1)).astype(
+                np.float32
+            ),
+        },
+    }
+    blob = KVTierBlob(
+        "fp", payload, 8, payload_bytes(payload), 24, 2, 22
+    )
+    data = serialize_blob(blob)
+    out = deserialize_blob("fp", data)
+    assert (out.page, out.num_pages, out.nbytes) == (8, 3, blob.nbytes)
+    assert (out.bucket, out.lo, out.used) == (24, 2, 22)
+    for ln, layer in payload.items():
+        for name, a in layer.items():
+            np.testing.assert_array_equal(out.payload[ln][name], a)
+
+    # Every corruption class raises (→ a counted miss at the fetch
+    # seam), never a wrong install.
+    for bad in (
+        b"garbage with no header",
+        b"{}\n",                                  # header missing fields
+        data[: len(data) // 2],                   # truncated payload
+        data + b"x",                              # trailing bytes
+        data.replace(b'"nbytes": ', b'"nbytes": 9', 1),  # byte total lies
+    ):
+        with pytest.raises(ValueError):
+            deserialize_blob("fp", bad)
+    # Leaf shape not [num_pages, page, ...]: refused.
+    bad_blob = KVTierBlob(
+        "fp",
+        {"l": {"k": np.zeros((3, 4, 2), np.float32)}},
+        8, 3 * 4 * 2 * 4, 24, 2, 22,
+    )
+    with pytest.raises(ValueError):
+        deserialize_blob("fp", serialize_blob(bad_blob))
+    # A negative manifest dim would make the leaf's byte size
+    # negative — np.frombuffer(count<0) silently reads the whole
+    # remaining buffer and the truncation check never trips — so
+    # non-positive dims are refused outright.
+    head_line, _, rest = data.partition(b"\n")
+    head = json.loads(head_line)
+    head["leaves"][0][2] = [3, 8, -1]
+    with pytest.raises(ValueError):
+        deserialize_blob("fp", json.dumps(head).encode() + b"\n" + rest)
+    # TypeError-shaped corruption (non-int metadata, non-list leaf
+    # manifest) must surface as the one documented ValueError too —
+    # the fetch path's degradation contract keys on it.
+    for tamper in (
+        {"bucket": {}},
+        {"leaves": 5},
+        {"leaves": [3]},
+    ):
+        bad_head = dict(json.loads(head_line), **tamper)
+        with pytest.raises(ValueError):
+            deserialize_blob(
+                "fp", json.dumps(bad_head).encode() + b"\n" + rest
+            )
+
+
+def test_fp_digest_is_stable_and_urlsafe():
+    d = fp_digest(PRE)
+    assert d == fp_digest(PRE) and len(d) == 32
+    assert all(c in "0123456789abcdef" for c in d)
+    assert fp_digest("other") != d
+
+
+# --- peer-restored streams: identity, zero prefill FLOPs ---------------
+
+
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+@pytest.mark.parametrize("kind", ["gpt_lm", "llama_lm"])
+def test_peer_restored_stream_identity(kind, fmt, gpt_params, llama_params):
+    """The acceptance pin: a cold replica serving a prefix it peer-
+    fetched streams TOKEN-IDENTICAL to the warm replica that built it,
+    with zero cold prefills (``builds`` == 0) — and the wire bytes
+    equal the ``num_pages × kv_page_bytes`` closed form, both cache
+    formats, MHA and GQA. The staged blob's pool pages restore
+    through ``restore_entry`` (the tier's restore_hits move), so the
+    dispatch thread never saw the wire."""
+    params = gpt_params if kind == "gpt_lm" else llama_params
+    model = _model(kind, fmt)
+    warm = _engine(model, params)
+    cold = _engine(model, params)
+    ref = warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert warm.prefix.builds == 1
+    n_pages = len(warm.pool.entry_pages(PRE))
+    closed = n_pages * kv_page_bytes(model, warm.pool.page)
+
+    _link(cold, warm, PRE)
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.prefix.builds == 0               # zero prefill FLOPs
+    assert cold.kv_peer.fetch_hits == 1
+    assert cold.kv_peer.fetch_bytes == closed    # wire closed form
+    assert warm.kv_peer.serve_count == 1
+    assert warm.kv_peer.serve_bytes == closed
+    # The fetched blob was staged locally and its pool pages restored
+    # through the alloc-first restore path, not the adopt copy.
+    assert cold.kv_tier.entries == 1
+    assert cold.kv_tier.restore_hits == 1
+    assert cold.kv_tier.spill_count == 0         # staging is not a spill
+    # Steady state: the second arrival is a plain device-cache hit.
+    out2 = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out2["token_ids"] == ref["token_ids"]
+    assert cold.kv_peer.fetch_hits == 1
+
+
+def test_peer_serves_from_tier_blob_after_eviction(gpt_params):
+    """The warm peer's blob may live in its HOST TIER rather than on
+    device (that is the failover reality after pressure): the serve
+    path prefers the tier blob and the fetch still restores
+    byte-identically."""
+    model = _model()
+    warm = _engine(model, gpt_params)
+    cold = _engine(model, gpt_params)
+    ref = warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert warm.pool.evict_idle(1) == 1          # blob now tier-only
+    assert warm.pool.entry_pages(PRE) is None
+    misses_before = warm.kv_tier.restore_misses
+    _link(cold, warm, PRE)
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.prefix.builds == 0
+    assert warm.kv_peer.serve_count == 1
+    # Serving a peer is not a local restore attempt: the warm tier's
+    # restore counters did not move (lookup(count=False)).
+    assert warm.kv_tier.restore_misses == misses_before
+
+
+def test_serve_wire_image_is_cached_and_identical(gpt_params):
+    """The serve path caches the serialized wire image per digest
+    (blob bytes for a prefix are deterministic per engine config —
+    the r13 byte-identity pins), so N-1 peers fetching one hot
+    prefix cost ONE device gather + serialize. Counters still count
+    every serve (they measure wire traffic out)."""
+    model = _model()
+    warm = _engine(model, gpt_params)
+    warm.generate_text(" q1", max_new_tokens=4, prefix=PRE)
+    d = fp_digest(PRE)
+    first = warm.kv_peer.serve_wire(d)
+    second = warm.kv_peer.serve_wire(d)
+    assert second == first                       # byte-identical image
+    assert warm.kv_peer.serve_count == 2
+    assert len(warm.kv_peer._serve_cache) == 1
+    # The cached image deserializes to the same blob either way.
+    assert deserialize_blob(PRE, second).nbytes == deserialize_blob(
+        PRE, first
+    ).nbytes
+    # Cap bounds it: serving other prefixes rolls the LRU, never grows.
+    for i in range(6):
+        p = f"other prefix {i}"
+        warm.generate_text(" q", max_new_tokens=2, prefix=p)
+        assert warm.kv_peer.serve_wire(fp_digest(p)) is not None
+    assert len(warm.kv_peer._serve_cache) <= warm.kv_peer._serve_cache_cap
+
+
+def test_no_hint_or_disabled_goes_cold(gpt_params):
+    """No hint (direct traffic) → no fetch, plain cold build; peer
+    fetch disabled → no peer state at all, bit-identical to r16."""
+    model = _model()
+    warm = _engine(model, gpt_params)
+    ref = warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+
+    cold = _engine(model, gpt_params)
+    cold.kv_peer._transport = _wire(warm)        # linked but unhinted
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.prefix.builds == 1
+    assert cold.kv_peer.fetch_hits == 0
+    assert cold.kv_peer.fetch_misses == 0
+    assert cold.kv_peer.fetch_failures == 0
+
+    off = _engine(model, gpt_params, kv_peer_fetch=False)
+    assert off.kv_peer is None
+    assert off.kv_peer_fetch_hits == 0 and off.kv_peer_serve_bytes == 0
+    out = off.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] == ref["token_ids"]
+    assert off.prefix.builds == 1
+
+
+# --- failure discipline ------------------------------------------------
+
+
+def test_geometry_drift_dropped_as_miss(gpt_params):
+    """A peer running a DIFFERENT bucket geometry serves a blob whose
+    bucket cannot match what a local build produces today: counted as
+    a fetch miss, never installed, stream served by the cold build —
+    and nothing was staged locally."""
+    model = _model()
+    # A smaller first prompt bucket buckets the 22-token prefix to 32
+    # on the peer vs 64 locally — real config drift, not corruption.
+    warm = _engine(model, gpt_params, prompt_buckets=(32, 64, 128))
+    cold = _engine(model, gpt_params)
+    warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert warm.prefix._entries[PRE].bucket != cold.prefix._plan(PRE)[1]
+    _link(cold, warm, PRE)
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    ref = _engine(model, gpt_params, kv_peer_fetch=False).generate_text(
+        " q1", max_new_tokens=6, prefix=PRE
+    )
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.kv_peer.fetch_misses == 1
+    assert cold.kv_peer.fetch_hits == 0
+    assert cold.prefix.builds == 1               # the cold build ran
+    assert cold.kv_tier.entries == 0             # nothing staged
+    # Config drift is persistent: the hint is dropped so future cold
+    # arrivals of this prefix never re-transfer the inapplicable blob.
+    assert cold.kv_peer.hint_for(PRE) is None
+    with cold.prefix._lock:
+        cold.prefix._entries.pop(PRE, None)
+    cold.pool.drop_entry(PRE)
+    cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert cold.kv_peer.fetch_misses == 1        # no second wire hop
+
+
+def test_corrupt_wire_body_is_miss(gpt_params):
+    model = _model()
+    cold = _engine(model, gpt_params)
+    bodies = [b"total garbage", b""]
+    cold.kv_peer._transport = (
+        lambda h, p, path, t: (200, bodies.pop(0))
+    )
+    cold.kv_peer.note_hint(PRE, "127.0.0.1:19")
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"]
+    assert cold.kv_peer.fetch_misses == 1
+    assert cold.prefix.builds == 1
+
+
+def test_peer_404_is_miss_and_drops_hint(gpt_params):
+    """A 404 means the hinted peer is not warm after all (evicted,
+    restarted): counted a miss AND the hint dropped, so the next miss
+    does not re-pay a hop that cannot help."""
+    model = _model()
+    calls = []
+    cold = _engine(model, gpt_params)
+    cold.kv_peer._transport = (
+        lambda h, p, path, t: (calls.append(path), (404, b""))[1]
+    )
+    cold.kv_peer.note_hint(PRE, "127.0.0.1:19")
+    cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert len(calls) == 1 and cold.kv_peer.fetch_misses == 1
+    assert cold.kv_peer.hint_for(PRE) is None
+    # A second cold miss (entry evicted) makes no second wire call.
+    cold.prefix.max_entries = 1
+    cold.generate_text(" q", max_new_tokens=4, prefix="other")
+    cold.kv_tier.drop(PRE)
+    cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert len(calls) == 1
+
+
+def test_transport_error_is_failure(gpt_params):
+    model = _model()
+    cold = _engine(model, gpt_params)
+
+    def boom(h, p, path, t):
+        raise ConnectionRefusedError("peer is down")
+
+    cold.kv_peer._transport = boom
+    cold.kv_peer.note_hint(PRE, "127.0.0.1:19")
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] and cold.prefix.builds == 1
+    assert cold.kv_peer.fetch_failures == 1
+
+
+def test_peer_fault_matrix_degrades_cold_and_conserves_pages(gpt_params):
+    """The r12/r13 fault-matrix extension (satellite): a raise at
+    ``peer_fetch`` or ``peer_serve`` falls back to the cold prefill
+    with ``kv_pages_in_use`` conserved on BOTH replicas and streams
+    completing; delays slow, never break."""
+    model = _model()
+    warm = _engine(model, gpt_params)
+    ref = warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    warm_pages = warm.kv_pages_in_use
+
+    for spec, counter in (
+        ("peer_fetch:raise", "fetch_failures"),
+        ("peer_serve:raise", "fetch_failures"),
+    ):
+        cold = _engine(model, gpt_params)
+        _link(cold, warm, PRE)
+        with faults.active(spec):
+            out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+        assert out["token_ids"] == ref["token_ids"], spec
+        assert cold.prefix.builds == 1, spec     # cold path served
+        assert getattr(cold.kv_peer, counter) == 1, spec
+        assert cold.kv_peer.fetch_hits == 0, spec
+        # Pages conserved everywhere: the cold replica holds exactly
+        # its own entry's pages; the warm one is untouched.
+        assert cold.kv_pages_in_use == len(cold.pool.entry_pages(PRE))
+        assert warm.kv_pages_in_use == warm_pages, spec
+        assert warm.kv_peer.serve_count == 0, spec
+
+    # Delays at both points: slowed, byte-complete, counted.
+    cold = _engine(model, gpt_params)
+    _link(cold, warm, PRE)
+    with faults.active("peer_fetch:delay=0.01,peer_serve:delay=0.01"):
+        out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+        assert faults.injected_count() == 2
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.prefix.builds == 0 and cold.kv_peer.fetch_hits == 1
+
+
+def test_pool_exhaustion_mid_restore_loud(gpt_params):
+    """Pool pressure while a peer-staged blob restores: the staged
+    blob takes the same alloc-first ``restore_entry`` path a local
+    spill does, so exhaustion propagates loudly with NOTHING
+    half-installed — and the stream serves once pressure lifts."""
+    model = _model()
+    warm = _engine(model, gpt_params)
+    ref = warm.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    cold = _engine(model, gpt_params)
+    _link(cold, warm, PRE)
+    out = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out["token_ids"] == ref["token_ids"]
+    # Evict the restored pages (blob stays staged in the local tier),
+    # then squeeze the pool below the blob's page need.
+    assert cold.pool.evict_idle(1) == 1
+    n_pages = cold.kv_tier.lookup(PRE, count=False).num_pages
+    free = cold.kv_pages_total - cold.kv_pages_in_use
+    hold = cold.pool.alloc(free - (n_pages - 1))
+    with pytest.raises(PagePoolExhausted):
+        cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert cold.kv_pages_in_use == len(hold)     # nothing installed
+    assert cold.pool.entry_pages(PRE) is None
+    assert cold.kv_tier.entries == 1             # staged blob intact
+    cold.pool.release(hold)
+    out2 = cold.generate_text(" q1", max_new_tokens=6, prefix=PRE)
+    assert out2["token_ids"] == ref["token_ids"]
+    assert cold.kv_peer.fetch_hits == 1          # no re-fetch needed
+
+
+# --- the replica surface (endpoint + header gating) --------------------
+
+
+async def _asgi_client(app):
+    import httpx
+
+    await app.startup()
+    transport = httpx.ASGITransport(app=app)
+    return httpx.AsyncClient(transport=transport, base_url="http://t")
+
+
+async def test_kv_endpoint_serves_and_404s(gpt_params, monkeypatch):
+    import httpx  # noqa: F401 — the fixture family imports it anyway
+
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    eng = _engine(_model(), gpt_params)
+    eng.generate_text(" q1", max_new_tokens=4, prefix=PRE)
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        r = await cl.get(f"/kv/prefix?fp={fp_digest(PRE)}")
+        assert r.status_code == 200
+        assert r.headers["content-type"] == "application/octet-stream"
+        blob = deserialize_blob(PRE, r.content)
+        n_pages = len(eng.pool.entry_pages(PRE))
+        assert blob.nbytes == n_pages * kv_page_bytes(
+            eng.model, eng.pool.page
+        )
+        assert eng.kv_peer.serve_count == 1
+        assert (await cl.get("/kv/prefix?fp=" + "0" * 32)).status_code == 404
+        assert (await cl.get("/kv/prefix")).status_code == 422
+        # The /metrics peer block exports all six counters.
+        snap = (await cl.get("/metrics")).json()
+        c = snap["counters"]
+        assert c["generate.kv_peer_serve_count"] == 1
+        assert c["generate.kv_peer_serve_bytes"] == blob.nbytes
+        for k in ("hits", "misses", "bytes", "failures"):
+            assert c[f"generate.kv_peer_fetch_{k}"] == 0
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_endpoint_absent_and_metrics_silent_when_disabled(
+    gpt_params, monkeypatch,
+):
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    eng = _engine(_model(), gpt_params, kv_peer_fetch=False)
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        r = await cl.get(f"/kv/prefix?fp={fp_digest(PRE)}")
+        assert r.status_code == 404              # route never installed
+        snap = (await cl.get("/metrics")).json()
+        assert not any(
+            k.startswith("generate.kv_peer") for k in snap["counters"]
+        )
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_endpoint_absent_on_non_replica_even_when_enabled(
+    gpt_params, monkeypatch,
+):
+    """The endpoint install is replica-gated like the hint header: a
+    direct-facing server with the flag on (but no router fleet) must
+    not expose a cache-presence oracle that hands raw KV bytes to
+    arbitrary callers."""
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.delenv("MLAPI_TPU_REPLICA", raising=False)
+    monkeypatch.delenv("MLAPI_TPU_REPLICAS", raising=False)
+    eng = _engine(_model(), gpt_params)          # kv_peer_fetch=True
+    eng.generate_text(" q1", max_new_tokens=4, prefix=PRE)
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        r = await cl.get(f"/kv/prefix?fp={fp_digest(PRE)}")
+        assert r.status_code == 404
+        assert eng.kv_peer.serve_count == 0
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_warm_peer_header_gated_to_replicas(gpt_params, monkeypatch):
+    """The hint header is trusted only on router replicas (the
+    x-mlapi-router-depth trust model): a direct caller must not be
+    able to aim this server's KV fetches at an arbitrary host."""
+    from mlapi_tpu.serving import build_app
+
+    async def post(eng_env_replica: bool):
+        if eng_env_replica:
+            monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+        else:
+            monkeypatch.delenv("MLAPI_TPU_REPLICA", raising=False)
+        eng = _engine(_model(), gpt_params)
+        app = build_app(eng)
+        cl = await _asgi_client(app)
+        try:
+            r = await cl.post(
+                "/generate",
+                json={"text": " q", "prefix": PRE, "max_new_tokens": 2},
+                headers={"x-mlapi-warm-peer": "10.0.0.9:8001"},
+            )
+            assert r.status_code == 200
+        finally:
+            await cl.aclose()
+            await app.shutdown()
+        return eng
+
+    eng = await post(True)
+    assert eng.kv_peer.hint_for(PRE) == ("10.0.0.9", 8001)
+    eng = await post(False)
+    assert eng.kv_peer.hint_for(PRE) is None
+
+
+def test_malformed_hint_never_becomes_a_connect(gpt_params):
+    peer = KVPeer(object())
+    for bad in ("", "nohost", "host:notaport", ":", "host:"):
+        peer.note_hint("fp", bad)
+        assert peer.hint_for("fp") is None
+
+
+# --- the 2-replica e2e: a drained replica's slice warm-starts ----------
+
+
+async def test_drained_slice_warm_starts_on_survivor(
+    gpt_params, monkeypatch,
+):
+    """The satellite e2e, real sockets end to end: replica A builds a
+    prefix (1 cold build), A drains, the router remaps A's slice to B
+    with a warm-peer hint, and B serves the prefix by fetching A's
+    blob over real HTTP — ``prefix_builds`` stays at 1 FLEET-WIDE,
+    token streams identical before and after the failover."""
+    import httpx
+
+    from mlapi_tpu.serving import build_app
+    from mlapi_tpu.serving.router import Router, build_router_app, hrw_order
+    from mlapi_tpu.serving.server import Server
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    engines = [_engine(_model(), gpt_params) for _ in range(2)]
+    servers = []
+    for eng in engines:
+        srv = Server(
+            build_app(eng, admission_control=False),
+            host="127.0.0.1", port=0,
+        )
+        await srv.start()
+        servers.append(srv)
+    router = Router(
+        [("127.0.0.1", s.port) for s in servers], health_poll_s=0.05
+    )
+    front = Server(build_router_app(router), host="127.0.0.1", port=0)
+    await front.start()
+    try:
+        # A prefix whose HRW head is replica 0 ("A").
+        names = [r.name for r in router.replicas]
+        pre = next(
+            f"warm start prompt {i}"
+            for i in range(1000)
+            if hrw_order(
+                f"warm start prompt {i}".encode()[
+                    : router.affinity_prefix_bytes
+                ],
+                names,
+            )[0] == names[0]
+        )
+        a_eng, b_eng = engines
+        payload = {"text": " go", "prefix": pre, "max_new_tokens": 6}
+        async with httpx.AsyncClient(timeout=60.0) as c:
+            url = f"http://127.0.0.1:{front.port}/generate"
+            r1 = await c.post(url, json=payload)
+            assert r1.status_code == 200
+            assert a_eng.prefix.builds == 1 and b_eng.prefix.builds == 0
+
+            # Drain A; the poll flips it; its slice remaps to B.
+            await a_eng.drain(0.05)
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if router.replicas[0].state == "draining":
+                    break
+            assert router.replicas[0].state == "draining"
+
+            r2 = await c.post(url, json=payload)
+            assert r2.status_code == 200
+            assert r2.json()["token_ids"] == r1.json()["token_ids"]
+        # The whole point: B served A's slice WITHOUT a cold prefill —
+        # one build fleet-wide — because it fetched A's blob (A still
+        # serves GET /kv while draining).
+        assert b_eng.prefix.builds == 0
+        assert a_eng.prefix.builds + b_eng.prefix.builds == 1
+        assert b_eng.kv_peer.fetch_hits == 1
+        assert a_eng.kv_peer.serve_count == 1
+        assert b_eng.kv_peer.fetch_bytes == a_eng.kv_peer.serve_bytes > 0
+        assert router.warm_peer_hints >= 1
+        # And the router's aggregated /metrics sums the peer counters
+        # across the fleet like every other generate counter.
+        async with httpx.AsyncClient(timeout=30.0) as c:
+            snap = (
+                await c.get(f"http://127.0.0.1:{front.port}/metrics")
+            ).json()
+        assert snap["counters"]["generate.kv_peer_fetch_hits"] == 1
+        assert snap["counters"]["generate.kv_peer_serve_count"] == 1
+        assert snap["counters"]["generate.prefix_builds"] == 1
+        assert snap["counters"]["router.warm_peer_hints"] >= 1
+    finally:
+        await front.stop()
+        await router.stop()
+        for s in servers:
+            await s.stop()

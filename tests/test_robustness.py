@@ -742,7 +742,14 @@ async def _matrix_traffic(eng, tier_leg: bool = False) -> list:
 # lane with pages conserved while the other lane streams on.
 _ENGINE_POINTS = tuple(
     p for p in faults.POINTS
-    if p not in ("router_forward", "sched_unit")
+    if p not in (
+        "router_forward", "sched_unit",
+        # The peer-fetch hop (crossed only with --kv-peer-fetch on a
+        # hinted replica) has its matrix in test_kv_peer.py: a raise
+        # at either point degrades to the cold prefill with pages
+        # conserved and streams completing.
+        "peer_fetch", "peer_serve",
+    )
 )
 
 

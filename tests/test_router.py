@@ -20,6 +20,7 @@ import pytest
 from mlapi_tpu.serving import faults
 from mlapi_tpu.serving.asgi import (
     App,
+    Request,
     StreamingResponse,
     json_response,
 )
@@ -30,6 +31,7 @@ from mlapi_tpu.serving.router import (
     NoReplicaAvailable,
     ReplicaState,
     Router,
+    _SubmitError,
     build_router_app,
     hrw_order,
 )
@@ -188,6 +190,102 @@ def test_shed_window_expires():
     assert router.choose(key) is not pref
     pref.shed_until = 0.0
     assert router.choose(key) is pref
+
+
+def _plain_request(headers=None) -> Request:
+    return Request(
+        {
+            "method": "POST",
+            "path": "/generate",
+            "headers": list(headers or []),
+        },
+        b"{}",
+    )
+
+
+async def test_warm_peer_hint_is_hrw_head_on_every_non_preferred_forward():
+    """The warmth contract (r17): a forward that misses the key's
+    HRW-preferred replica carries that head as the warm-peer hint —
+    and a forward that LANDS on the head carries none (nobody is
+    warmer than the target itself)."""
+    router = _router(3)
+    key = b"some prefix"
+    pref = _preferred(router, key)
+    seen = []
+
+    async def fake_attempt(r, request, warm_peer=None):
+        seen.append((r, warm_peer))
+        return json_response({}, 200)
+
+    router._attempt = fake_attempt
+    await router.forward(_plain_request(), key)
+    assert seen[-1] == (pref, None)
+    assert router.warm_peer_hints == 0
+    # Preferred draining: the fallback forward names the head.
+    pref.state = DRAINING
+    await router.forward(_plain_request(), key)
+    target, hint = seen[-1]
+    assert target is not pref and hint is pref
+    assert router.warm_peer_hints == 1
+    # No key (and round_robin policy): no warmth map, no hint.
+    await router.forward(_plain_request(), None)
+    assert seen[-1][1] is None
+    rr = _router(3, policy="round_robin")
+    rr._attempt = fake_attempt
+    await rr.forward(_plain_request(), key)
+    assert seen[-1][1] is None and rr.warm_peer_hints == 0
+
+
+async def test_warm_peer_hint_survives_failover_hop():
+    """The affinity-map blind spot (satellite fix): the failover's
+    second choose() excludes the failed replica and re-ranks the rest
+    — it has no memory of the ORIGINAL preferred. The hint must ride
+    the original HRW head through the retry hop anyway."""
+    router = _router(3)
+    key = b"some prefix"
+    pref = _preferred(router, key)
+    calls = []
+
+    async def fake_attempt(r, request, warm_peer=None):
+        calls.append((r, warm_peer))
+        if len(calls) == 1:
+            raise _SubmitError("injected pre-submit", retryable=True)
+        return json_response({}, 200)
+
+    router._attempt = fake_attempt
+    await router.forward(_plain_request(), key)
+    (first, hint1), (second, hint2) = calls
+    assert first is pref and hint1 is None
+    assert second is not pref
+    assert hint2 is pref          # the head survived the retry hop
+    assert router.failovers == 1
+    assert router.warm_peer_hints == 1
+
+
+def test_build_upstream_stamps_and_strips_warm_peer():
+    """Anti-spoof parity with x-mlapi-router-depth: a client-sent
+    warm-peer header is dropped (it could aim a replica's KV fetches
+    at an arbitrary host), and the router-authored one appears
+    exactly once, naming the hinted replica."""
+    router = _router(2)
+    target, peer = router.replicas
+    req = _plain_request(
+        headers=[
+            (b"x-mlapi-warm-peer", b"evil.example:9"),
+            (b"content-type", b"application/json"),
+        ]
+    )
+    head = router._build_upstream(req, target, peer).split(
+        b"\r\n\r\n"
+    )[0].lower()
+    assert head.count(b"x-mlapi-warm-peer") == 1
+    assert b"x-mlapi-warm-peer: " + peer.name.encode() in head
+    assert b"evil.example" not in head
+    # No hint: the header is absent entirely.
+    head2 = router._build_upstream(req, target, None).split(
+        b"\r\n\r\n"
+    )[0].lower()
+    assert b"x-mlapi-warm-peer" not in head2
 
 
 def test_routing_key_prefers_prefix_field_and_truncates():

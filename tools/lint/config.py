@@ -67,6 +67,17 @@ LOCK_REGISTRY: dict[str, LockSpec] = {
             "_pending", "_lanes", "_forming_group", "_stopped",
         }),
     ),
+    # r17 peer-fetch state: hints arrive from the event loop, fetch
+    # counters from encode executor threads, serve counters from the
+    # app executor — all /metrics-scraped, all lost-update-prone.
+    "KVPeer": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            "_hints", "_serve_cache",
+            "fetch_hits", "fetch_misses", "fetch_bytes",
+            "fetch_failures", "serve_count", "serve_bytes",
+        }),
+    ),
     "LatencyStats": LockSpec(
         locks=frozenset({"_lock"}),
         attrs=frozenset({"_ttft_ms", "_itl_ms"}),
