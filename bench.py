@@ -1189,6 +1189,243 @@ print(json.dumps(report))
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _disagg_report(ck: str, env: dict) -> dict:
+    """Subprocess: prefill/decode disaggregation on the SAME
+    checkpoint (``BENCH_GEN_DISAGG=1``) — a P=1 prefill + D=1 decode
+    role-split fleet vs 2 mixed replicas, both behind the real
+    router over real sockets. Claim classes per the variance rule:
+
+    - **Counters + bytes — asserted, never wall-clock.** On every
+      disaggregated leg the decode replica pays ZERO prefill FLOPs
+      (``prefix_builds == 0`` AND ``prefill_chunks == 0`` while
+      ``kv_push_applied`` covers every request) and the pushed bytes
+      equal the ``num_pages × kv_page_bytes`` closed form — asserted
+      for BOTH cache formats (int8 pushes at fewer wire bytes), with
+      streams asserted token-identical to a mixed engine serving the
+      same request alone.
+    - **Prompt-heavy arrival TTFT + running-stream ITL — measured,
+      topologies ALTERNATED in ONE window.** The workload mixed
+      replicas serve worst: a long-budget running stream occupies a
+      replica while prompt-heavy (chunked-prefill) arrivals land.
+      Role-split, the arrivals' prefills burn the PREFILL replica
+      while the decode replica's running stream keeps its inter-token
+      cadence; mixed, affinity may land a long prefill on the replica
+      mid-stream. Running-stream ITL p95 is reported per topology
+      (subject to VARIANCE_NOTE on this box).
+    """
+    src = f"""
+import asyncio, dataclasses, json, os, time
+os.environ["MLAPI_TPU_REPLICA"] = "1"   # the push surface is replica-gated
+import numpy as np
+import jax
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_page_bytes
+from mlapi_tpu.serving import build_app
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.router import Router, build_router_app
+from mlapi_tpu.serving.server import Server
+from mlapi_tpu.text import ByteTokenizer
+
+PAGE = 16
+params, meta = load_checkpoint({ck!r})
+base = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+report = {{}}
+# Prompt-heavy: 100 tokens bucket to 128 = TWO 64-token prefill
+# chunks, so the chunk-granularity push (and the chunked cold
+# prefill it replaces) is exercised for real.
+HEAVY = "the quick brown fox jumps over the lazy dog. " * 2 + "go"
+STREAM_N, HEAVY_N = 96, 8
+
+def engine(model, role="mixed"):
+    return TextGenerationEngine(
+        model, params, tokenizer=tok, chunk=8, fused_single=False,
+        kv_page_size=PAGE, prompt_buckets=(16, 64),
+        replica_role=role,
+    )
+
+async def serve(eng):
+    srv = Server(
+        build_app(eng, admission_control=False),
+        host="127.0.0.1", port=0,
+    )
+    await srv.start()
+    return srv
+
+# --- asserted legs: identity + closed-form bytes, both formats -------
+async def formats():
+    loop = asyncio.get_running_loop()
+    for fmt in ("none", "int8"):
+        model = (
+            dataclasses.replace(base, kv_quant=fmt) if fmt != "none"
+            else base
+        )
+        mixed, pre, dec = engine(model), engine(model, "prefill"), (
+            engine(model, "decode")
+        )
+        ref = await loop.run_in_executor(
+            None,
+            lambda: mixed.generate_text(HEAVY, max_new_tokens=HEAVY_N),
+        )
+        srv_p, srv_d = await serve(pre), await serve(dec)
+        router = Router(
+            [("127.0.0.1", srv_p.port), ("127.0.0.1", srv_d.port)],
+            roles=["prefill", "decode"], health_poll_s=0.1,
+        )
+        front = Server(
+            build_router_app(router), host="127.0.0.1", port=0
+        )
+        await front.start()
+        try:
+            import httpx
+
+            async with httpx.AsyncClient(timeout=300.0) as c:
+                r = await c.post(
+                    "http://127.0.0.1:%d/generate" % front.port,
+                    json={{"text": HEAVY, "max_new_tokens": HEAVY_N}},
+                )
+                assert r.status_code == 200, r.text
+                assert r.json()["token_ids"] == ref["token_ids"], fmt
+            # Zero decode-side prefill FLOPs, from counters.
+            assert dec.prefix.builds == 0, fmt
+            assert dec.prefill_chunks == 0, fmt
+            assert dec.kv_push_applied == 1, fmt
+            # 128-slot bucket = 8 pages of 16 slots: the closed form
+            # on BOTH ends of the wire.
+            closed = 8 * kv_page_bytes(model, PAGE)
+            assert pre.kv_push_bytes_sent == closed, (
+                pre.kv_push_bytes_sent, closed)
+            assert dec.kv_push_bytes_applied == closed, fmt
+            assert pre.kv_push.push_sent == 2, fmt   # chunk granularity
+            report[f"disagg_push_wire_bytes_{{fmt}}"] = closed
+        finally:
+            await front.stop()
+            await router.stop()
+            await srv_p.stop()
+            await srv_d.stop()
+
+asyncio.run(formats())
+report["disagg_push_ratio_none_over_int8"] = round(
+    report["disagg_push_wire_bytes_none"]
+    / report["disagg_push_wire_bytes_int8"], 3
+)
+report["disagg_bytes_asserted"] = True
+report["disagg_zero_decode_prefill_asserted"] = True
+report["disagg_streams_identical"] = True
+
+# --- measured window: P+D vs 2 mixed, alternated ---------------------
+async def window():
+    import httpx
+
+    topo = {{}}
+    for name, roles, engs in (
+        ("disagg", ["prefill", "decode"],
+         [engine(base, "prefill"), engine(base, "decode")]),
+        ("mixed", None, [engine(base), engine(base)]),
+    ):
+        srvs = [await serve(e) for e in engs]
+        router = Router(
+            [("127.0.0.1", s.port) for s in srvs],
+            roles=roles, health_poll_s=0.1,
+        )
+        front = Server(
+            build_router_app(router), host="127.0.0.1", port=0
+        )
+        await front.start()
+        topo[name] = (engs, srvs, router, front)
+
+    async def one_round(name):
+        engs, srvs, router, front = topo[name]
+        url = "http://127.0.0.1:%d/generate" % front.port
+        stamps = []
+        async with httpx.AsyncClient(timeout=300.0) as c:
+            async def run_stream():
+                async with c.stream(
+                    "POST", url,
+                    json={{"text": "warm me up", "stream": True,
+                          "max_new_tokens": STREAM_N}},
+                ) as resp:
+                    async for line in resp.aiter_lines():
+                        if line:
+                            stamps.append(
+                                (time.perf_counter(),
+                                 len(json.loads(line).get(
+                                     "token_ids", [])))
+                            )
+
+            stream_task = asyncio.create_task(run_stream())
+            # Let the stream get going, then land prompt-heavy work.
+            while len(stamps) < 2:
+                await asyncio.sleep(0.002)
+            ttfts = []
+            for k in range(3):
+                t0 = time.perf_counter()
+                r = await c.post(
+                    url,
+                    json={{"text": HEAVY + str(k),
+                          "max_new_tokens": HEAVY_N}},
+                )
+                assert r.status_code == 200, r.text
+                ttfts.append((time.perf_counter() - t0) * 1e3)
+            await stream_task
+        gaps = [
+            (stamps[i][0] - stamps[i - 1][0]) * 1e3
+            / max(1, stamps[i][1])
+            for i in range(1, len(stamps)) if stamps[i][1]
+        ]
+        return ttfts, gaps
+
+    try:
+        for name in topo:                 # compile round, off the clock
+            await one_round(name)
+        out = {{n: ([], []) for n in topo}}
+        for rnd in range(4):              # alternated: ONE window
+            order = (
+                ("disagg", "mixed") if rnd % 2 == 0
+                else ("mixed", "disagg")
+            )
+            for name in order:
+                ttfts, gaps = await one_round(name)
+                out[name][0].extend(ttfts)
+                out[name][1].extend(gaps)
+        # The disagg legs' structural claim, from counters: every
+        # measured-window request's prefill ran on the prefill
+        # replica, never the decode one.
+        dec_eng = topo["disagg"][0][1]
+        assert dec_eng.prefill_chunks == 0
+        assert dec_eng.prefix.builds == 0
+        assert dec_eng.kv_push_applied > 0
+        return out
+    finally:
+        for engs, srvs, router, front in topo.values():
+            await front.stop()
+            await router.stop()
+            for s in srvs:
+                await s.stop()
+
+out = asyncio.run(window())
+q = lambda xs, f: round(sorted(xs)[min(len(xs) - 1, int(f * len(xs)))], 2)
+for name, (ttfts, gaps) in out.items():
+    report[f"{{name}}_heavy_arrival_ttft_p50_ms"] = q(ttfts, 0.5)
+    report[f"{{name}}_heavy_arrival_ttft_p95_ms"] = q(ttfts, 0.95)
+    report[f"{{name}}_running_stream_itl_p50_ms"] = q(gaps, 0.5)
+    report[f"{{name}}_running_stream_itl_p95_ms"] = q(gaps, 0.95)
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"disagg_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def _sched_report(ck: str, env: dict) -> dict:
     """Subprocess: continuous-batching scheduler v2 on the SAME
     checkpoint (BENCH_GEN_SCHED=1). Claim classes per the variance
@@ -1782,6 +2019,13 @@ def bench_generate() -> None:
             # window; interleaving asserted from sched_* counters and
             # streams asserted identical in-subprocess.
             kv_extras.update(_sched_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_DISAGG") == "1":
+            # Prefill/decode disaggregation: P=1+D=1 role-split vs 2
+            # mixed replicas alternated in one window on a
+            # prompt-heavy-plus-running-stream workload; zero
+            # decode-side prefill FLOPs and the push-byte closed form
+            # asserted in-subprocess for both KV formats.
+            kv_extras.update(_disagg_report(ck, server_env))
         if os.environ.get("BENCH_GEN_ROUTER") == "1":
             # Scale-out router: 2 engine replicas, repeated-prefix
             # workload, affinity vs forced round-robin alternated in

@@ -152,6 +152,11 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
     if getattr(args, "kv_peer_fetch", False):
         cmd += ["--kv-peer-fetch"]
+    if getattr(args, "replica_role", "mixed") != "mixed":
+        # A uniform role for every child (the role-split supervisor
+        # appends its own per-child --replica-role AFTER these, and
+        # argparse's last occurrence wins).
+        cmd += ["--replica-role", args.replica_role]
     if not getattr(args, "prefill_page_native", True):
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
@@ -307,6 +312,14 @@ def _supervise_router(ckpt: str | None, args) -> int:
     )
     from mlapi_tpu.serving.router import Router, build_router_app
 
+    # Role-split topology (r18): --prefill-replicas P --decode-replicas D
+    # spawns P prefill-role + D decode-role replicas instead of
+    # --replicas mixed ones. Pools size independently per role group
+    # (--prefill-kv-pages / --decode-kv-pages override --kv-pages for
+    # their group: prompt-only working sets vs prompt+generation).
+    n_pre = getattr(args, "prefill_replicas", 0)
+    n_dec = getattr(args, "decode_replicas", 0)
+    roles: list | None = None
     if args.replica_urls:
         endpoints = replica_endpoints_from_env(args.replica_urls)
         spawn = False
@@ -314,9 +327,12 @@ def _supervise_router(ckpt: str | None, args) -> int:
         endpoints = replica_endpoints_from_env()  # $MLAPI_TPU_REPLICAS
         spawn = not endpoints
         if spawn:
+            n = n_pre + n_dec if (n_pre or n_dec) else args.replicas
             endpoints = [
-                (args.host, args.port + 1 + i) for i in range(args.replicas)
+                (args.host, args.port + 1 + i) for i in range(n)
             ]
+            if n_pre or n_dec:
+                roles = ["prefill"] * n_pre + ["decode"] * n_dec
     if not endpoints:
         raise SystemExit("--router: no replica endpoints")
     env_spec = ",".join(f"{h}:{p}" for h, p in endpoints)
@@ -334,6 +350,19 @@ def _supervise_router(ckpt: str | None, args) -> int:
                 "--replica-urls"
             )
         for i, (h, p) in enumerate(endpoints):
+            role_flags: list = []
+            if roles is not None:
+                role_flags += ["--replica-role", roles[i]]
+                # Per-role pool sizing, appended AFTER the shared
+                # flags so argparse's last-occurrence rule makes it
+                # the group's --kv-pages override.
+                per_role = (
+                    getattr(args, "prefill_kv_pages", None)
+                    if roles[i] == "prefill"
+                    else getattr(args, "decode_kv_pages", None)
+                )
+                if per_role is not None:
+                    role_flags += ["--kv-pages", str(per_role)]
             cmds.append(
                 (
                     [
@@ -341,6 +370,7 @@ def _supervise_router(ckpt: str | None, args) -> int:
                         "--checkpoint", ckpt, "--host", h, "--port", str(p),
                         "--max-wait-ms", str(args.max_wait_ms),
                         *_forwarded_engine_flags(args),
+                        *role_flags,
                     ],
                     dict(base_env, MLAPI_TPU_REPLICA_ID=str(i)),
                 )
@@ -356,6 +386,7 @@ def _supervise_router(ckpt: str | None, args) -> int:
             # Gate routing on a passed health poll: a replica still
             # compiling its warmup grids must not eat traffic.
             assume_live=False,
+            roles=roles,
         )
         server = Server(build_router_app(router), host=args.host,
                         port=args.port)
@@ -479,6 +510,53 @@ def main(argv=None) -> None:
         "--replicas", type=int, default=2,
         help="with --router: how many engine replica processes to "
              "spawn (default 2)",
+    )
+    parser.add_argument(
+        "--replica-role", choices=["prefill", "decode", "mixed"],
+        default="mixed",
+        help="prefill/decode disaggregation role (r18, generative "
+             "checkpoints): 'prefill' replicas take the first hop of "
+             "role-split generative traffic — they run the prompt's "
+             "chunked prefill and PUSH each finished chunk's KV to "
+             "the decode replica the router named (POST /kv/push, "
+             "the r17 blob wire format at chunk granularity); "
+             "'decode' replicas stage pushed chunks and activate the "
+             "stream with ZERO local prefill FLOPs the moment the "
+             "last chunk lands (generate.kv_push_applied moves while "
+             "prefix_builds and prefill_chunks stay flat). 'mixed' "
+             "(default) serves both phases — an all-mixed fleet is "
+             "bit-identical to the flag never existing. Roles "
+             "specialize routing and pool sizing, not capability: "
+             "either role still serves a plain /generate end to end "
+             "(the router's role-starved fallback)",
+    )
+    parser.add_argument(
+        "--prefill-replicas", type=int, default=0,
+        help="with --router: spawn this many PREFILL-role replicas "
+             "(combined with --decode-replicas, replaces --replicas; "
+             "ports still derive from --port). The router sends new "
+             "generative requests to the prefill pool (p2c by load) "
+             "and the stream to the HRW-chosen decode replica; a "
+             "role-starved fleet degrades to mixed routing, counted "
+             "in router.role_fallback_mixed",
+    )
+    parser.add_argument(
+        "--decode-replicas", type=int, default=0,
+        help="with --router: spawn this many DECODE-role replicas "
+             "(see --prefill-replicas)",
+    )
+    parser.add_argument(
+        "--prefill-kv-pages", type=int, default=None,
+        help="with --prefill-replicas and --kv-page-size: the "
+             "prefill pool's --kv-pages override — prefill replicas "
+             "hold prompt-only working sets (no generation tail), so "
+             "their pool sizes independently of the decode pool's",
+    )
+    parser.add_argument(
+        "--decode-kv-pages", type=int, default=None,
+        help="with --decode-replicas and --kv-page-size: the decode "
+             "pool's --kv-pages override (prompt + generation "
+             "working sets)",
     )
     parser.add_argument(
         "--replica-urls", default=None,
@@ -746,6 +824,28 @@ def main(argv=None) -> None:
             "--router and --workers are different topologies (distinct "
             "ports with affinity vs one shared port); pick one"
         )
+    if (args.prefill_replicas or args.decode_replicas) and not args.router:
+        parser.error(
+            "--prefill-replicas/--decode-replicas describe a --router "
+            "topology; without the router nothing routes by role"
+        )
+    if (args.prefill_replicas or args.decode_replicas) and (
+        args.replica_urls or os.environ.get("MLAPI_TPU_REPLICAS")
+    ):
+        parser.error(
+            "--prefill-replicas/--decode-replicas spawn the role "
+            "topology; over an external fleet (--replica-urls / "
+            "$MLAPI_TPU_REPLICAS) launch the replicas with "
+            "--replica-role yourself"
+        )
+    if (
+        args.prefill_kv_pages or args.decode_kv_pages
+    ) and not args.kv_page_size:
+        # Same before-the-fork loudness as the tier mis-pair below.
+        parser.error(
+            "--prefill-kv-pages/--decode-kv-pages require "
+            "--kv-page-size (they size the paged pool per role group)"
+        )
     if router_external:
         ckpt = args.checkpoint
     else:
@@ -822,6 +922,7 @@ def main(argv=None) -> None:
         kv_tier_bytes=args.kv_tier_bytes,
         kv_tier_disk_dir=args.kv_tier_disk_dir,
         kv_peer_fetch=args.kv_peer_fetch,
+        replica_role=args.replica_role,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
         scheduler=args.scheduler,

@@ -107,13 +107,39 @@ def _warm_peer(request) -> str | None:
     ``_router_depth``: read only on router replicas, and the router
     strips client-sent copies, so an arbitrary caller can never aim
     this replica's KV fetches at a host of their choosing."""
+    return _scan_header(request, b"x-mlapi-warm-peer")
+
+
+def _scan_header(request, key: bytes) -> str | None:
+    """Raw ASGI header-list scan for one router-authored key (same
+    no-full-decode discipline as ``_router_depth``)."""
     for k, v in request.scope.get("headers", []):
-        if k == b"x-mlapi-warm-peer":
+        if k == key:
             try:
                 return v.decode("latin-1").strip() or None
             except Exception:
                 return None
     return None
+
+
+def _decode_peer(request) -> str | None:
+    """The decode replica a fronting router named for a disaggregated
+    forward (``x-mlapi-decode-peer: host:port``, r18) — stamped only
+    on forwards to PREFILL-role replicas. Router-authored and
+    replica-gated like ``x-mlapi-warm-peer``: the router strips
+    client-sent copies, and a non-replica server never reads it, so
+    an arbitrary caller can never aim a replica's KV pushes at a host
+    of their choosing."""
+    return _scan_header(request, b"x-mlapi-decode-peer")
+
+
+def _kv_xfer(request) -> str | None:
+    """The transfer id of a disaggregated request
+    (``x-mlapi-kv-xfer``, r18): on a prefill replica it names the
+    push stream to open; on a decode replica it names the staged
+    transfer whose KV replaces this request's prefill. Same trust
+    model as ``_decode_peer``."""
+    return _scan_header(request, b"x-mlapi-kv-xfer")
 
 
 def _overloaded_http(e: OverloadedError) -> HTTPError:
@@ -200,6 +226,16 @@ def build_app(
             # endpoint would only be a cache-presence oracle handing
             # raw KV bytes to arbitrary direct callers.
             _install_kv_peer(app, engine)
+        if (
+            getattr(engine, "kv_push", None) is not None
+            and getattr(engine, "replica_role", "mixed") == "decode"
+            and _is_router_replica()
+        ):
+            # The push intake exists ONLY on decode-role replicas
+            # inside a fleet (r18): a mixed topology exposes no push
+            # endpoint at all — bit-identical to r17 — and outside a
+            # fleet there is no trusted pusher.
+            _install_kv_push(app, engine)
     else:
         batcher = MicroBatcher(
             engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -434,6 +470,70 @@ def _install_generate(app: App, engine) -> None:
             )
         _validate_deadline_ms(req.deadline_ms)
         stops = _norm_stops(req.stop)
+        push_to = None
+        kv_xfer = None
+        if is_replica and getattr(engine, "kv_push", None) is not None:
+            xfer = _kv_xfer(request)
+            peer = _decode_peer(request)
+            if (
+                xfer
+                and peer
+                and engine.replica_role == "prefill"
+                and not req.prefix
+            ):
+                # Disaggregated PREFILL leg (r18): run the prompt as
+                # a prefill-only batch whose chunk KV streams to the
+                # named decode replica; answer the router with the
+                # handoff verdict — it forwards the client's request
+                # to the decode replica next (with the transfer id
+                # only if every chunk landed).
+                host, _, port = peer.rpartition(":")
+                if host and port.isdigit():
+                    push_to = (host, int(port), xfer)
+            elif xfer and engine.replica_role == "decode":
+                # Disaggregated DECODE leg: the staged transfer's KV
+                # replaces this request's prefill at formation.
+                kv_xfer = xfer
+        if push_to is not None:
+            try:
+                gen = await engine.submit(
+                    req.text,
+                    max_new_tokens=n_new,
+                    temperature=req.temperature,
+                    seed=req.seed,
+                    top_k=req.top_k,
+                    top_p=req.top_p,
+                    deadline_ms=req.deadline_ms,
+                    push_to=push_to,
+                )
+            except OverloadedError as e:
+                raise _overloaded_http(e) from None
+            first_token = None
+            while True:
+                item = await gen.queue.get()
+                if isinstance(item, Exception):
+                    http = _terminal_http(item)
+                    if http is not None:
+                        raise http from None
+                    raise item
+                if item is None:
+                    break
+                ids = item.get("token_ids") or []
+                if ids and first_token is None:
+                    first_token = int(ids[0])
+            # The fin rides the FIFO sender queue behind every chunk,
+            # so a True here means the decode replica has the whole
+            # transfer; waited off the event loop.
+            complete = await asyncio.get_running_loop().run_in_executor(
+                None, engine.kv_push.wait_sent, push_to[2]
+            )
+            return {
+                "handoff": True,
+                "xfer": push_to[2],
+                "complete": bool(complete and first_token is not None),
+                "first_token": first_token,
+                "prompt_tokens": gen.prompt_tokens,
+            }
         try:
             gen = await engine.submit(
                 req.text,
@@ -449,6 +549,7 @@ def _install_generate(app: App, engine) -> None:
                 # and sync once.
                 stream=bool(req.stream) or bool(stops),
                 deadline_ms=req.deadline_ms,
+                kv_xfer=kv_xfer,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
@@ -608,6 +709,31 @@ def _install_kv_peer(app: App, engine) -> None:
         return Response(data, content_type="application/octet-stream")
 
 
+def _install_kv_push(app: App, engine) -> None:
+    """The internal prefill→decode push intake (r18 disaggregation,
+    decode-role replicas only): ``POST /kv/push`` stages one chunk
+    (or the fin) of a transfer. Parse + staging run on an executor
+    thread — numpy copies of multi-KB bodies must not block the
+    event loop. A corrupt body is a 400 the SENDER counts as its
+    transfer failure; the decode replica then simply cold-prefills
+    when the router's second hop arrives without a usable
+    transfer."""
+    push = engine.kv_push
+
+    @app.post("/kv/push")
+    async def kv_push(request: Request):
+        body = request.body
+        if not body:
+            raise HTTPError(422, "empty push body")
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, push.receive, body
+            )
+        except ValueError as e:
+            raise HTTPError(400, f"bad push body: {e}") from None
+        return out
+
+
 def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> None:
     """Routes/middleware every engine kind shares: CSV ingestion
     (``/files/``, the reference's second endpoint), health, metrics."""
@@ -750,10 +876,15 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             batcher.queue_depth if batcher is not None
             else getattr(engine, "queue_depth", 0)
         )
+        role = getattr(engine, "replica_role", "mixed")
         return {
             # "draining" the moment shutdown begins: the load balancer
             # stops routing here while in-flight streams finish.
             "status": "draining" if draining else "ok",
+            # Role-split fleets (r18): which disaggregation role this
+            # replica plays. Absent on mixed replicas — the default
+            # topology's healthz is bit-identical to r17.
+            **({"role": role} if role != "mixed" else {}),
             # Backpressure in the SAME poll the router/balancer already
             # makes for liveness (its threshold check still scrapes the
             # authoritative /metrics gauges on the poll cadence; this
@@ -1063,6 +1194,44 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
                 )
                 snap["counters"]["generate.kv_peer_serve_bytes"] = (
                     engine.kv_peer_serve_bytes
+                )
+            if getattr(engine, "kv_push", None) is not None:
+                # Prefill/decode disaggregation (r18): chunk-push
+                # traffic out (prefill role) and in (decode role),
+                # exact payload-byte arithmetic per chunk — never
+                # wall-clock. kv_push_applied moving while
+                # prefix_builds AND prefill_chunks stay flat IS the
+                # zero-decode-side-prefill claim; kv_push_fallbacks
+                # counts the degradations (failed/incomplete/drifted
+                # transfers served by the cold prefill instead).
+                # Absent on mixed replicas — the default topology's
+                # /metrics is bit-identical to r17.
+                snap["counters"]["generate.kv_push_sent"] = (
+                    engine.kv_push_sent
+                )
+                snap["counters"]["generate.kv_push_send_failures"] = (
+                    engine.kv_push_send_failures
+                )
+                snap["counters"]["generate.kv_push_bytes_sent"] = (
+                    engine.kv_push_bytes_sent
+                )
+                snap["counters"]["generate.kv_push_recv"] = (
+                    engine.kv_push_recv
+                )
+                snap["counters"]["generate.kv_push_recv_failures"] = (
+                    engine.kv_push_recv_failures
+                )
+                snap["counters"]["generate.kv_push_bytes_recv"] = (
+                    engine.kv_push_bytes_recv
+                )
+                snap["counters"]["generate.kv_push_applied"] = (
+                    engine.kv_push_applied
+                )
+                snap["counters"]["generate.kv_push_bytes_applied"] = (
+                    engine.kv_push_bytes_applied
+                )
+                snap["counters"]["generate.kv_push_fallbacks"] = (
+                    engine.kv_push_fallbacks
                 )
         return snap
 

@@ -160,6 +160,26 @@ class BatchRun:
         # joiner) + its consecutive-dispatch stall counter.
         self._pf: dict | None = None
         self._pf_consec = 0
+        # Disaggregation push state (r18): a prefill-role run whose
+        # chunk KV streams to a decode replica as each chunk
+        # finishes. Solo by the collector's compatibility rule, so
+        # the pushed row is always device row 0.
+        self._push: dict | None = None
+        r0 = reqs[0]
+        if (
+            getattr(r0, "push_to", None) is not None
+            and self.b == 1 and not self.p_len
+            and eng.kv_push is not None
+        ):
+            host, port, xfer = r0.push_to
+            cp = eng.prompt_buckets[-1]
+            n_run = (
+                self.bucket // cp
+                if self.bucket > cp and self.bucket % cp == 0
+                else 1
+            )
+            self._push = {"xfer": xfer, "n": n_run, "sent": 0}
+            eng.kv_push.begin(xfer, host, int(port))
         try:
             first = self._prefill()
             self.pos = self.p_len + self.bucket
@@ -171,8 +191,24 @@ class BatchRun:
             self.rows: list = list(range(b))
             self.b_cur = b_pad
             self._first_token(first)
+            if self._push is not None:
+                # Finalize the transfer: the sampled first token (one
+                # synchronous readback — this run IS the prefill, it
+                # ends here) plus the geometry the decode replica
+                # validates. FIFO behind every chunk on the sender
+                # thread, so a fin implies a complete transfer.
+                eng.kv_push.finish(
+                    self._push["xfer"], self._push["n"],
+                    int(np.asarray(self._first)[0]),
+                    self.bucket, reqs[0].used,
+                )
             self.chain = DispatchChain(self._deliver)
         except BaseException:
+            if self._push is not None:
+                # A failed formation must not leave the handler
+                # blocking out its full wait: fail the transfer NOW
+                # (the decode replica will cold-prefill).
+                eng.kv_push.abort(self._push["xfer"])
             # Formation failed (incl. a loud PagePoolExhausted before
             # any dispatch): give every held page back — the wrapper
             # delivers the error to the waiters. write_back matters
@@ -200,6 +236,136 @@ class BatchRun:
             self.eng.brownout_spec_suppressed += 1
         return True
 
+    # -- disaggregation: chunk-boundary KV push (prefill replica) -----
+
+    def _push_boundary(self, lo: int, hi: int) -> None:
+        """The r18 chunk-boundary push hook: gather row 0's freshly
+        written KV slots ``[lo, hi)`` to host (the device→host copy —
+        forced here because the bytes must cross hosts either way)
+        and hand them to the KVPush sender thread. The wire POST
+        never runs on this thread, so a slow decode replica slows the
+        TRANSFER, not the prefill. No-op for every non-push batch —
+        one attribute read."""
+        if self._push is None:
+            return
+        kv: dict = {}
+        if self.pool is not None:
+            page = self.page
+            t0, t1 = lo // page, -(-hi // page)
+            pages = np.asarray(self.tab[0, t0:t1])
+            base = t0 * page
+            from mlapi_tpu.ops.quant import paged_pools_of
+
+            for ln, layer in paged_pools_of(self.cache).items():
+                kv[ln] = {}
+                for name, leaf in layer.items():
+                    # [n, page, ...] gather → [1, n*page, ...] → the
+                    # exact slot slice. Null-page tiles (pad slots the
+                    # page-native row never mapped) contribute
+                    # never-read bytes — masked on the decode side
+                    # exactly as they are here.
+                    a = np.asarray(leaf[pages])
+                    a = a.reshape((1, a.shape[0] * page) + a.shape[2:])
+                    kv[ln][name] = a[:, lo - base:hi - base]
+        else:
+            for ln, layer in self.cache.items():
+                kv[ln] = {
+                    name: np.asarray(leaf[0:1, lo:hi])
+                    for name, leaf in layer.items()
+                }
+        self.eng.kv_push.send_chunk(
+            self._push["xfer"], self._push["sent"], self._push["n"],
+            (lo, hi), kv,
+        )
+        self._push["sent"] += 1
+
+    # -- disaggregation: pushed-KV formation (decode replica) ---------
+
+    def _prefill_pushed(self):
+        """Install a pushed transfer's assembled prompt KV as this
+        (solo) batch's row 0 — ZERO prefill FLOPs on this replica.
+        Paged: the blob goes through the pool's alloc-first donated
+        install (``PagePool.install_blob`` — ``PagePoolExhausted``
+        propagates with nothing installed, the restore_entry
+        ordering) and the pages become a PRIVATE table row; decode
+        pages beyond the prompt allocate at chunk boundaries as
+        usual. Contiguous: one admission-style scatter of the
+        device_put blob into a fresh cache. Returns the ``[B]`` first
+        token vector (the prefill replica sampled it from the final
+        chunk's logits — same program, same key), or ``None`` to fall
+        back to the cold prefill (geometry mismatch; counted)."""
+        eng, r = self.eng, self.reqs[0]
+        pushed = r.pushed
+        if self.pool is not None:
+            from mlapi_tpu.ops.quant import paged_cache_tree
+            from mlapi_tpu.serving.kv_tier import (
+                KVTierBlob,
+                payload_bytes,
+                payload_from_contiguous,
+            )
+
+            payload = payload_from_contiguous(pushed.kv, self.page)
+            blob = KVTierBlob(
+                None, payload, self.page, payload_bytes(payload),
+                pushed.bucket, 0, pushed.used,
+            )
+            pages = self.pool.install_blob(blob)
+            if pages is None:
+                eng.kv_push.count_fallback()
+                _log.debug(
+                    "pushed blob does not match the local pool "
+                    "geometry; cold prefill"
+                )
+                return None
+            self.tab[0, :len(pages)] = pages
+            self.cache = paged_cache_tree(eng.pool.layers, self.tab)
+            self._tab_dirty = False
+        else:
+            import jax
+
+            from mlapi_tpu.models.gpt import admit_scatter_fn
+
+            # Validate the pushed tree against the model's OWN cache
+            # leaves before any device work — the contiguous twin of
+            # install_blob's geometry check. A cross-config peer
+            # (different head dim, kv format) whose bucket/used
+            # happened to match must still degrade to the counted
+            # cold prefill, never a formation error (and never a
+            # silent astype of wrong-format bytes into a live cache).
+            proto = jax.eval_shape(
+                lambda: eng.model.init_cache(1, pushed.bucket)
+            )
+            ok = True
+            for ln, layer in proto.items():
+                pl = pushed.kv.get(ln) if isinstance(pushed.kv, dict) \
+                    else None
+                if pl is None or set(pl) != set(layer):
+                    ok = False
+                    break
+                for name, leaf in layer.items():
+                    a = pl[name]
+                    if a.shape != leaf.shape or a.dtype != leaf.dtype:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if not ok or set(pushed.kv) != set(proto):
+                eng.kv_push.count_fallback()
+                _log.debug(
+                    "pushed blob does not match the local cache "
+                    "format; cold prefill"
+                )
+                return None
+            mini = jax.tree.map(jnp.asarray, pushed.kv)
+            self.cache = admit_scatter_fn()(
+                eng.model.init_cache(self.b_pad, self.total), mini,
+                jnp.int32(0), jnp.int32(0),
+            )
+        eng.kv_push.count_applied(pushed.nbytes)
+        return jnp.asarray(
+            np.full((self.b_pad,), pushed.first_token, np.int32)
+        )
+
     # -- formation ----------------------------------------------------
 
     def _prefill(self):
@@ -209,6 +375,15 @@ class BatchRun:
         bucket, total = self.bucket, self.total
         from mlapi_tpu.models.gpt import prefill_fn, prefix_prefill_fn
 
+        if (
+            getattr(reqs[0], "pushed", None) is not None
+            and self.b == 1 and not self.p_len
+            and eng.kv_push is not None
+        ):
+            first = self._prefill_pushed()
+            if first is not None:
+                return first
+            # Fallback: the cold prefill below — counted above.
         if self.pool is not None:
             return self._prefill_paged()
         if self.p_len:
@@ -261,6 +436,10 @@ class BatchRun:
                     jnp.asarray(self.prompt[:, c0:c0 + cp]),
                     jnp.int32(c0), n_pad_j,
                 )
+                # r18: the finished chunk's KV streams to the decode
+                # replica while the NEXT chunk computes (no-op for
+                # non-push batches).
+                self._push_boundary(c0, c0 + cp)
             first = sample_fn(eng.model)(
                 logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
@@ -272,6 +451,9 @@ class BatchRun:
                 jnp.asarray(self.n_pad), jnp.asarray(self.topk),
                 jnp.asarray(self.topp),
             )
+            # r18: a bucket-sized prompt is one "chunk" — the whole
+            # span pushes at its (single) boundary.
+            self._push_boundary(0, bucket)
         return first
 
     # -- paged formation + page lifecycle ------------------------------
@@ -475,6 +657,8 @@ class BatchRun:
                     jnp.asarray(self.prompt[:, c0:c0 + cp]),
                     jnp.int32(c0), n_pad_j, jnp.int32(0), jnp.int32(0),
                 )
+                # r18 chunk-boundary push (no-op off the disagg path).
+                self._push_boundary(c0, c0 + cp)
             return sample_fn(eng.model)(
                 logits, jnp.asarray(self.keys), jnp.asarray(self.temps),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
@@ -494,6 +678,7 @@ class BatchRun:
                 jnp.asarray(self.temps), jnp.asarray(self.n_pad),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
             )
+            self._push_boundary(0, bucket)  # r18: one-chunk push
             return first
         # Legacy: the bucket-length contiguous prefill (the same
         # program admission warms), adopted into pages — the extra
@@ -511,6 +696,7 @@ class BatchRun:
         self.cache = paged_scatter_fn()(
             self.cache, mini, jnp.asarray(self.tab), jnp.int32(0)
         )
+        self._push_boundary(0, bucket)  # r18: one-chunk push
         return first
 
     def _prefill_paged_prefix(self):
@@ -683,6 +869,13 @@ class BatchRun:
             eng.draft_model is not None
             and b == 1 and self.p_len == 0
             and not reqs[0].cancelled
+            # Disaggregated rows never speculate: a prefill-only run
+            # ends at its first token, and a pushed row's stream must
+            # stay structurally identical to the mixed replica's
+            # chunked decode (greedy spec emits the same tokens, but
+            # the draft replay from a wire-restored cache is a
+            # surface r18 does not need).
+            and reqs[0].push_to is None and reqs[0].pushed is None
             and (
                 (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
                 or (eng.spec_sample and temps[0] > 0.0)
@@ -912,6 +1105,14 @@ class BatchRun:
                 continue
             if cand.cancelled:
                 self._unstage(cand)  # drop silently
+                continue
+            if cand.push_to is not None or cand.pushed is not None:
+                # Disaggregated requests form their own solo batches
+                # (same reason they never group at formation): defer
+                # to the collector's next batch.
+                self._unstage(cand)
+                with eng._alock:
+                    eng._deferred.append(cand)
                 continue
             if self.p_len or cand.prefix_fp is not None:
                 # Prefix rows batch only at FORMATION time (incl.

@@ -149,6 +149,7 @@ class InferenceEngine:
         kv_tier_bytes: int = 0,
         kv_tier_disk_dir: str | None = None,
         kv_peer_fetch: bool = False,
+        replica_role: str = "mixed",
         draft_checkpoint=None,
         spec_sample: bool = False,
         fused_batch: bool | str = "auto",
@@ -360,6 +361,7 @@ class InferenceEngine:
                 kv_tier_bytes=kv_tier_bytes,
                 kv_tier_disk_dir=kv_tier_disk_dir,
                 kv_peer_fetch=kv_peer_fetch,
+                replica_role=replica_role,
                 scheduler=scheduler,
                 sched_max_batches=sched_max_batches,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
@@ -373,6 +375,8 @@ class InferenceEngine:
                          if kv_tier_bytes else {}),
                       **({"kv_peer_fetch": True}
                          if kv_peer_fetch else {}),
+                      **({"replica_role": replica_role}
+                         if replica_role != "mixed" else {}),
                       **({"scheduler": True} if scheduler else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
@@ -394,6 +398,12 @@ class InferenceEngine:
                 "kv_peer_fetch applies to generative checkpoints "
                 f"(they cache prefix KV); {type(inner).__name__} has "
                 f"none"
+            )
+        if replica_role != "mixed":
+            raise ValueError(
+                "replica_role applies to generative checkpoints "
+                f"(they split prefill from decode); "
+                f"{type(inner).__name__} has neither"
             )
         if scheduler:
             raise ValueError(
@@ -631,6 +641,7 @@ class TextGenerationEngine:
         kv_tier_disk_dir: str | None = None,
         kv_peer_fetch: bool = False,
         kv_peer_timeout_s: float = 5.0,
+        replica_role: str = "mixed",
         scheduler: bool = False,
         sched_max_batches: int = 2,
     ):
@@ -837,6 +848,29 @@ class TextGenerationEngine:
             from mlapi_tpu.serving.kv_peer import KVPeer
 
             self.kv_peer = KVPeer(self, timeout_s=kv_peer_timeout_s)
+        # Prefill/decode disaggregation (r18, serving/kv_peer.py):
+        # role-split replicas. A "prefill" replica serves
+        # disaggregated requests as prefill-only runs, pushing each
+        # finished chunk's KV to the decode replica the router named;
+        # a "decode" replica exposes POST /kv/push, stages the chunks,
+        # and its formation installs the assembled blob into a
+        # private table row — zero decode-side prefill FLOPs. "mixed"
+        # (the default): no push state, no endpoint, no role headers
+        # read — bit-identical to r17. The role is a ROUTING
+        # specialization, not a capability fence: either role still
+        # serves a plain /generate end to end (the router's
+        # role-starved fallback ladder depends on that).
+        if replica_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"replica_role must be prefill|decode|mixed, got "
+                f"{replica_role!r}"
+            )
+        self.replica_role = replica_role
+        self.kv_push = None
+        if replica_role != "mixed":
+            from mlapi_tpu.serving.kv_peer import KVPush
+
+            self.kv_push = KVPush(self)
         # Page-native prefill (r10): bucket prefill and admission write
         # K/V straight into pool pages through the page table — the
         # contiguous-then-adopt copy (one full extra write of
@@ -1470,6 +1504,49 @@ class TextGenerationEngine:
     def kv_peer_serve_bytes(self) -> int:
         return self.kv_peer.serve_bytes if self.kv_peer else 0
 
+    # -- disaggregation accounting (state lives in serving/kv_peer.py's
+    # KVPush) — byte counters are exact payload arithmetic (each
+    # chunk's ``span × per-slot kv bytes`` closed form), never
+    # wall-clock; everything 0 on a mixed replica.
+    @property
+    def kv_push_sent(self) -> int:
+        return self.kv_push.push_sent if self.kv_push else 0
+
+    @property
+    def kv_push_send_failures(self) -> int:
+        return self.kv_push.push_send_failures if self.kv_push else 0
+
+    @property
+    def kv_push_bytes_sent(self) -> int:
+        return self.kv_push.push_bytes_sent if self.kv_push else 0
+
+    @property
+    def kv_push_recv(self) -> int:
+        return self.kv_push.push_recv if self.kv_push else 0
+
+    @property
+    def kv_push_recv_failures(self) -> int:
+        return self.kv_push.push_recv_failures if self.kv_push else 0
+
+    @property
+    def kv_push_bytes_recv(self) -> int:
+        return self.kv_push.push_bytes_recv if self.kv_push else 0
+
+    @property
+    def kv_push_applied(self) -> int:
+        """Pushed transfers installed as live decode rows — moving
+        while ``prefix_builds`` AND ``prefill_chunks`` stay flat IS
+        the zero-decode-side-prefill claim."""
+        return self.kv_push.push_applied if self.kv_push else 0
+
+    @property
+    def kv_push_bytes_applied(self) -> int:
+        return self.kv_push.push_bytes_applied if self.kv_push else 0
+
+    @property
+    def kv_push_fallbacks(self) -> int:
+        return self.kv_push.push_fallbacks if self.kv_push else 0
+
     # -- prefix-cache counters (state lives in serving/prefix.py) ---------
     @property
     def prefix_hits(self) -> int:
@@ -1497,7 +1574,8 @@ class TextGenerationEngine:
                 loop, top_k: int = 0, top_p: float = 1.0,
                 prefix: str | None = None,
                 stream: bool = False,
-                deadline_ms: float | None = None) -> GenRequest:
+                deadline_ms: float | None = None,
+                push_to=None, kv_xfer: str | None = None) -> GenRequest:
         entry = None
         raw = None
         if prefix:
@@ -1558,10 +1636,33 @@ class TextGenerationEngine:
         row = np.full((bucket,), self.tokenizer.pad_id, np.int32)
         used = min(len(raw), bucket)
         row[-used:] = raw[-used:]
+        pushed = None
+        if kv_xfer is not None and self.kv_push is not None:
+            # Decode-role arrival naming a pushed transfer: take the
+            # assembled blob (encode executor thread — the host
+            # concat runs here, never on the dispatch thread) and
+            # validate its geometry against what THIS replica's
+            # encode just produced. Anything short of an exact match
+            # — incomplete/failed transfer, bucket/used drift across
+            # configs — is a counted fallback to the cold prefill;
+            # the stream still serves, just without the saved FLOPs.
+            pushed = self.kv_push.take(kv_xfer)
+            if pushed is not None and (
+                pushed.bucket != bucket or pushed.used != used
+                or entry is not None
+            ):
+                _log.debug(
+                    "pushed transfer %s geometry drifted "
+                    "(%d/%d vs local %d/%d); cold prefill",
+                    kv_xfer, pushed.bucket, pushed.used, bucket, used,
+                )
+                pushed = None
+            if pushed is None:
+                self.kv_push.count_fallback()
         return GenRequest(
             row, used, n_new, temperature, seed, loop, top_k, top_p,
             prefix=entry, stream=stream, stats=self.latency,
-            deadline_ms=deadline_ms,
+            deadline_ms=deadline_ms, push_to=push_to, pushed=pushed,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -1622,6 +1723,11 @@ class TextGenerationEngine:
                 len(reqs) == 1
                 and reqs[0].prefix_len == 0 and not reqs[0].stream
                 and not reqs[0].cancelled
+                # Disaggregated requests pin the chunked lifecycle:
+                # a fused whole-generation program has no chunk
+                # boundary to push at, and a pushed-KV row has no
+                # prefill for the fused program to run.
+                and reqs[0].push_to is None and reqs[0].pushed is None
                 and self.fused.try_run(reqs[0], admit)
             ):
                 return None
@@ -1735,6 +1841,14 @@ class TextGenerationEngine:
         unwarmed combinations fall back to same-prefix grouping."""
         if (r.prefix_fp is None) != (group[0].prefix_fp is None):
             return False
+        # Disaggregated requests run SOLO (r18): a prefill-only run
+        # pushes ITS row's chunk KV at each boundary and a pushed-KV
+        # row installs a whole-prompt blob at formation — neither
+        # composes with co-batched rows' shapes yet (batched prefill
+        # handoff is a future optimization, noted in DESIGN §24).
+        for x in (r, group[0]):
+            if x.push_to is not None or x.pushed is not None:
+                return False
         p_len = 0
         if r.prefix_fp is not None:
             p_len = max(r.prefix_len, *(g.prefix_len for g in group))
@@ -2086,10 +2200,20 @@ class TextGenerationEngine:
         prefix: str | None = None,
         stream: bool = False,
         deadline_ms: float | None = None,
+        push_to=None,
+        kv_xfer: str | None = None,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
         sentinel (exceptions are delivered in-band).
+
+        Disaggregation (r18): ``push_to=(host, port, xfer)`` runs the
+        prompt as a PREFILL-ONLY batch (``n_new`` forced to 1 — the
+        run ends at the sampled first token) whose chunk KV streams
+        to the named decode replica; ``kv_xfer=<id>`` resolves a
+        staged pushed transfer so formation installs the prompt KV
+        instead of prefilling. Both default None — the pre-r18 path,
+        bit for bit.
 
         ``deadline_ms`` is the request's end-to-end wall-clock budget
         (engine default when ``None``; see ``default_deadline_ms``).
@@ -2168,8 +2292,15 @@ class TextGenerationEngine:
                 text, n_new, float(temperature), int(seed), loop,
                 int(top_k), float(top_p), prefix=prefix,
                 stream=bool(stream), deadline_ms=deadline_ms,
+                push_to=push_to, kv_xfer=kv_xfer,
             ),
         )
+        if push_to is not None:
+            # Prefill-only AFTER encoding: geometry (bucket/limit) was
+            # computed with the CLIENT's token budget — identical to
+            # what the decode replica computes for the same body — but
+            # this run ends at the sampled first token.
+            req.n_new = 1
         if self.draining or self._task is None or self._task.done():
             # Drain (or a full stop) may have COMPLETED during the
             # encode executor await: this request passed the front-door
@@ -2205,21 +2336,29 @@ class TextGenerationEngine:
         top_p: float = 1.0,
         prefix: str | None = None,
         deadline_ms: float | None = None,
+        push_to=None,
+        kv_xfer: str | None = None,
     ) -> dict:
         """One prompt → generated continuation (text + ids), through
         the same ``_run_batch`` the batcher uses — including its
         batch-1 fused fast path (one XLA program per generation) when
         eligible; pass ``fused_single=False`` at construction to pin
         the chunked programs (e.g. when reproducing a chunked-path
-        decode bug)."""
+        decode bug). ``push_to``/``kv_xfer`` mirror :meth:`submit`'s
+        disaggregation hooks (engine-level tests and drills)."""
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         req = self._encode(
             text, n_new, float(temperature), int(seed), None,
             int(top_k), float(top_p), prefix=prefix,
-            deadline_ms=deadline_ms,
+            deadline_ms=deadline_ms, push_to=push_to, kv_xfer=kv_xfer,
         )
+        if push_to is not None:
+            # Same contract as submit(): encode with the client's
+            # budget (geometry parity with the decode replica), then
+            # run prefill-only.
+            req.n_new = 1
         out_ids: list[int] = []
         sink = _SyncSink(req, out_ids)
         self._run_batch([sink])

@@ -81,6 +81,23 @@ POINTS = (
     # its tier/entries are untouched.
     "peer_fetch",       # before the GET /kv/prefix wire request
     "peer_serve",       # before a peer blob is resolved/serialized
+    # Prefill/decode disaggregation (serving/kv_peer.py KVPush, r18).
+    # Both points fire BEFORE any wire byte moves or any counter
+    # mutates. ``kv_push_send`` fires on the PREFILL replica's push
+    # worker before each chunk's POST — a raise marks the transfer
+    # failed (counted), the remaining chunks are dropped, and the
+    # router's fallback submits the request to the decode replica
+    # WITHOUT the transfer id, which then cold-prefills with
+    # ``kv_pages_in_use`` conserved on both ends (the push path
+    # allocates no pages; pool pages only move at the decode
+    # replica's formation, which the failed transfer never reaches).
+    # ``kv_push_recv`` fires in the decode replica's /kv/push handler
+    # before the body is parsed or staged — a raise 500s the push,
+    # which the sender counts as the same transfer failure. Delays
+    # slow the worker thread / the app executor, never the dispatch
+    # thread.
+    "kv_push_send",     # before a chunk's POST /kv/push leaves the sender
+    "kv_push_recv",     # before a pushed chunk is parsed/staged
 )
 
 ENV_VAR = "MLAPI_FAULTS"

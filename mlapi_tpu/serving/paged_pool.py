@@ -297,6 +297,61 @@ class PagePool:
             " (spilled to host tier)" if self.tier is not None else "",
         )
 
+    def _blob_geometry_ok(self, blob) -> bool:
+        """Does a host blob match this pool's page size and every
+        layer's leaf shapes/dtypes? ONE definition shared by the tier
+        restore and the r18 push install — the two blob-install paths
+        must never diverge on what 'applies here' means."""
+        if blob.page != self.page:
+            return False
+        for ln, layer in self.layers.items():
+            pl = blob.payload.get(ln)
+            if pl is None:
+                return False
+            for name, leaf in layer.items():
+                a = pl.get(name)
+                if (
+                    a is None
+                    or a.shape[1:] != leaf.shape[1:]
+                    or a.dtype != leaf.dtype
+                ):
+                    return False
+        return True
+
+    def _scatter_blob(self, pages, blob, *, fire: str | None,
+                      what: str) -> None:
+        """The shared alloc-first install core: one donated scatter
+        rebinds ``self.layers`` atomically. On ANY failure the pages
+        go back (``kv_pages_in_use`` conserved exactly) — UNLESS the
+        donated scatter failed DURING execution: then the pool
+        buffers are consumed with no result to rebind, and any
+        fallback that reads them dies on deleted buffers (the r12
+        formation-poisoning bug class) — surfaced loudly as
+        :class:`PagePoolPoisoned` instead. The optional fault point
+        fires BEFORE the call on purpose, so injected raises always
+        take the safe branch. Shared by :meth:`restore_entry` and
+        :meth:`install_blob` so a fix to the poisoning detection can
+        never reach one install path and not the other."""
+        import jax.numpy as jnp
+
+        try:
+            if fire is not None:
+                faults.fire(fire)
+            self.layers = _tier_restore_fn()(
+                self.layers, blob.payload, jnp.asarray(pages)
+            )
+        except BaseException as e:
+            self.release(pages)
+            leaf = next(
+                iter(next(iter(self.layers.values())).values())
+            )
+            if getattr(leaf, "is_deleted", lambda: False)():
+                raise PagePoolPoisoned(
+                    f"KV pool consumed by a {what} that failed "
+                    "mid-execution; no fallback may read the pool"
+                ) from e
+            raise
+
     def restore_entry(self, fp, blob, holds: int = 0):
         """Repopulate fresh pool pages from a spilled tier blob and
         register them as ``fp``'s entry page set (with ``holds`` row
@@ -310,54 +365,34 @@ class PagePool:
         page ids, or ``None`` when the blob does not match this
         pool's geometry (dropped from the tier — it can never apply).
         Decode-thread only, like every other pool-array touch."""
-        import jax.numpy as jnp
-
-        if blob.page != self.page:
+        if not self._blob_geometry_ok(blob):
             self.tier.drop(blob.fp)
             return None
-        for ln, layer in self.layers.items():
-            pl = blob.payload.get(ln)
-            if pl is None:
-                self.tier.drop(blob.fp)
-                return None
-            for name, leaf in layer.items():
-                a = pl.get(name)
-                if (
-                    a is None
-                    or a.shape[1:] != leaf.shape[1:]
-                    or a.dtype != leaf.dtype
-                ):
-                    self.tier.drop(blob.fp)
-                    return None
         pages = self.alloc(blob.num_pages)
-        try:
-            faults.fire("tier_restore")
-            self.layers = _tier_restore_fn()(
-                self.layers, blob.payload, jnp.asarray(pages)
-            )
-        except BaseException as e:
-            # Nothing was installed: hand the pages back and let the
-            # caller fall back to the adopt path — ``kv_pages_in_use``
-            # is conserved exactly. UNLESS the donated scatter failed
-            # DURING execution: then the pool buffers are consumed
-            # with no result to rebind, and any fallback that reads
-            # them dies on deleted buffers (the r12 formation-
-            # poisoning bug class) — surface that loudly instead.
-            # The ``tier_restore`` fault point fires BEFORE the call
-            # on purpose, so injected raises always take the safe
-            # branch.
-            self.release(pages)
-            leaf = next(
-                iter(next(iter(self.layers.values())).values())
-            )
-            if getattr(leaf, "is_deleted", lambda: False)():
-                raise PagePoolPoisoned(
-                    "KV pool consumed by a tier restore that failed "
-                    "mid-execution; no fallback may read the pool"
-                ) from e
-            raise
+        self._scatter_blob(
+            pages, blob, fire="tier_restore", what="tier restore"
+        )
         self.put_entry_pages(fp, pages, holds=holds)
         self.tier.count_restore(blob)
+        return pages
+
+    def install_blob(self, blob) -> np.ndarray | None:
+        """Repopulate fresh pool pages from a host blob WITHOUT
+        registering an entry set — the r18 disaggregation install: a
+        pushed prompt's KV becomes a PRIVATE table row (each page at
+        ref 1, writable in place), not a shared prefix entry. Same
+        ordering contract as :meth:`restore_entry` (the shared
+        :meth:`_scatter_blob` core): pages ALLOCATED first, one
+        donated scatter, :class:`PagePoolPoisoned` on mid-execution
+        failure. Returns the page ids (caller assigns them into its
+        row table and owns the release), or ``None`` when the blob
+        does not match this pool's geometry (caller cold-prefills,
+        pages conserved). Decode-thread only, like every other
+        pool-array touch."""
+        if not self._blob_geometry_ok(blob):
+            return None
+        pages = self.alloc(blob.num_pages)
+        self._scatter_blob(pages, blob, fire=None, what="push install")
         return pages
 
     def evict_idle(self, n: int = 1) -> int:

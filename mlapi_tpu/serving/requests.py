@@ -123,12 +123,14 @@ class GenRequest:
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
         "prompt_tokens", "stats", "t0", "t_last", "deadline",
+        "push_to", "pushed",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
                  top_k=0, top_p=1.0, prefix=None, stream=False,
                  stats: LatencyStats | None = None,
-                 deadline_ms: float | None = None):
+                 deadline_ms: float | None = None,
+                 push_to=None, pushed=None):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -161,6 +163,16 @@ class GenRequest:
             self.prefix_len = 0
             self.prefix_lo = 0
             self.prompt_tokens = used
+        # Prefill/decode disaggregation (r18, serving/kv_peer.py).
+        # push_to = (host, port, xfer): this is a PREFILL-ONLY run on
+        # a prefill-role replica — the prompt's KV streams to the
+        # named decode replica chunk by chunk and the request ends at
+        # its first token. pushed = a PushedKV: this request's prompt
+        # KV arrived over the wire — formation installs it instead of
+        # prefilling. Both None (every non-disaggregated request):
+        # bit-identical to the fields never existing.
+        self.push_to = push_to
+        self.pushed = pushed
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
         # Engine latency reservoirs (None for warmup requests): TTFT
@@ -220,6 +232,7 @@ class _SyncSink:
         self.stream = req.stream
         self.stats, self.t0, self.t_last = req.stats, req.t0, None
         self.deadline = req.deadline
+        self.push_to, self.pushed = req.push_to, req.pushed
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False

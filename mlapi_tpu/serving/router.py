@@ -177,13 +177,19 @@ class ReplicaState:
     __slots__ = (
         "host", "port", "name", "state", "queue_depth", "inflight",
         "shed_until", "poll_failures", "last_poll", "healthz",
-        "metrics",
+        "metrics", "role",
     )
 
-    def __init__(self, host: str, port: int, *, assume_live: bool = True):
+    def __init__(self, host: str, port: int, *, assume_live: bool = True,
+                 role: str = "mixed"):
         self.host = host
         self.port = port
         self.name = f"{host}:{port}"
+        # Disaggregation role (r18): "prefill" replicas take the
+        # first hop of role-split generative traffic, "decode"
+        # replicas own the streams; "mixed" (default) serves both —
+        # an all-mixed fleet routes exactly as r17 did.
+        self.role = role
         # assume_live=False (the CLI topology) gates routing on the
         # first successful health poll — a replica still booting its
         # engine never sees traffic; True is the embedded/unit default
@@ -318,14 +324,30 @@ class Router:
         queue_depth_limit: int | None = None,
         assume_live: bool = True,
         rng: random.Random | None = None,
+        roles: list | None = None,
     ):
         if not endpoints:
             raise ValueError("router needs at least one replica endpoint")
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
+        if roles is None:
+            roles = ["mixed"] * len(endpoints)
+        if len(roles) != len(endpoints):
+            raise ValueError("one role per replica endpoint")
+        bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+        if bad:
+            raise ValueError(f"unknown replica roles {bad!r}")
         self.replicas = [
-            ReplicaState(h, p, assume_live=assume_live) for h, p in endpoints
+            ReplicaState(h, p, assume_live=assume_live, role=role)
+            for (h, p), role in zip(endpoints, roles)
         ]
+        # Role-split topology (r18): disaggregate generative traffic
+        # whenever BOTH pools exist. An all-mixed fleet (default) has
+        # neither — routing is bit-identical to r17.
+        self.role_split = any(r == "prefill" for r in roles) and any(
+            r == "decode" for r in roles
+        )
+        self._xfer_seq = 0
         if len({r.name for r in self.replicas}) != len(self.replicas):
             raise ValueError("duplicate replica endpoints")
         self.policy = policy
@@ -346,21 +368,47 @@ class Router:
         self.shed_no_replica = 0
         self.stream_upstream_errors = 0
         self.warm_peer_hints = 0
+        # Disaggregation counters (r18, exported under router.role_*):
+        # disagg_forwards counts two-hop role-split forwards;
+        # fallback_mixed counts role-starved degradations (a pool
+        # down/unroutable ⇒ the request served mixed-style by
+        # whatever is routable); push_incomplete counts handoffs
+        # whose transfer failed mid-push (the decode replica then
+        # cold-prefills — pages conserved on both ends).
+        self.role_disagg_forwards = 0
+        self.role_fallback_mixed = 0
+        self.role_push_incomplete = 0
 
     # -- discovery/keys ---------------------------------------------------
-    def routing_key(self, body: bytes) -> bytes | None:
-        """The affinity key of a ``/generate`` body: the ``prefix``
-        field when present (the shared-prompt cache unit — every
-        request naming it must land where its KV lives), else the
-        prompt ``text``; truncated to the first K bytes. The router
-        tokenizes nothing — raw UTF-8 bytes hash the same on every
-        router process. ``None`` (unparseable body, no text) routes by
-        load only; the replica still owns rejecting the bad body."""
+    @staticmethod
+    def parse_body(body: bytes) -> dict | None:
+        """ONE parse of a ``/generate`` body, shared by the routing
+        key and the disagg gate (the role-split hot path must not pay
+        two full ``json.loads`` of a multi-KB prompt on the event
+        loop). ``None`` for unparseable/non-object bodies — the
+        replica owns rejecting those."""
         try:
             obj = json.loads(body)
         except Exception:
             return None
-        if not isinstance(obj, dict):
+        return obj if isinstance(obj, dict) else None
+
+    def routing_key(self, body: bytes) -> bytes | None:
+        """The affinity key of a ``/generate`` body (convenience
+        wrapper over :meth:`routing_key_of` for callers holding raw
+        bytes)."""
+        return self.routing_key_of(self.parse_body(body))
+
+    def routing_key_of(self, obj: dict | None) -> bytes | None:
+        """The affinity key of a parsed body: the ``prefix`` field
+        when present (the shared-prompt cache unit — every request
+        naming it must land where its KV lives), else the prompt
+        ``text``; truncated to the first K bytes. The router
+        tokenizes nothing — raw UTF-8 bytes hash the same on every
+        router process. ``None`` (unparseable body, no text) routes
+        by load only; the replica still owns rejecting the bad
+        body."""
+        if obj is None:
             return None
         src = obj.get("prefix") or obj.get("text")
         if not isinstance(src, str) or not src:
@@ -368,6 +416,54 @@ class Router:
         return src.encode("utf-8", "surrogatepass")[
             : self.affinity_prefix_bytes
         ]
+
+    def wants_disagg(self, body: bytes) -> bool:
+        """Raw-bytes wrapper over :meth:`wants_disagg_of`."""
+        return self.wants_disagg_of(self.parse_body(body))
+
+    def wants_disagg_of(self, obj: dict | None) -> bool:
+        """Should this parsed ``/generate`` body take the role-split
+        two-hop path? Only in a role-split fleet, and only for plain
+        prompt requests: a ``prefix``-carrying request is the
+        shared-prefix warmth workload the affinity + peer-fetch path
+        (r14/r17) already serves — its suffix prefill is small by
+        construction, so disaggregating it buys nothing and would
+        complicate the prefix-region transfer. Unparseable bodies
+        route normally (the replica owns rejecting them)."""
+        if not self.role_split or obj is None:
+            return False
+        return (
+            isinstance(obj.get("text"), str)
+            and bool(obj.get("text"))
+            and not obj.get("prefix")
+        )
+
+    def _pick_role(
+        self, key: bytes | None, role: str,
+        exclude: ReplicaState | None = None,
+    ) -> ReplicaState | None:
+        """The routable pick inside ONE role pool: HRW by key first
+        (decode replicas keep per-key placement stable across
+        requests — the warmth argument, applied to the role pool),
+        power-of-two-choices otherwise; ``None`` when the pool has no
+        routable member (the caller degrades to mixed routing,
+        counted). Never touches the affinity hit/fallback counters —
+        those describe the r14 single-hop policy."""
+        now = time.monotonic()
+        pool = [
+            r for r in self.replicas
+            if r.role == role and r is not exclude
+            and r.routable(now, self.queue_depth_limit)
+        ]
+        if not pool:
+            return None
+        if key is not None:
+            order = hrw_order(key, [r.name for r in pool])
+            return next(r for r in pool if r.name == order[0])
+        if len(pool) == 1:
+            return pool[0]
+        a, b = self._rng.sample(pool, 2)
+        return a if a.load() <= b.load() else b
 
     # -- the routing decision ---------------------------------------------
     def preferred_for(self, key: bytes | None) -> ReplicaState | None:
@@ -527,7 +623,8 @@ class Router:
         ))
 
     def _build_upstream(self, request: Request, r: ReplicaState,
-                        warm_peer: ReplicaState | None = None) -> bytes:
+                        warm_peer: ReplicaState | None = None,
+                        extra: dict | None = None) -> bytes:
         target = request.scope.get("raw_path") or request.path.encode()
         if isinstance(target, str):  # ASGI test transports pass str
             target = target.encode()
@@ -551,6 +648,12 @@ class Router:
             if k.lower() not in _HOP_HEADERS and k.lower() not in (
                 b"x-mlapi-router-depth",
                 b"x-mlapi-warm-peer",
+                # r18 disaggregation headers are router-authored too:
+                # a client-sent copy could aim a prefill replica's KV
+                # pushes at an arbitrary host or claim a staged
+                # transfer it never produced.
+                b"x-mlapi-decode-peer",
+                b"x-mlapi-kv-xfer",
             ):
                 head += k + b": " + v + b"\r\n"
         head += b"content-length: %d\r\n" % len(request.body)
@@ -565,6 +668,8 @@ class Router:
             # cold-prefilling (--kv-peer-fetch replicas; others
             # ignore the header).
             head += b"x-mlapi-warm-peer: %s\r\n" % warm_peer.name.encode()
+        for k, v in (extra or {}).items():
+            head += b"%s: %s\r\n" % (k.encode(), v.encode())
         head += b"connection: close\r\n\r\n"
         return bytes(head) + request.body
 
@@ -577,7 +682,8 @@ class Router:
         }
 
     async def _attempt(self, r: ReplicaState, request: Request,
-                       warm_peer: ReplicaState | None = None) -> Response:
+                       warm_peer: ReplicaState | None = None,
+                       extra: dict | None = None) -> Response:
         """One forward attempt against one replica. Returns the relay
         response (unary fully read; streams as a relaying iterator).
         Raises :class:`_SubmitError` on pre-commit failures."""
@@ -610,7 +716,9 @@ class Router:
                 ) from None
             submitted = False
             try:
-                writer.write(self._build_upstream(request, r, warm_peer))
+                writer.write(
+                    self._build_upstream(request, r, warm_peer, extra)
+                )
                 await writer.drain()
                 submitted = True
                 status, headers = await _read_response_head(reader)
@@ -800,6 +908,102 @@ class Router:
                         return self._submit_error_response(e2, e1)
             return self._submit_error_response(e1)
 
+    async def forward_disagg(
+        self, request: Request, key: bytes | None
+    ) -> Response:
+        """The role-split two-hop forward (r18): hop 1 sends the
+        request to a PREFILL replica (p2c by load — prompt work is
+        bursty and has no warmth to preserve) naming the HRW-chosen
+        DECODE replica and a fresh transfer id; the prefill replica
+        streams each finished chunk's KV straight to the decode
+        replica and answers with the handoff verdict. Hop 2 forwards
+        the client's request to that decode replica — with the
+        transfer id only when every chunk landed, so the decode
+        replica either installs the pushed KV (zero prefill FLOPs)
+        or cold-prefills, never waits on a wire. The fallback ladder
+        degrades a role-starved fleet to MIXED routing, counted: no
+        routable decode replica ⇒ the plain r14 path over whatever
+        is routable; no routable prefill replica ⇒ the decode
+        replica takes the cold prefill itself."""
+        dec = self._pick_role(key, "decode")
+        if dec is None:
+            # Decode pool down: whatever is routable serves the whole
+            # request, r14-style.
+            self.role_fallback_mixed += 1
+            return await self.forward(request, key)
+        pre = self._pick_role(None, "prefill")
+        if pre is None:
+            # Prefill pool down: a routable replica (the decode pool,
+            # in practice) accepts the cold prefill via the PLAIN
+            # forward — which keeps the failover-once ladder, so a
+            # decode replica dying between the health poll and this
+            # forward still fails over instead of erroring the client
+            # in the already-degraded state.
+            self.role_fallback_mixed += 1
+            return await self.forward(request, key)
+        self.forwarded += 1
+        self.role_disagg_forwards += 1
+        self._xfer_seq += 1
+        xfer = f"xf{self._xfer_seq}-{self._rng.getrandbits(48):012x}"
+        complete = False
+        try:
+            resp = await self._attempt(
+                pre, request,
+                extra={
+                    "x-mlapi-decode-peer": dec.name,
+                    "x-mlapi-kv-xfer": xfer,
+                },
+            )
+            if resp.status != 200:
+                # The prefill replica REJECTED the request itself
+                # (422 and friends): relay — the decode replica would
+                # reject the same body the same way.
+                return resp
+            try:
+                obj = json.loads(resp.body)
+            except Exception:
+                obj = {}
+            if not obj.get("handoff"):
+                # A replica that ignored the role headers (older
+                # build, operator-mislabeled role) served the whole
+                # generation: that IS the answer — relay it.
+                return resp
+            complete = bool(obj.get("complete"))
+        except _SubmitError as e:
+            _log.info(
+                "prefill hop to %s failed (%s); decode replica "
+                "cold-prefills", pre.name, e.detail,
+            )
+        if not complete:
+            self.role_push_incomplete += 1
+        try:
+            return await self._attempt(
+                dec, request,
+                extra={"x-mlapi-kv-xfer": xfer} if complete else None,
+            )
+        except _SubmitError as e1:
+            if e1.retryable:
+                # Failover-once, decode pool first: the pushed KV
+                # died with the target, so the alternate always
+                # cold-prefills (no xfer header).
+                second = self._pick_role(key, "decode", exclude=dec)
+                if second is None:
+                    try:
+                        second = self.choose(key, exclude=dec, count=False)
+                    except NoReplicaAvailable:
+                        second = None
+                if second is not None:
+                    self.failovers += 1
+                    _log.info(
+                        "disagg failover %s -> %s (%s)",
+                        dec.name, second.name, e1.detail,
+                    )
+                    try:
+                        return await self._attempt(second, request)
+                    except _SubmitError as e2:
+                        return self._submit_error_response(e2, e1)
+            return self._submit_error_response(e1)
+
     @staticmethod
     def _submit_error_response(
         e: _SubmitError, prior: _SubmitError | None = None
@@ -841,6 +1045,7 @@ class Router:
                 {
                     "name": r.name,
                     "state": r.state,
+                    **({"role": r.role} if self.role_split else {}),
                     "queue_depth": r.queue_depth,
                     "inflight": r.inflight,
                     "shedding": now < r.shed_until,
@@ -903,6 +1108,18 @@ class Router:
             self.stream_upstream_errors
         )
         counters["router.warm_peer_hints"] = self.warm_peer_hints
+        if self.role_split:
+            # Role-split fleets only: an all-mixed topology's
+            # /metrics stays bit-identical to r17.
+            counters["router.role_disagg_forwards"] = (
+                self.role_disagg_forwards
+            )
+            counters["router.role_fallback_mixed"] = (
+                self.role_fallback_mixed
+            )
+            counters["router.role_push_incomplete"] = (
+                self.role_push_incomplete
+            )
         state_counts = self._state_counts()
         gauges["router.replicas_live"] = state_counts[LIVE]
         gauges["router.replicas_draining"] = state_counts[DRAINING]
@@ -942,9 +1159,15 @@ def build_router_app(router: Router) -> App:
 
     @app.post("/generate")
     async def generate(request: Request):
-        return await router.forward(
-            request, key=router.routing_key(request.body)
-        )
+        obj = router.parse_body(request.body)  # parsed ONCE
+        key = router.routing_key_of(obj)
+        if router.wants_disagg_of(obj):
+            # Role-split fleet + plain prompt: the two-hop
+            # prefill→decode path (r18). Prefix-carrying requests
+            # stay on the affinity path below — their warmth story is
+            # the r14/r17 machinery.
+            return await router.forward_disagg(request, key)
+        return await router.forward(request, key=key)
 
     @app.post("/predict")
     async def predict(request: Request):

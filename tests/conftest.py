@@ -122,8 +122,13 @@ _CACHE_FAMILIES = {
     # {gpt, llama} x {none, int8} engine shapes at page 8 / chunk 2 —
     # peer restores re-drive the programs the tier module compiled;
     # only the wire hop is new, and it compiles nothing.
+    # + the kv_push module (r18): the same CFG again — disaggregated
+    # prefill/decode drive the family's compiled programs at a
+    # (16, 64) bucket ladder (a handful of extra shapes, paid once in
+    # the shared window); the push wire hop compiles nothing.
     "paged-family": frozenset({
         "test_kv_peer",
+        "test_kv_push",
         "test_paged_kv",
         "test_paged_kv_tier",
         "test_scheduler",

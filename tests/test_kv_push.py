@@ -1,0 +1,748 @@
+"""Prefill/decode disaggregation (r18): role-split replicas with
+proactive chunk-granularity KV push (``serving/kv_peer.py``'s
+``KVPush``, ``--replica-role``, ``POST /kv/push``).
+
+The contract, layer by layer — every claim asserted from counters and
+exact byte arithmetic, never wall-clock:
+
+- **Wire format**: the r17 blob framing extended with
+  ``{xfer, chunk, num_chunks, span}`` round-trips byte-identically;
+  every corruption class raises (a counted receive failure, never a
+  staged wrong chunk); the fin message carries the first token and
+  the geometry the decode replica validates.
+- **The engine pair**: a prefill-role engine runs the EXISTING
+  chunked prefill and pushes each finished chunk's KV at its
+  boundary; the decode-role engine assembles the chunks and its
+  formation installs them through the pool's alloc-first donated
+  scatter into a PRIVATE table row — streams are TOKEN-IDENTICAL
+  disaggregated-vs-mixed across {gpt-MHA, llama-GQA} × {none, int8},
+  paged AND contiguous, with the decode side's ``prefix_builds`` AND
+  ``prefill_chunks`` both at ZERO (the zero-decode-side-prefill
+  claim) and the pushed bytes equal to the
+  ``num_pages × kv_page_bytes`` closed form.
+- **Failure discipline**: ``kv_push_send``/``kv_push_recv`` raises
+  degrade to the cold prefill with ``kv_pages_in_use`` conserved on
+  BOTH replicas and streams completing; delays slow, never break;
+  geometry drift between differently-configured replicas is a
+  counted fallback; pool exhaustion during the install propagates
+  loudly with nothing half-installed.
+- **Topology**: the handoff headers and the push endpoint are
+  replica-gated and role-gated; an all-mixed engine/app is
+  bit-identical to r17 (no endpoint, no counters, no role field);
+  the real-socket e2e drives a P=1+D=1 fleet through the role-aware
+  router and pins the two-hop flow end to end, including the
+  role-starved degradation to mixed routing.
+
+Engines reuse the paged family's tiny-model CFG (conftest
+``paged-family``) so the jitted program factories are shared across
+the family instead of compiled again.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.ops.quant import kv_page_bytes
+from mlapi_tpu.serving import faults
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.kv_peer import (
+    deserialize_push,
+    serialize_push_chunk,
+    serialize_push_fin,
+)
+from mlapi_tpu.serving.paged_pool import PagePoolExhausted
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=160,
+    compute_dtype="float32",
+)
+
+
+def _model(kind="gpt_lm", kv_quant="none"):
+    kw = dict(CFG, kv_quant=kv_quant)
+    if kind == "llama_lm":
+        kw["num_kv_heads"] = 2  # GQA: 4 query heads over 2 KV heads
+    return get_model(kind, **kw)
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    return _model().init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return _model("llama_lm").init(jax.random.key(0))
+
+
+def _engine(model, params, role="mixed", **kw):
+    kw.setdefault("chunk", 2)
+    kw.setdefault("fused_single", False)
+    kw.setdefault("kv_page_size", 8)
+    # cp = 64: a 100-token prompt buckets to 128 = TWO prefill chunks,
+    # so the chunk-granularity push is exercised for real (the
+    # family's default (16, 64, 128) buckets would make it one).
+    kw.setdefault("prompt_buckets", (16, 64))
+    return TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(),
+        replica_role=role, **kw,
+    )
+
+
+def _link(pre, dec):
+    """Wire the prefill engine's push transport straight into the
+    decode engine's receive path — the exact serve path (fault points
+    included) without a socket."""
+
+    def transport(host, port, path, body, timeout_s):
+        try:
+            dec.kv_push.receive(body)
+            return 200, b"{}"
+        except ValueError:
+            return 400, b""
+
+    pre.kv_push._transport = transport
+
+
+LONG = "y" * 100   # buckets to 128 = 2 x 64-token prefill chunks
+XFERS = iter(f"xf-test-{i}" for i in range(10_000))
+
+
+async def _wait_for(pred, timeout_s: float = 60.0) -> None:
+    """Condition-based wait (MLA006): the batch's page release runs
+    on the dispatch thread AFTER the terminal frame reaches the
+    client — poll the counter instead of racing it."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while not pred():
+        if loop.time() >= deadline:
+            raise AssertionError(
+                f"condition never became true within {timeout_s}s"
+            )
+        await asyncio.sleep(0.005)
+
+
+def _handoff(pre, dec, text, n_new, **kw):
+    """One disaggregated request through the engine pair: prefill +
+    push on ``pre``, then the stream on ``dec``. Returns (decode
+    output, transfer-complete)."""
+    xfer = next(XFERS)
+    pre.generate_text(
+        text, max_new_tokens=n_new, push_to=("127.0.0.1", 1, xfer), **kw
+    )
+    ok = pre.kv_push.wait_sent(xfer, 30.0)
+    return dec.generate_text(
+        text, max_new_tokens=n_new, kv_xfer=xfer if ok else "absent", **kw
+    ), ok
+
+
+# --- wire format -------------------------------------------------------
+
+
+def test_push_wire_roundtrip_and_validation():
+    rng = np.random.default_rng(0)
+    kv = {
+        "layer_0": {
+            "k": rng.standard_normal((1, 64, 4, 8)).astype(np.float32),
+            "v": rng.standard_normal((1, 64, 4, 8)).astype(np.float32),
+        },
+        "layer_1": {
+            "k_q": rng.integers(-128, 127, (1, 64, 4, 8)).astype(np.int8),
+            "k_scale": rng.standard_normal((1, 64, 4, 1)).astype(
+                np.float32
+            ),
+        },
+    }
+    data = serialize_push_chunk("xf1", 1, 2, (64, 128), kv)
+    out = deserialize_push(data)
+    assert (out["kind"], out["xfer"]) == ("chunk", "xf1")
+    assert (out["chunk"], out["num_chunks"]) == (1, 2)
+    assert out["span"] == (64, 128)
+    for ln, layer in kv.items():
+        for name, a in layer.items():
+            np.testing.assert_array_equal(out["payload"][ln][name], a)
+
+    fin = deserialize_push(serialize_push_fin("xf1", 2, 37, 128, 100))
+    assert fin == {
+        "kind": "fin", "xfer": "xf1", "num_chunks": 2,
+        "first_token": 37, "bucket": 128, "used": 100,
+    }
+
+    # Every corruption class raises (→ a counted receive failure),
+    # never a staged wrong chunk.
+    head_line, _, rest = data.partition(b"\n")
+    head = json.loads(head_line)
+    for bad in (
+        b"garbage with no header",
+        b"{}\n",                                   # missing fields
+        data[: len(data) // 2],                    # truncated payload
+        data + b"x",                               # trailing bytes
+        data.replace(b'"nbytes": ', b'"nbytes": 9', 1),  # total lies
+        serialize_push_fin("xf1", 2, 1, 8, 4) + b"junk",  # fin + tail
+    ):
+        with pytest.raises(ValueError):
+            deserialize_push(bad)
+    for tamper in (
+        {"xfer": ""},
+        {"kind": "nope"},
+        {"chunk": 5},                              # outside num_chunks
+        {"span": [8, 8]},                          # empty span
+        {"leaves": 5},
+        {"num_chunks": 0},
+    ):
+        bad_head = dict(head, **tamper)
+        with pytest.raises(ValueError):
+            deserialize_push(
+                json.dumps(bad_head).encode() + b"\n" + rest
+            )
+    # Leaf shape must be [1, span, ...] with positive dims (a
+    # negative dim would defeat the truncation check — the
+    # deserialize_blob lesson applied here).
+    bad_head = dict(head)
+    bad_head["leaves"] = [["layer_0", "k", [1, 64, -4, 8], "<f4"]] + head[
+        "leaves"
+    ][1:]
+    with pytest.raises(ValueError):
+        deserialize_push(json.dumps(bad_head).encode() + b"\n" + rest)
+
+
+# --- the acceptance matrix: disaggregated == mixed ---------------------
+
+
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+@pytest.mark.parametrize("kind", ["gpt_lm", "llama_lm"])
+def test_disagg_stream_identity(kind, fmt, gpt_params, llama_params):
+    """THE acceptance pin: a prompt prefilled on the prefill replica
+    and decoded on the decode replica streams TOKEN-IDENTICAL to a
+    mixed replica serving it alone — with decode-side prefill FLOPs
+    exactly ZERO (``prefix_builds == 0`` AND ``prefill_chunks == 0``,
+    ``kv_push_applied == 1``) and the pushed bytes equal to the
+    ``num_pages × kv_page_bytes`` closed form. Both cache formats,
+    MHA and GQA; the 128-slot prompt pushes as TWO 64-token chunks
+    (the r10/r15 chunk seam, not one blob)."""
+    params = gpt_params if kind == "gpt_lm" else llama_params
+    model = _model(kind, fmt)
+    mixed = _engine(model, params)
+    pre = _engine(model, params, role="prefill")
+    dec = _engine(model, params, role="decode")
+    _link(pre, dec)
+
+    ref = mixed.generate_text(LONG, max_new_tokens=8)
+    out, ok = _handoff(pre, dec, LONG, 8)
+    assert ok
+    assert out["token_ids"] == ref["token_ids"]
+    # Zero decode-side prefill FLOPs, from counters.
+    assert dec.prefix.builds == 0
+    assert dec.prefill_chunks == 0
+    assert dec.kv_push_applied == 1
+    # Chunk granularity + the exact closed form on BOTH ends: the
+    # 128-slot bucket is 16 pages of 8 slots.
+    assert pre.kv_push.push_sent == 2
+    closed = 16 * kv_page_bytes(model, 8)
+    assert pre.kv_push_bytes_sent == closed
+    assert dec.kv_push_bytes_recv == closed
+    assert dec.kv_push_bytes_applied == closed
+    # The prefill side ran ITS chunked prefill (the existing seam).
+    assert pre.prefill_chunks == 2
+    # Pages conserved everywhere once the streams finish.
+    assert pre.kv_pages_in_use == 0 and dec.kv_pages_in_use == 0
+    assert dec.kv_push.staged_count == 0
+
+
+def test_disagg_contiguous_engines(gpt_params):
+    """The same identity on CONTIGUOUS engines: the pushed blob
+    installs via the admission scatter instead of pool pages."""
+    model = _model()
+    mixed = _engine(model, gpt_params, kv_page_size=None)
+    pre = _engine(model, gpt_params, role="prefill", kv_page_size=None)
+    dec = _engine(model, gpt_params, role="decode", kv_page_size=None)
+    _link(pre, dec)
+    ref = mixed.generate_text(LONG, max_new_tokens=8)
+    out, ok = _handoff(pre, dec, LONG, 8)
+    assert ok and out["token_ids"] == ref["token_ids"]
+    assert dec.prefill_chunks == 0 and dec.kv_push_applied == 1
+    assert pre.kv_push.push_sent == 2
+
+
+def test_disagg_short_prompt_single_chunk(gpt_params):
+    """A bucket-sized prompt is one chunk: one push, same identity,
+    and sampled (seeded) requests ride the same contract — the
+    prefill replica's first token came from the same sample program
+    at the same key/step."""
+    model = _model()
+    mixed = _engine(model, gpt_params)
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    _link(pre, dec)
+    ref = mixed.generate_text(
+        "hi there", max_new_tokens=6, temperature=0.8, seed=11
+    )
+    out, ok = _handoff(
+        pre, dec, "hi there", 6, temperature=0.8, seed=11
+    )
+    assert ok and out["token_ids"] == ref["token_ids"]
+    assert pre.kv_push.push_sent == 1
+    assert dec.kv_push_applied == 1 and dec.prefill_chunks == 0
+
+
+def test_mixed_default_is_inert(gpt_params):
+    """The default (all-mixed) engine carries NO push state: the
+    flag's absence is bit-identical to r17."""
+    eng = _engine(_model(), gpt_params)
+    assert eng.kv_push is None and eng.replica_role == "mixed"
+    assert eng.kv_push_applied == 0 and eng.kv_push_bytes_sent == 0
+    out = eng.generate_text(LONG, max_new_tokens=6)
+    assert len(out["token_ids"]) == 6
+
+
+# --- failure discipline ------------------------------------------------
+
+
+def test_send_fault_degrades_cold_pages_conserved(gpt_params):
+    """``kv_push_send`` raise: the transfer fails, the remaining
+    chunks are dropped, and the decode replica serves the stream by
+    the COLD prefill — pages conserved on both replicas, counted."""
+    model = _model()
+    mixed = _engine(model, gpt_params)
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    _link(pre, dec)
+    ref = mixed.generate_text(LONG, max_new_tokens=8)
+    with faults.active("kv_push_send:raise"):
+        out, ok = _handoff(pre, dec, LONG, 8)
+    assert not ok
+    assert out["token_ids"] == ref["token_ids"]
+    assert pre.kv_push_send_failures == 1
+    assert pre.kv_push.push_sent == 0          # first chunk died
+    assert dec.kv_push_applied == 0
+    assert dec.kv_push_fallbacks == 1          # cold path, counted
+    assert dec.prefill_chunks == 2             # the cold prefill ran
+    assert pre.kv_pages_in_use == 0 and dec.kv_pages_in_use == 0
+
+
+def test_recv_fault_degrades_cold_pages_conserved(gpt_params):
+    """``kv_push_recv`` raise: the decode replica's intake 500s (the
+    sender counts the transfer failure) — same cold-prefill
+    degradation, pages conserved on both ends."""
+    model = _model()
+    mixed = _engine(model, gpt_params)
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+
+    def transport(host, port, path, body, timeout_s):
+        try:
+            dec.kv_push.receive(body)
+            return 200, b"{}"
+        except ValueError:
+            return 400, b""
+        except faults.InjectedFault:
+            return 500, b""   # what the real endpoint's 500 looks like
+
+    pre.kv_push._transport = transport
+    ref = mixed.generate_text(LONG, max_new_tokens=8)
+    with faults.active("kv_push_recv:raise"):
+        out, ok = _handoff(pre, dec, LONG, 8)
+    assert not ok
+    assert out["token_ids"] == ref["token_ids"]
+    assert pre.kv_push_send_failures == 1
+    assert dec.kv_push_applied == 0 and dec.kv_push_fallbacks == 1
+    assert dec.prefill_chunks == 2
+    assert pre.kv_pages_in_use == 0 and dec.kv_pages_in_use == 0
+
+
+def test_push_delays_slow_never_break(gpt_params):
+    model = _model()
+    mixed = _engine(model, gpt_params)
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    _link(pre, dec)
+    ref = mixed.generate_text(LONG, max_new_tokens=8)
+    with faults.active(
+        "kv_push_send:every=1:delay=0.01,kv_push_recv:every=1:delay=0.01"
+    ):
+        out, ok = _handoff(pre, dec, LONG, 8)
+        assert faults.injected_count() >= 2
+    assert ok and out["token_ids"] == ref["token_ids"]
+    assert dec.kv_push_applied == 1
+
+
+def test_geometry_drift_falls_back_cold(gpt_params):
+    """A prefill replica running a different bucket config pushes a
+    transfer whose geometry the decode replica's own encode cannot
+    reproduce: a counted fallback to the cold prefill, stream still
+    correct."""
+    model = _model()
+    pre = _engine(model, gpt_params, role="prefill")
+    # REAL config drift, not corruption: a 20-token prompt buckets to
+    # 64 on the prefill side's (16, 64) ladder but to 32 on the
+    # decode side's (32, 64) one.
+    dec = _engine(
+        model, gpt_params, role="decode", prompt_buckets=(32, 64),
+    )
+    _link(pre, dec)
+    text = "z" * 20
+    ref = _engine(model, gpt_params, prompt_buckets=(32, 64)).generate_text(
+        text, max_new_tokens=6
+    )
+    xfer = next(XFERS)
+    pre.generate_text(
+        text, max_new_tokens=6, push_to=("127.0.0.1", 1, xfer)
+    )
+    assert pre.kv_push.wait_sent(xfer, 30.0)
+    out = dec.generate_text(text, max_new_tokens=6, kv_xfer=xfer)
+    assert out["token_ids"] == ref["token_ids"]
+    assert dec.kv_push_applied == 0
+    assert dec.kv_push_fallbacks == 1
+    assert dec.kv_pages_in_use == 0
+
+
+def test_format_drift_contiguous_falls_back(gpt_params):
+    """A peer running a DIFFERENT cache format (int8 vs none) pushes
+    a transfer whose bucket/used happen to match — the contiguous
+    install must still validate the tree against the local model's
+    own cache leaves and degrade to the counted cold prefill, never
+    a formation error (or a silent astype of wrong-format bytes)."""
+    pre = _engine(
+        _model(kv_quant="int8"),
+        _model(kv_quant="int8").init(jax.random.key(0)),
+        role="prefill", kv_page_size=None,
+    )
+    dec = _engine(_model(), gpt_params, role="decode", kv_page_size=None)
+    _link(pre, dec)
+    ref = _engine(_model(), gpt_params, kv_page_size=None).generate_text(
+        LONG, max_new_tokens=6
+    )
+    xfer = next(XFERS)
+    pre.generate_text(LONG, max_new_tokens=6,
+                      push_to=("127.0.0.1", 1, xfer))
+    assert pre.kv_push.wait_sent(xfer, 30.0)
+    out = dec.generate_text(LONG, max_new_tokens=6, kv_xfer=xfer)
+    assert out["token_ids"] == ref["token_ids"]
+    assert dec.kv_push_applied == 0
+    assert dec.kv_push_fallbacks == 1
+
+
+def test_unknown_or_incomplete_transfer_falls_back(gpt_params):
+    """Naming a transfer that never arrived (or only partially
+    arrived) is a counted fallback, never a hang or an error."""
+    model = _model()
+    dec = _engine(model, gpt_params, role="decode")
+    ref = _engine(model, gpt_params).generate_text(
+        LONG, max_new_tokens=6
+    )
+    out = dec.generate_text(LONG, max_new_tokens=6, kv_xfer="no-such")
+    assert out["token_ids"] == ref["token_ids"]
+    assert dec.kv_push_fallbacks == 1 and dec.kv_push_applied == 0
+    # Partial: one chunk staged, no fin.
+    kv = {
+        "layer_0": {"k": np.zeros((1, 64, 4, 8), np.float32)},
+    }
+    dec.kv_push.receive(serialize_push_chunk("part", 0, 2, (0, 64), kv))
+    out = dec.generate_text(LONG, max_new_tokens=6, kv_xfer="part")
+    assert out["token_ids"] == ref["token_ids"]
+    assert dec.kv_push_fallbacks == 2
+
+
+def test_pool_exhaustion_mid_install_loud(gpt_params):
+    """Pool pressure while a pushed transfer installs: the alloc-first
+    ordering propagates ``PagePoolExhausted`` loudly with NOTHING
+    half-installed, and the replica serves once pressure lifts."""
+    model = _model()
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    _link(pre, dec)
+    ref = _engine(model, gpt_params).generate_text(LONG, max_new_tokens=6)
+    xfer = next(XFERS)
+    pre.generate_text(LONG, max_new_tokens=6,
+                      push_to=("127.0.0.1", 1, xfer))
+    assert pre.kv_push.wait_sent(xfer, 30.0)
+    free = dec.kv_pages_total - dec.kv_pages_in_use
+    hold = dec.pool.alloc(free - 4)   # < the 16 pages the blob needs
+    with pytest.raises(PagePoolExhausted):
+        dec.generate_text(LONG, max_new_tokens=6, kv_xfer=xfer)
+    assert dec.kv_pages_in_use == len(hold)   # nothing half-installed
+    dec.pool.release(hold)
+    out = dec.generate_text(LONG, max_new_tokens=6)
+    assert out["token_ids"] == ref["token_ids"]
+
+
+def test_staging_store_is_bounded(gpt_params):
+    """A remote peer cannot pin unbounded host RAM: the staging store
+    LRU-evicts past its cap."""
+    dec = _engine(_model(), gpt_params, role="decode")
+    kv = {"layer_0": {"k": np.zeros((1, 8, 4, 8), np.float32)}}
+    cap = dec.kv_push._STAGE_CAP
+    for i in range(cap + 8):
+        dec.kv_push.receive(
+            serialize_push_chunk(f"spam-{i}", 0, 2, (0, 8), kv)
+        )
+    assert dec.kv_push.staged_count <= cap
+
+
+# --- the replica surface (headers, endpoint, role gating) ---------------
+
+
+async def _asgi_client(app):
+    import httpx
+
+    await app.startup()
+    transport = httpx.ASGITransport(app=app)
+    return httpx.AsyncClient(transport=transport, base_url="http://t")
+
+
+async def test_handoff_endpoint_and_push_intake(gpt_params, monkeypatch):
+    """The app surface end to end over ASGI: the prefill replica's
+    /generate answers a handoff verdict for role-headed requests, the
+    decode replica's /kv/push stages chunks (400 on garbage), and the
+    decode replica's /generate with the transfer header streams
+    token-identical to mixed with zero local prefill."""
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    model = _model()
+    mixed = _engine(model, gpt_params)
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    ref = mixed.generate_text(LONG, max_new_tokens=6)
+
+    app_d = build_app(dec)
+    cl_d = await _asgi_client(app_d)
+    app_p = build_app(pre)
+    cl_p = await _asgi_client(app_p)
+
+    # Route the prefill engine's pushes through the REAL endpoint.
+    loop = asyncio.get_running_loop()
+
+    def transport(host, port, path, body, timeout_s):
+        fut = asyncio.run_coroutine_threadsafe(
+            cl_d.post(path, content=body), loop
+        )
+        r = fut.result(timeout_s)
+        return r.status_code, r.content
+
+    pre.kv_push._transport = transport
+    try:
+        body = {"text": LONG, "max_new_tokens": 6}
+        r = await cl_p.post(
+            "/generate", json=body,
+            headers={
+                "x-mlapi-decode-peer": "127.0.0.1:1",
+                "x-mlapi-kv-xfer": "app-x1",
+            },
+        )
+        assert r.status_code == 200
+        hand = r.json()
+        assert hand["handoff"] is True and hand["complete"] is True
+        assert hand["first_token"] == ref["token_ids"][0]
+        assert dec.kv_push_recv == 2    # both chunks landed via HTTP
+
+        r = await cl_d.post(
+            "/generate", json=body,
+            headers={"x-mlapi-kv-xfer": "app-x1"},
+        )
+        assert r.status_code == 200
+        assert r.json()["token_ids"] == ref["token_ids"]
+        assert dec.prefill_chunks == 0 and dec.kv_push_applied == 1
+
+        # Garbage intake: 400, counted, sender-visible.
+        r = await cl_d.post("/kv/push", content=b"not a push")
+        assert r.status_code == 400
+        assert dec.kv_push_recv_failures == 1
+
+        # /metrics exports the full push block on both roles.
+        snap = (await cl_d.get("/metrics")).json()
+        c = snap["counters"]
+        assert c["generate.kv_push_applied"] == 1
+        assert c["generate.kv_push_recv"] == 2
+        assert c["generate.kv_push_recv_failures"] == 1
+        snap = (await cl_p.get("/metrics")).json()
+        assert snap["counters"]["generate.kv_push_sent"] == 2
+        assert snap["counters"]["generate.kv_push_bytes_sent"] > 0
+        # /healthz names the role on role-carrying replicas.
+        assert (await cl_p.get("/healthz")).json()["role"] == "prefill"
+        assert (await cl_d.get("/healthz")).json()["role"] == "decode"
+    finally:
+        await cl_p.aclose()
+        await app_p.shutdown()
+        await cl_d.aclose()
+        await app_d.shutdown()
+
+
+async def test_mixed_app_has_no_push_surface(gpt_params, monkeypatch):
+    """Default topology (mixed role): no /kv/push route, no
+    generate.kv_push_* counters, no healthz role field, and the
+    disaggregation headers are ignored — bit-identical to r17."""
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    eng = _engine(_model(), gpt_params)
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        assert (await cl.post("/kv/push", content=b"x")).status_code == 404
+        r = await cl.post(
+            "/generate",
+            json={"text": "hi", "max_new_tokens": 2},
+            headers={
+                "x-mlapi-decode-peer": "10.0.0.9:1",
+                "x-mlapi-kv-xfer": "spoof",
+            },
+        )
+        assert r.status_code == 200
+        assert "token_ids" in r.json()      # served normally, no handoff
+        snap = (await cl.get("/metrics")).json()
+        assert not any(
+            k.startswith("generate.kv_push") for k in snap["counters"]
+        )
+        assert "role" not in (await cl.get("/healthz")).json()
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_push_endpoint_absent_off_replica(gpt_params, monkeypatch):
+    """A decode-role server OUTSIDE a router fleet does not expose
+    the push intake (no trusted pusher exists there)."""
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.delenv("MLAPI_TPU_REPLICA", raising=False)
+    monkeypatch.delenv("MLAPI_TPU_REPLICAS", raising=False)
+    eng = _engine(_model(), gpt_params, role="decode")
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        assert (await cl.post("/kv/push", content=b"x")).status_code == 404
+        # And the transfer header is ignored: served cold, counted
+        # nothing (the scan is replica-gated).
+        r = await cl.post(
+            "/generate",
+            json={"text": "hi", "max_new_tokens": 2},
+            headers={"x-mlapi-kv-xfer": "spoof"},
+        )
+        assert r.status_code == 200
+        assert eng.kv_push_fallbacks == 0
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+# --- the role-aware router e2e -----------------------------------------
+
+
+async def test_role_split_fleet_e2e(gpt_params, monkeypatch):
+    """The tentpole e2e, real sockets end to end: a P=1 prefill +
+    D=1 decode fleet behind the role-aware router serves a plain
+    long-prompt /generate through the TWO-HOP path — stream identical
+    to a direct mixed engine, decode-side prefill FLOPs zero, router
+    counters moving — and degrades to mixed routing (cold prefill on
+    the decode replica, counted) when the prefill pool goes away."""
+    import httpx
+
+    from mlapi_tpu.serving import build_app
+    from mlapi_tpu.serving.router import Router, build_router_app
+    from mlapi_tpu.serving.server import Server
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    model = _model()
+    pre = _engine(model, gpt_params, role="prefill")
+    dec = _engine(model, gpt_params, role="decode")
+    ref = _engine(model, gpt_params).generate_text(LONG, max_new_tokens=6)
+
+    servers = []
+    for eng in (pre, dec):
+        srv = Server(
+            build_app(eng, admission_control=False),
+            host="127.0.0.1", port=0,
+        )
+        await srv.start()
+        servers.append(srv)
+    router = Router(
+        [("127.0.0.1", s.port) for s in servers],
+        roles=["prefill", "decode"],
+        health_poll_s=0.05,
+    )
+    front = Server(build_router_app(router), host="127.0.0.1", port=0)
+    await front.start()
+    try:
+        assert router.role_split
+        url = f"http://127.0.0.1:{front.port}/generate"
+        payload = {"text": LONG, "max_new_tokens": 6}
+        async with httpx.AsyncClient(timeout=120.0) as c:
+            r = await c.post(url, json=payload)
+            assert r.status_code == 200
+            assert r.json()["token_ids"] == ref["token_ids"]
+            # Two-hop verdict, from counters on every party.
+            assert router.role_disagg_forwards == 1
+            assert router.role_push_incomplete == 0
+            assert pre.kv_push.push_sent == 2
+            assert dec.kv_push_applied == 1
+            assert dec.prefill_chunks == 0 and dec.prefix.builds == 0
+            await _wait_for(
+                lambda: pre.kv_pages_in_use == 0
+                and dec.kv_pages_in_use == 0
+            )
+
+            # Streaming relays through the same two-hop path.
+            async with c.stream(
+                "POST", url, json=dict(payload, stream=True)
+            ) as resp:
+                assert resp.status_code == 200
+                lines = [ln async for ln in resp.aiter_lines() if ln]
+            frames = [json.loads(ln) for ln in lines]
+            ids: list = []
+            for f in frames[:-1]:
+                ids.extend(f["token_ids"])
+            assert frames[-1]["done"] is True
+            assert frames[-1]["token_ids"] == ref["token_ids"]
+            assert dec.kv_push_applied == 2
+
+            # Aggregated /metrics sums the push counters fleet-wide.
+            snap = (
+                await c.get(f"http://127.0.0.1:{front.port}/metrics")
+            ).json()
+            assert snap["counters"]["generate.kv_push_sent"] == 4
+            assert snap["counters"]["generate.kv_push_applied"] == 2
+            assert snap["counters"]["router.role_disagg_forwards"] == 2
+
+            # Role-starved fallback: the prefill pool drains away —
+            # the decode replica accepts the cold prefill, counted.
+            await pre.drain(0.05)
+            for _ in range(200):
+                await asyncio.sleep(0.05)
+                if router.replicas[0].state == "draining":
+                    break
+            assert router.replicas[0].state == "draining"
+            r = await c.post(url, json=payload)
+            assert r.status_code == 200
+            assert r.json()["token_ids"] == ref["token_ids"]
+            assert router.role_fallback_mixed >= 1
+            assert dec.prefill_chunks == 2      # the cold prefill ran
+    finally:
+        await front.stop()
+        await router.stop()
+        for s in servers:
+            await s.stop()

@@ -749,6 +749,11 @@ _ENGINE_POINTS = tuple(
         # at either point degrades to the cold prefill with pages
         # conserved and streams completing.
         "peer_fetch", "peer_serve",
+        # The disaggregation push seams (crossed only on role-split
+        # replicas) have their matrix in test_kv_push.py: a raise at
+        # either point fails the transfer and the decode replica
+        # cold-prefills with kv_pages_in_use conserved on both ends.
+        "kv_push_send", "kv_push_recv",
     )
 )
 

@@ -288,6 +288,81 @@ def test_build_upstream_stamps_and_strips_warm_peer():
     assert b"x-mlapi-warm-peer" not in head2
 
 
+def test_role_pools_and_disagg_gate():
+    """Role-split units (r18): wants_disagg fires only in a
+    role-split fleet for plain prompt bodies (prefix-carrying and
+    unparseable bodies stay on the affinity path); _pick_role picks
+    inside one pool by HRW (key) or load (None) and returns None for
+    a starved pool; an all-mixed router has no role surface at all —
+    bit-identical to r17."""
+    mixed = _router(3)
+    assert not mixed.role_split
+    assert not mixed.wants_disagg(json.dumps({"text": "hi"}).encode())
+
+    router = Router(
+        [("127.0.0.1", 9000 + i) for i in range(4)],
+        roles=["prefill", "prefill", "decode", "decode"],
+    )
+    assert router.role_split
+    assert router.wants_disagg(json.dumps({"text": "hi"}).encode())
+    assert not router.wants_disagg(
+        json.dumps({"text": "hi", "prefix": "sys"}).encode()
+    )
+    assert not router.wants_disagg(b"not json")
+    assert not router.wants_disagg(json.dumps({"text": ""}).encode())
+
+    key = b"some prompt"
+    dec = router._pick_role(key, "decode")
+    assert dec is not None and dec.role == "decode"
+    # HRW stability: same key, same decode pick, every time.
+    assert router._pick_role(key, "decode") is dec
+    pre = router._pick_role(None, "prefill")
+    assert pre is not None and pre.role == "prefill"
+    # A starved pool returns None (the forward degrades to mixed
+    # routing, counted) — never a member of the other pool.
+    for r in router.replicas:
+        if r.role == "prefill":
+            r.state = DOWN
+    assert router._pick_role(None, "prefill") is None
+    assert router._pick_role(key, "decode") is not None
+
+    # Role validation is loud.
+    with pytest.raises(ValueError):
+        Router([("h", 1)], roles=["imaginary"])
+    with pytest.raises(ValueError):
+        Router([("h", 1), ("h", 2)], roles=["mixed"])
+
+
+def test_build_upstream_stamps_and_strips_disagg_headers():
+    """The r18 headers ride the same anti-spoof contract as
+    warm-peer: client-sent copies are stripped (they could aim a
+    replica's pushes at an arbitrary host or claim a foreign
+    transfer), router-authored extras appear exactly once."""
+    router = _router(2)
+    target = router.replicas[0]
+    req = _plain_request(
+        headers=[
+            (b"x-mlapi-decode-peer", b"evil.example:9"),
+            (b"x-mlapi-kv-xfer", b"stolen"),
+        ]
+    )
+    head = router._build_upstream(
+        req, target, None,
+        {"x-mlapi-decode-peer": "10.0.0.2:8001", "x-mlapi-kv-xfer": "xf1"},
+    ).split(b"\r\n\r\n")[0].lower()
+    assert head.count(b"x-mlapi-decode-peer") == 1
+    assert b"x-mlapi-decode-peer: 10.0.0.2:8001" in head
+    assert head.count(b"x-mlapi-kv-xfer") == 1
+    assert b"x-mlapi-kv-xfer: xf1" in head
+    assert b"evil.example" not in head and b"stolen" not in head
+    # No extras: both headers absent entirely.
+    head2 = router._build_upstream(req, target, None).split(
+        b"\r\n\r\n"
+    )[0].lower()
+    assert b"x-mlapi-decode-peer" not in head2
+    assert b"x-mlapi-kv-xfer" not in head2
+
+
 def test_routing_key_prefers_prefix_field_and_truncates():
     router = _router(2, affinity_prefix_bytes=8)
     body = json.dumps(

@@ -107,6 +107,25 @@ async def _collect(req):
         out.extend(item["token_ids"])
 
 
+async def _wait_for(pred, timeout_s: float = 60.0,
+                    interval_s: float = 0.005) -> None:
+    """CONDITION-based wait (the MLA006 discipline): poll a counter/
+    state predicate under a generous deadline instead of a tuned
+    iteration budget. The old ``for _ in range(200): ...sleep(0.01)``
+    shape was a hidden 2 s wall-clock assertion — on this drifting
+    box (documented ±25-30% and worse) it flaked whenever the
+    condition was merely LATE, not wrong. Raises loudly on timeout so
+    a genuinely-stuck condition still fails."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while not pred():
+        if loop.time() >= deadline:
+            raise AssertionError(
+                f"condition never became true within {timeout_s}s"
+            )
+        await asyncio.sleep(interval_s)
+
+
 # Two groups the collector can NEVER window together: max(bucket) +
 # max(n_new) = 128 + 34 > 160 = max_positions, while each alone fits.
 _SHORT = ("hello world", 34)      # 16-bucket, long budget (> 32
@@ -160,7 +179,11 @@ async def test_two_incompatible_groups_interleave(gpt_params):
                     _SHORT[1] // eng.chunk + _LONG[1] // eng.chunk
                 ) - 2
                 assert eng.sched_units_prefill >= 2  # one formation each
-            assert eng.kv_pages_in_use == 0
+            # The lane's page release runs on the dispatch thread
+            # AFTER the terminal frame is pushed — wait for the
+            # condition instead of racing it (the flake this module
+            # carried since r15).
+            await _wait_for(lambda: eng.kv_pages_in_use == 0)
         finally:
             await eng.stop()
     # Greedy streams byte-identical, scheduler-on vs off.
@@ -178,18 +201,9 @@ async def test_scheduler_queue_feeds_queue_depth(gpt_params):
             _SHORT[0], max_new_tokens=30, stream=True
         )
         # Wait until the blocker is laned, then park a second group.
-        for _ in range(200):
-            if eng.sched_batches_live == 1:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched_batches_live == 1)
         pend = await eng.submit(_LONG[0], max_new_tokens=8, stream=True)
-        seen = 0
-        for _ in range(200):
-            seen = max(seen, eng.queue_depth)
-            if seen:
-                break
-            await asyncio.sleep(0.005)
-        assert seen >= 1  # the pending group counted
+        await _wait_for(lambda: eng.queue_depth >= 1)
         assert (await _collect(blocker))[1] is None
         assert (await _collect(pend))[1] is None
     finally:
@@ -263,13 +277,13 @@ async def test_pending_groups_start_in_deadline_slack_order(gpt_params):
         # Slow every decode chunk so the blocker provably outlives
         # both submissions — the ordering claim must not race the
         # blocker's completion (the counters stay the assert; the
-        # delay only holds the lane slot open).
-        faults.arm("decode:every=1:delay=0.02")
+        # delay only holds the lane slot open). 0.05 x 20 chunks = a
+        # 1 s floor: the r17-documented flake was this floor sitting
+        # at 0.4 s while a drifting box took longer than that just to
+        # run the two submits' encode hops.
+        faults.arm("decode:every=1:delay=0.05")
         blocker = await eng.submit("hold", max_new_tokens=40, stream=True)
-        for _ in range(200):
-            if eng.sched_batches_live == 1:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched_batches_live == 1)
         # A first (loose deadline), then B (tighter deadline): pure
         # slack comparison, reservoir-independent — FIFO would run A
         # first, slack priority runs B. (A deadline-LESS group is
@@ -284,11 +298,7 @@ async def test_pending_groups_start_in_deadline_slack_order(gpt_params):
             _LONG[0], max_new_tokens=8, stream=True, deadline_ms=60000.0
         )
         # Both groups pending BEFORE the blocker's lane can free.
-        for _ in range(400):
-            if eng.sched.backlog >= 2:
-                break
-            await asyncio.sleep(0.005)
-        assert eng.sched.backlog >= 2
+        await _wait_for(lambda: eng.sched.backlog >= 2)
         results = await asyncio.gather(
             _collect(blocker), tagged(ra, "A"), tagged(rb, "B")
         )
@@ -321,10 +331,7 @@ async def test_deadline_expiry_at_unit_boundaries(gpt_params):
         ) >= 1
         faults.disarm()
         # The lane died cleanly: pages conserved, engine serves on.
-        for _ in range(200):
-            if eng.sched.idle:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched.idle)
         assert eng.kv_pages_in_use == 0
         fresh = await eng.submit("after", max_new_tokens=4)
         toks, err = await _collect(fresh)
@@ -351,7 +358,9 @@ async def test_sched_unit_raise_kills_one_lane_only(gpt_params):
             _collect(ra), _collect(rb)
         )
         assert ea is None and eb is None
-        assert eng.kv_pages_in_use == 0
+        # Same dispatch-thread release race as the flagship test:
+        # wait for the condition, don't race it.
+        await _wait_for(lambda: eng.kv_pages_in_use == 0)
         # Fault a mid-run unit: both lanes formed (units 1-2), the
         # raise lands on one lane's decode/admit unit.
         faults.arm("sched_unit:after=6:raise")
@@ -368,10 +377,7 @@ async def test_sched_unit_raise_kills_one_lane_only(gpt_params):
         else:
             assert tb == ref_b
         faults.disarm()
-        for _ in range(200):
-            if eng.sched.idle:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched.idle)
         assert eng.kv_pages_in_use == 0  # refcounts conserved
         fresh = await eng.submit("after", max_new_tokens=4)
         toks, err = await _collect(fresh)
@@ -397,10 +403,7 @@ async def test_sched_unit_raise_before_first_unit_conserves_pages(
         toks, err = await _collect(req)
         assert isinstance(err, faults.InjectedFault)
         faults.disarm()
-        for _ in range(200):
-            if eng.sched.idle:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched.idle)
         assert eng.kv_pages_in_use == 0  # the formation's pages back
         fresh = await eng.submit("after", max_new_tokens=4)
         toks, err = await _collect(fresh)
@@ -422,7 +425,7 @@ async def test_sched_unit_delay_slows_never_breaks(gpt_params):
         assert ea is None and eb is None
         assert len(ta) == _SHORT[1] and len(tb) == _LONG[1]
         assert eng.faults_injected > 0
-        assert eng.kv_pages_in_use == 0
+        await _wait_for(lambda: eng.kv_pages_in_use == 0)
     finally:
         faults.disarm()
         await eng.stop()
@@ -446,10 +449,7 @@ async def test_page_budget_defers_second_lane(gpt_params):
     await eng.start()
     try:
         ra = await eng.submit("hold", max_new_tokens=30, stream=True)
-        for _ in range(200):
-            if eng.sched_batches_live == 1:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched_batches_live == 1)
         rb = await eng.submit("bbbb", max_new_tokens=64, stream=True)
         (ta, ea), (tb, eb) = await asyncio.gather(
             _collect(ra), _collect(rb)
@@ -457,7 +457,7 @@ async def test_page_budget_defers_second_lane(gpt_params):
         assert ea is None and eb is None
         assert len(ta) == 30 and len(tb) == 64
         assert eng.sched_pages_deferred >= 1
-        assert eng.kv_pages_in_use == 0
+        await _wait_for(lambda: eng.kv_pages_in_use == 0)
     finally:
         await eng.stop()
 
@@ -474,20 +474,17 @@ async def test_drain_covers_scheduler_queue(gpt_params):
     try:
         # Slowed decode chunks keep the blocker's lane provably alive
         # past the drain budget — the sweep claim must not race its
-        # natural completion.
-        faults.arm("decode:every=1:delay=0.02")
+        # natural completion (0.05 x 30 chunks = a 1.5 s floor; the
+        # 0.02 floor flaked on this drifting box when the submits +
+        # backlog wait ran past 0.6 s and the blocker finished first,
+        # letting the pending group lane and complete naturally).
+        faults.arm("decode:every=1:delay=0.05")
         blocker = await eng.submit(
             _SHORT[0], max_new_tokens=60, stream=True
         )
-        for _ in range(200):
-            if eng.sched_batches_live == 1:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched_batches_live == 1)
         pend = await eng.submit(_LONG[0], max_new_tokens=8, stream=True)
-        for _ in range(400):
-            if eng.sched.backlog >= 1:
-                break
-            await asyncio.sleep(0.005)
+        await _wait_for(lambda: eng.sched.backlog >= 1)
         gather = asyncio.gather(_collect(blocker), _collect(pend))
         await eng.drain(0.05)  # budget too small: sweep fires
         (tb, ebk), (tp, ep) = await gather
@@ -496,10 +493,7 @@ async def test_drain_covers_scheduler_queue(gpt_params):
         assert ep is None or isinstance(ep, DrainCancelled)
         # The pending group can never have been laned after the sweep.
         assert isinstance(ep, DrainCancelled)
-        for _ in range(200):
-            if eng.sched.idle:
-                break
-            await asyncio.sleep(0.01)
+        await _wait_for(lambda: eng.sched.idle)
         assert eng.sched.idle
         assert eng.kv_pages_in_use == 0
     finally:
@@ -638,11 +632,8 @@ async def test_scheduler_churn_soak(gpt_params):
             for toks, err in results:
                 assert err is None, err
                 assert toks
-            for _ in range(200):
-                if eng.sched.idle:
-                    break
-                await asyncio.sleep(0.01)
-            assert eng.kv_pages_in_use == 0, round_i
+            await _wait_for(lambda: eng.sched.idle)
+            await _wait_for(lambda: eng.kv_pages_in_use == 0)
         assert eng.sched_batches_live_max >= 2
     finally:
         await eng.stop()

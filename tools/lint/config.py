@@ -78,6 +78,20 @@ LOCK_REGISTRY: dict[str, LockSpec] = {
             "fetch_failures", "serve_count", "serve_bytes",
         }),
     ),
+    # r18 disaggregation push state: chunk sends enqueue from the
+    # dispatch thread, the sender thread posts and counts, receives
+    # land on the app executor, applied/fallback counts come from the
+    # dispatch thread AND encode executors — all /metrics-scraped,
+    # all lost-update-prone.
+    "KVPush": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            "_xfers", "_staged", "_staged_bytes", "_sendq", "_worker",
+            "push_sent", "push_send_failures", "push_bytes_sent",
+            "push_recv", "push_recv_failures", "push_bytes_recv",
+            "push_applied", "push_bytes_applied", "push_fallbacks",
+        }),
+    ),
     "LatencyStats": LockSpec(
         locks=frozenset({"_lock"}),
         attrs=frozenset({"_ttft_ms", "_itl_ms"}),
