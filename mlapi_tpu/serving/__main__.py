@@ -398,8 +398,17 @@ def _supervise_router(ckpt: str | None, args) -> int:
             except (NotImplementedError, RuntimeError):
                 pass
 
+        # fork+exec through the executor: this loop IS the router's
+        # serving loop, and Popen blocks the calling thread for the
+        # whole spawn (mlapi-lint MLA008, caught r19). Startup has no
+        # traffic yet, but the respawn loop below shares the shape —
+        # one helper, both sites off the loop.
+        def _spawn(i: int):
+            return subprocess.Popen(cmds[i][0], env=cmds[i][1])
+
         children: list = [
-            subprocess.Popen(cmd, env=env) for cmd, env in cmds
+            await loop.run_in_executor(None, _spawn, i)
+            for i in range(len(cmds))
         ]
         spawned_at = [time.time()] * len(children)
         restart_at = [0.0] * len(children)
@@ -427,8 +436,10 @@ def _supervise_router(ckpt: str | None, args) -> int:
                         restart_at[i] = time.time() + backoff[i]
                         children[i] = None
                     elif c is None and time.time() >= restart_at[i]:
-                        children[i] = subprocess.Popen(
-                            cmds[i][0], env=cmds[i][1]
+                        # Respawn happens MID-TRAFFIC: the fork+exec
+                        # must not stall in-flight relays (MLA008).
+                        children[i] = await loop.run_in_executor(
+                            None, _spawn, i
                         )
                         spawned_at[i] = time.time()
 

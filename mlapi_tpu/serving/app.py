@@ -1087,8 +1087,14 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             # Deterministic per-slot KV bytes at the default
             # bucket/tier (addressable_shards nbytes) — the committed
             # int8-KV number; kv_quant itself rides /healthz meta.
+            # Warmup precomputes it, but a scrape that arrives FIRST
+            # would build a largest-bucket cache on-device — that
+            # fence goes through the executor, never the event loop
+            # (mlapi-lint MLA008, caught r19).
             snap["gauges"]["generate.kv_cache_bytes_per_slot"] = (
-                engine.kv_cache_slot_bytes()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, engine.kv_cache_slot_bytes
+                )
             )
             # Modeled HBM read per decode step for the ACTIVE (cache
             # format, decode impl) pair — the production-observable

@@ -1585,7 +1585,7 @@ class TextGenerationEngine:
                 # placeholder behind the prefix — serve the prefix
                 # alone through the plain path instead (identical
                 # output by the pinned equivalence).
-                self.prefix.fallbacks += 1
+                self.prefix.count_fallback()
                 text = prefix + text
                 raw = None  # re-tokenize the concatenation below
             else:
@@ -2259,7 +2259,16 @@ class TextGenerationEngine:
             # the host tier attached the eviction SPILLS instead of
             # discarding (PagePool._spill_and_release), so the brownout
             # trades HBM for host RAM, not for a future re-prefill.
-            self.pool.evict_idle(1)
+            # Through the executor: the spill is a device gather plus
+            # (disk tier) an npz write — run inline it would freeze
+            # every stream on the loop for exactly as long as the
+            # server is under the pressure that triggered it
+            # (mlapi-lint MLA008, caught r19 — the r13 review moved
+            # this work outside the pool LOCK; off the LOOP is the
+            # other half).
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.pool.evict_idle, 1
+            )
         if (
             self.admission_control
             and deadline_ms is not None

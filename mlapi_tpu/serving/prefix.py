@@ -72,6 +72,14 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
+    def count_fallback(self) -> None:
+        """A prefix request served through the plain path instead of
+        the KV path (empty suffix, unstackable entry): counted under
+        the lock — callers run on concurrent encode executor threads
+        (mlapi-lint MLA002, caught r19)."""
+        with self._lock:
+            self.fallbacks += 1
+
     def entry(self, text: str) -> _PrefixEntry:
         """Return (computing on first use, LRU-cached after) the KV
         cache of a shared prompt prefix. The forward pass over the
@@ -187,7 +195,12 @@ class PrefixCache:
 
         eng = self.eng
         ids, bucket, _ = self._plan(text)
-        self.builds += 1
+        with self._lock:
+            # Concurrent builds of DIFFERENT prefixes run on separate
+            # encode executor threads; a bare += here lost updates on
+            # the counter the zero-prefill-FLOPs claims are pinned
+            # against (mlapi-lint MLA002, caught r19).
+            self.builds += 1
         row = np.full((1, bucket), eng.tokenizer.pad_id, np.int32)
         row[0, -len(ids):] = ids
         lo = bucket - len(ids)
@@ -438,7 +451,11 @@ class PrefixCache:
                     jnp.asarray(np.ones((bsz,), np.int32)), zk, op,
                     jnp.int32(p), lo_vec,
                 )
-        self.mix_warmed.add(p)
+        with self._lock:
+            # Registration threads warm concurrently; the formation
+            # path reads membership from the dispatch thread
+            # (mlapi-lint MLA002, caught r19).
+            self.mix_warmed.add(p)
 
     def paged_entry(self, fp, kv, holds: int):
         """Pool-page residency for a prefix entry (paged engines):

@@ -126,9 +126,13 @@ _CACHE_FAMILIES = {
     # prefill/decode drive the family's compiled programs at a
     # (16, 64) bucket ladder (a handful of extra shapes, paid once in
     # the shared window); the push wire hop compiles nothing.
+    # + the lock-witness module (r19): identical CFG once more — the
+    # armed smoke re-drives the family's compiled prefix/scheduler
+    # programs with wrapped locks; wrapping compiles nothing.
     "paged-family": frozenset({
         "test_kv_peer",
         "test_kv_push",
+        "test_lock_witness",
         "test_paged_kv",
         "test_paged_kv_tier",
         "test_scheduler",
@@ -190,3 +194,46 @@ def mesh_1x4():
     from mlapi_tpu.parallel import create_mesh
 
     return create_mesh((1, 4), devices=_jax.devices()[:4])
+
+
+def _armed_witness():
+    """One arming protocol for both witness fixtures: install the
+    runtime lock-order witness (tools/lint/witness.py, the dynamic
+    half of MLA007), yield it, uninstall, and FAIL on any recorded
+    order inversion against the committed lockorder.json (or
+    hold-budget breach when MLAPI_LOCK_WITNESS_BUDGET_S is set)."""
+    import sys
+
+    root = str(os.path.dirname(os.path.dirname(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.lint.witness import LockWitness, install
+
+    w = LockWitness.from_artifact()
+    uninstall = install(w)
+    try:
+        yield w
+    finally:
+        uninstall()
+    assert not w.violations, "\n".join(w.violations)
+
+
+@pytest.fixture
+def lock_witness():
+    """Opt-in per-test witness: every registered serving lock
+    constructed inside the fixture's scope records per-thread
+    acquisition stacks; teardown fails the test on violations. Arm
+    it suite-wide instead with MLAPI_LOCK_WITNESS=1."""
+    yield from _armed_witness()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_env():
+    """MLAPI_LOCK_WITNESS=1 arms the witness for the WHOLE session:
+    every engine any test builds runs wrapped, and the session fails
+    at teardown on any recorded violation. Off (the default), this
+    fixture is a no-op — zero cost, nothing imported."""
+    if os.environ.get("MLAPI_LOCK_WITNESS") != "1":
+        yield
+        return
+    yield from _armed_witness()

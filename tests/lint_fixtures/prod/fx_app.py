@@ -1,11 +1,13 @@
 """MLA005 fixture export surface: the snapshot-store shapes the rule
-extracts exported names from. Exports exactly ``generate.requests``
-and ``generate.queue_depth`` — anything else scraped or documented in
-the fixture set is drift."""
+extracts exported names from. Exports exactly ``generate.requests``,
+``generate.queue_depth``, and ``generate.kv_pages_in_use`` (the
+MLA009 fixture scrapes the last) — anything else scraped or
+documented in the fixture set is drift."""
 
 
 async def metrics():
     snap = {"counters": {}, "gauges": {}}
     snap["counters"]["generate.requests"] = 1
     snap["gauges"]["generate.queue_depth"] = 2
+    snap["gauges"]["generate.kv_pages_in_use"] = 3
     return snap

@@ -1,0 +1,39 @@
+"""MLA007 fixture: a two-class lock-order cycle — the deadlock shape
+the rule exists to refuse. ``KVTier.register`` holds the tier lock
+and calls into the pool (tier-before-pool); ``PagePool.evict`` holds
+the pool lock and calls back into the tier (pool-before-tier). Two
+threads taking one path each deadlock under load. The rule emits ONE
+finding per cycle, anchored at the first edge's first site — the
+call under ``KVTier._lock``."""
+
+import threading
+
+
+class KVTier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = PagePool()
+
+    def register(self, fp):
+        with self._lock:
+            self.pool.drop_entry(fp)  # EXPECT(MLA007)
+
+
+class PagePool:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tier = KVTier()
+
+    def drop_entry(self, fp):
+        with self.lock:
+            pass
+
+    def evict(self, fp):
+        with self.lock:
+            self.tier.register(fp)  # the reverse order: the cycle
+
+    def safe_evict(self, fp):
+        # The fix pattern: claim under the lock, call outside it.
+        with self.lock:
+            victim = fp
+        self.tier.register(victim)  # no lock held: no edge, clean

@@ -1,0 +1,55 @@
+"""MLA008 fixture: the r13 spill-under-brownout shape — blocking
+device/disk work REACHABLE on the event loop through two sync hops
+the single-function rules can't see — plus the direct blocking call,
+the propagated jax fence, and every documented clean shape (the
+executor hop, thread-target workers)."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+
+
+class SpillPool:
+    """Sync on purpose: only CONTEXT makes its work wrong."""
+
+    def evict_idle(self):
+        self._spill()
+
+    def _spill(self):
+        # Blocked on the loop via submit() -> evict_idle() -> here.
+        np.savez("/tmp/blob.npz", x=1)  # EXPECT(MLA008)
+
+
+def fence(x):
+    import jax
+
+    jax.block_until_ready(x)  # EXPECT(MLA008): reached from metrics()
+
+
+class Server:
+    def __init__(self):
+        self.spool = SpillPool()
+
+    async def submit(self, text):
+        time.sleep(0.01)  # EXPECT(MLA008): directly on the loop
+        self.spool.evict_idle()  # seeds the chain flagged above
+        # The documented hop: the SAME work through the executor is
+        # clean (the callee is seeded worker, never loop-propagated).
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.spool.evict_idle
+        )
+
+    async def metrics(self):
+        return fence(None)
+
+
+def worker_loop(spool):
+    # Thread-target context: blocking off the loop is the job.
+    time.sleep(0.1)
+    spool.evict_idle()
+
+
+def start(spool):
+    threading.Thread(target=worker_loop, args=(spool,)).start()

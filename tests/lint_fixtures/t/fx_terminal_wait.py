@@ -1,0 +1,63 @@
+"""MLA009 fixture: the r17/r18 flake shape — a release-settled
+counter asserted straight after the stream's terminal read — next to
+every blessed wait shape (the `_wait_for` condition wait, the inline
+deadline poll, engine stop, and the sync drive where no race
+exists)."""
+
+import asyncio
+
+
+async def _collect(req):
+    out = []
+    while True:
+        item = await req.queue.get()
+        if item is None:
+            return out
+        out.extend(item["token_ids"])
+
+
+async def _wait_for(pred):
+    while not pred():
+        await asyncio.sleep(0.005)
+
+
+async def test_flaky_assert_after_terminal(eng):
+    r = await eng.submit("x", stream=True)
+    await _collect(r)
+    assert eng.kv_pages_in_use == 0  # EXPECT(MLA009)
+
+
+async def test_flaky_metrics_scrape_after_gather(eng):
+    a = await eng.submit("x", stream=True)
+    b = await eng.submit("y", stream=True)
+    outs = await asyncio.gather(_collect(a), _collect(b))
+    g = eng.metrics()["gauges"]
+    assert outs and g["generate.kv_pages_in_use"] == 0  # EXPECT(MLA009)
+
+
+async def test_condition_wait_is_clean(eng):
+    r = await eng.submit("x", stream=True)
+    await _collect(r)
+    await _wait_for(lambda: eng.kv_pages_in_use == 0)
+    assert eng.kv_pages_in_use == 0
+
+
+async def test_inline_poll_is_clean(eng):
+    r = await eng.submit("x", stream=True)
+    await _collect(r)
+    while eng.kv_pages_in_use != 0:
+        await asyncio.sleep(0.005)
+    assert eng.kv_pages_in_use == 0
+
+
+async def test_stop_joins_the_dispatch_thread(eng):
+    r = await eng.submit("x", stream=True)
+    await _collect(r)
+    await eng.stop()
+    assert eng.kv_pages_in_use == 0
+
+
+def test_sync_drive_never_races(eng):
+    # generate_text returns after cleanup: nothing to wait on.
+    eng.generate_text("x")
+    assert eng.kv_pages_in_use == 0

@@ -1,0 +1,262 @@
+"""Runtime lock-order witness (tools/lint/witness.py) — the dynamic
+half of MLA007.
+
+Three layers:
+
+- **Mechanics** (pure stdlib, no jax): proxy-wrapped locks record
+  per-thread acquisition stacks; a declared-order nesting passes, the
+  INVERSION of a committed lockorder.json edge is recorded as a
+  violation (the negative test proving the witness and the static
+  rule enforce the SAME order), Condition waits split hold spans, and
+  the opt-in hold budget flags a lock held past it.
+- **Armed smoke** (the tier-1 leg): one paged+tier+scheduler engine
+  churns real traffic — prefix registrations past the dict-LRU cap
+  (the ``drop_entry``-under-``PrefixCache._lock`` edge), concurrent
+  bucket-incompatible scheduler lanes — with every registered lock
+  wrapped. Passes iff NO inversion was recorded and every observed
+  (held, acquired) class pair is inside the static graph's closure:
+  an edge the analyzer cannot see fails here until the analyzer (or
+  the binding registry) is taught it. That is the static/dynamic
+  cross-check the artifact exists for.
+- Module sits in the conftest ``paged-family`` cache window (same
+  tiny CFG as test_paged_kv/tier/scheduler) so its compiles are
+  already paid.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from tools.lint.witness import (  # noqa: E402
+    LockWitness,
+    WitnessLock,
+    install,
+    load_order,
+    wrap_instance,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+class _Toy:
+    """Stand-in lock-bearing class for mechanics tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+
+def _wrapped(witness, cls_name, lock_names=("_lock",)):
+    t = _Toy()
+    wrap_instance(witness, t, cls_name, lock_names)
+    return t
+
+
+# --- mechanics ---------------------------------------------------------
+
+
+def test_declared_order_passes_and_is_observed():
+    w = LockWitness({("PrefixCache", "PagePool")})
+    a = _wrapped(w, "PrefixCache")
+    b = _wrapped(w, "PagePool", ("lock",))
+    with a._lock:
+        with b.lock:
+            pass
+    assert w.violations == []
+    assert ("PrefixCache", "PagePool") in w.observed_edges
+
+
+def test_inversion_of_committed_order_is_flagged():
+    """The deliberately-inverted nesting: the committed artifact
+    orders PrefixCache before PagePool (the ``drop_entry`` edge), so
+    taking PagePool first and PrefixCache inside it must fail — the
+    runtime witness enforcing exactly what MLA007 proved statically."""
+    order = load_order()
+    assert ("PrefixCache", "PagePool") in order, (
+        "committed lockorder.json lost its PrefixCache->PagePool "
+        "edge; regenerate with python -m tools.lint --lockorder-out"
+    )
+    w = LockWitness(order)
+    pool = _wrapped(w, "PagePool", ("lock",))
+    prefix = _wrapped(w, "PrefixCache")
+    with pool.lock:
+        with prefix._lock:
+            pass
+    assert len(w.violations) == 1
+    assert "order inversion" in w.violations[0]
+    assert "PrefixCache" in w.violations[0]
+
+
+def test_same_class_nesting_carries_no_order():
+    """Two INSTANCES of one class nest without findings — class-level
+    pairs carry no order (the witness cannot and does not invent
+    one)."""
+    w = LockWitness({("PrefixCache", "PagePool")})
+    a = _wrapped(w, "PagePool", ("lock",))
+    b = _wrapped(w, "PagePool", ("lock",))
+    with a.lock:
+        with b.lock:
+            pass
+    assert w.violations == []
+    assert w.observed_edges == set()
+
+
+def test_hold_budget_flags_convoy():
+    w = LockWitness(set(), hold_budget_s=0.01)
+    t = _wrapped(w, "KVTier")
+    with t._lock:
+        time.sleep(0.05)
+    assert len(w.violations) == 1
+    assert "hold-span budget" in w.violations[0]
+
+
+def test_condition_wait_splits_hold_span():
+    """Condition.wait releases the lock — the witness must not charge
+    the wait to the hold span (the whole point of waits is NOT
+    holding)."""
+    w = LockWitness(set(), hold_budget_s=0.04)
+    t = _Toy()
+    wrap_instance(w, t, "PagePool", ("_lock", "_cond"))
+    done = threading.Event()
+
+    def waker():
+        done.wait(5.0)
+        with t._cond:
+            t._cond.notify_all()
+
+    thr = threading.Thread(target=waker, daemon=True)
+    thr.start()
+    with t._cond:
+        done.set()
+        t._cond.wait(timeout=1.0)  # released while waiting
+    thr.join(5.0)
+    assert w.violations == [], w.violations
+
+
+def test_witness_tolerates_unseen_release():
+    """A release the witness never saw acquired (the init-window
+    mixed-Condition path) must not corrupt the stack."""
+    w = LockWitness(set())
+    t = _Toy()
+    proxy = WitnessLock(w, "KVTier", t._lock)
+    t._lock.acquire()      # raw acquire, unrecorded
+    proxy.release()        # recorded release with no record: tolerated
+    assert w.violations == []
+    with proxy:
+        pass
+    assert w.violations == []
+
+
+# --- armed smoke: static order vs dynamic order ------------------------
+
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=160,
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    from mlapi_tpu.models import get_model
+
+    return get_model("gpt_lm", **CFG)
+
+
+@pytest.fixture(scope="module")
+def gpt_params(gpt_model):
+    return gpt_model.init(jax.random.key(0))
+
+
+async def _collect(req):
+    out = []
+    while True:
+        item = await req.queue.get()
+        if isinstance(item, Exception):
+            return out, item
+        if item is None:
+            return out, None
+        out.extend(item["token_ids"])
+
+
+async def test_armed_paged_tier_scheduler_smoke(gpt_model, gpt_params):
+    """One churn over the full lock surface with the witness armed:
+    prefix registrations past the LRU cap take the
+    PrefixCache->PagePool edge for real, scheduler lanes and tier
+    traffic take everything else. Zero inversions, and the OBSERVED
+    edge set is a subset of the static closure — the two halves of
+    MLA007 checking each other."""
+    from mlapi_tpu.serving.engine import TextGenerationEngine
+    from mlapi_tpu.text import ByteTokenizer
+
+    w = LockWitness.from_artifact()
+    uninstall = install(w)
+    try:
+        eng = TextGenerationEngine(
+            gpt_model, gpt_params, tokenizer=ByteTokenizer(),
+            chunk=2, fused_single=False, kv_page_size=8,
+            kv_tier_bytes=1 << 24, scheduler=True,
+            sched_max_batches=2, max_wait_ms=0.0,
+        )
+        # Tight entry cap: the THIRD distinct prefix evicts the first
+        # inside ``entry()``'s registration block — ``drop_entry``
+        # (pool lock) under ``PrefixCache._lock``, the committed
+        # static edge, taken live.
+        eng.prefix.max_entries = 2
+        prefixes = ["alpha " * 4, "bravo " * 4, "charlie " * 4]
+        for p in prefixes:
+            out = eng.generate_text("go", max_new_tokens=4, prefix=p)
+            assert out["token_ids"]
+        assert eng.prefix.builds == 3
+        # Scheduler churn: two bucket-incompatible groups advance as
+        # concurrent lanes on the dispatch thread while the event
+        # loop streams — the cross-thread traffic the witness exists
+        # to observe.
+        await eng.start()
+        try:
+            r1 = await eng.submit(
+                "hello world", max_new_tokens=24, stream=True
+            )
+            r2 = await eng.submit("y" * 70, max_new_tokens=6)
+            outs = [await _collect(r1), await _collect(r2)]
+            assert all(err is None for _, err in outs)
+        finally:
+            await eng.stop()
+    finally:
+        uninstall()
+    assert w.violations == [], "\n".join(w.violations)
+    static = load_order()
+    unknown = w.observed_edges - static
+    assert not unknown, (
+        f"runtime took lock orders the static analyzer cannot see: "
+        f"{sorted(unknown)} — teach tools/lint/rules/lockorder.py (or "
+        f"the binding registry) and regenerate lockorder.json"
+    )
+    assert ("PrefixCache", "PagePool") in w.observed_edges, (
+        "the smoke no longer exercises the committed "
+        "PrefixCache->PagePool edge — it must, or the cross-check "
+        "is vacuous"
+    )
+
+
+# Staleness of the committed artifact vs a fresh static build is
+# pinned byte-for-byte in test_static_analysis.py
+# (test_lockorder_artifact_roundtrip) — not re-checked here.

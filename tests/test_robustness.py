@@ -596,7 +596,16 @@ async def test_admission_install_fault_spares_running_batch():
         _, berr = await _collect(b)
         assert isinstance(berr, faults.InjectedFault), berr
         # Pre-admission refcount restored WHILE the batch still runs
-        # (the joiner's freshly-mapped page went back).
+        # (the joiner's freshly-mapped page went back). The release
+        # runs on the decode thread AFTER b's terminal frame — wait
+        # on the counter instead of racing it (mlapi-lint MLA009,
+        # caught r19: the same class as the r17/r18 hand-de-flakes).
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while eng.kv_pages_in_use != pre:
+            assert (
+                asyncio.get_running_loop().time() < deadline
+            ), eng.kv_pages_in_use
+            await asyncio.sleep(0.005)
         assert eng.kv_pages_in_use == pre
         toks, aerr = await _collect(a)
         assert aerr is None

@@ -5,9 +5,13 @@ Three layers, mirroring what the linter promises:
 - **Clean tree.** ``run_rules`` over the real repo config reports
   zero unsuppressed findings — the invariants the rules encode
   (donation discipline, lock discipline, seam ordering/coverage,
-  router purity, metrics consistency, test hygiene) HOLD on the
-  current tree, and any PR that breaks one fails here with a
-  ``file:line``.
+  router purity, metrics consistency, test hygiene, and the r19
+  concurrency layer: lock-order acyclicity, thread-context
+  placement, terminal-frame wait discipline) HOLD on the current
+  tree, and any PR that breaks one fails here with a ``file:line``.
+  The MLA007 artifact (``tools/lint/lockorder.json``) is
+  additionally pinned byte-for-byte — the runtime witness loads it,
+  so staleness would enforce a stale order.
 - **Fixtures.** Each rule is negative-tested against a minimal repro
   of the historical bug it mechanizes (``tests/lint_fixtures/``,
   one module per rule). The contract is exact: the finding set must
@@ -119,7 +123,8 @@ def test_fixtures_flag_exactly_the_marked_lines():
     assert not extra, f"rules over-flagged (false positives): {sorted(extra)}"
     # Every rule has at least one fixture repro.
     assert {r for (r, _, _) in got} == {
-        "MLA001", "MLA002", "MLA003", "MLA004", "MLA005", "MLA006"
+        "MLA001", "MLA002", "MLA003", "MLA004", "MLA005", "MLA006",
+        "MLA007", "MLA008", "MLA009",
     }
     # Both suppression paths were exercised: the inline allow and the
     # baseline entry each swallowed one fx_locks violation.
@@ -160,18 +165,77 @@ def test_baseline_requires_justification(tmp_path):
         raise AssertionError("justification-less entry was accepted")
 
 
+# --- MLA007 artifact ---------------------------------------------------
+
+
+def test_lockorder_artifact_roundtrip():
+    """The committed tools/lint/lockorder.json IS the freshly
+    recomputed graph — byte-for-byte. A PR that changes lock scopes
+    without regenerating the artifact fails here (the runtime
+    witness loads this file as the allowed order, so a stale file
+    would enforce a stale order)."""
+    from tools.lint.rules.lockorder import render_artifact
+
+    cfg = Config()
+    proj = load_project(cfg)
+    committed = (REPO_ROOT / cfg.lockorder_artifact).read_text()
+    assert committed == render_artifact(proj, cfg), (
+        "lockorder.json is stale — regenerate: python -m tools.lint "
+        "--lockorder-out tools/lint/lockorder.json"
+    )
+
+
+def test_lockorder_artifact_is_cycle_free_with_total_order():
+    import json
+
+    from tools.lint.rules.lockorder import find_cycles
+
+    doc = json.loads(
+        (REPO_ROOT / Config().lockorder_artifact).read_text()
+    )
+    edges = {(e["before"], e["after"]) for e in doc["edges"]}
+    assert find_cycles(edges) == []
+    # Acyclic graphs always emit a usable total order, and it must
+    # respect every edge.
+    order = doc["order"]
+    assert order is not None
+    pos = {n: i for i, n in enumerate(order)}
+    for a, b in edges:
+        assert pos[a] < pos[b], (a, b)
+    # The edge the serving stack actually carries (drop_entry under
+    # the prefix registry lock) is present — the graph is not
+    # vacuously empty.
+    assert ("PrefixCache", "PagePool") in edges
+
+
+def test_find_cycles_unit():
+    from tools.lint.rules.lockorder import find_cycles
+
+    assert find_cycles({("A", "B"), ("B", "A")}) == [["A", "B"]]
+    assert find_cycles({("A", "A")}) == [["A"]]
+    assert find_cycles({("A", "B"), ("B", "C")}) == []
+
+
 # --- CLI + purity ------------------------------------------------------
 
 
-def test_cli_exit_codes_and_jax_purity():
+def test_cli_exit_codes_and_jax_purity(tmp_path):
     """The CI entry point: ``python -m tools.lint`` exits 0 on the
-    clean tree, and the analysis never imports jax (pure AST — the
-    <15 s CPU-only property). One subprocess checks both."""
+    clean tree, the ``--rule`` filter and ``--lockorder-out`` flag
+    work, and the analysis never imports jax (pure AST — the <15 s
+    CPU-only property). One subprocess checks all of it."""
+    out = tmp_path / "lockorder.json"
     code = (
         "import sys\n"
+        "from pathlib import Path\n"
         "from tools.lint.__main__ import main\n"
         "rc = main([])\n"
         "assert rc == 0, f'lint reported findings: rc={rc}'\n"
+        "rc = main(['--rule', 'MLA007', '--lockorder-out', "
+        f"{str(out)!r}])\n"
+        "assert rc == 0, f'MLA007 reported findings: rc={rc}'\n"
+        f"assert 'PrefixCache' in Path({str(out)!r}).read_text()\n"
+        "assert main(['--rule', 'MLA999']) == 2\n"
         "assert 'jax' not in sys.modules, 'linter imported jax'\n"
         "print('LINT_OK')\n"
     )

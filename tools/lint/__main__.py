@@ -36,8 +36,17 @@ def main(argv=None) -> int:
         help="finding output format (github = Actions annotations)",
     )
     ap.add_argument(
-        "--rules", default=None,
-        help="comma-separated rule IDs to run (default: all)",
+        "--rules", "--rule", default=None,
+        help="comma-separated rule IDs to run (default: all); "
+        "--rule MLA007 is the single-rule triage spelling",
+    )
+    ap.add_argument(
+        "--lockorder-out", default=None, metavar="PATH",
+        help="write the MLA007 lock-order graph artifact (the "
+        "machine-readable partial order the runtime witness "
+        "enforces) to PATH and exit 0/1 as usual; regenerate the "
+        "committed tools/lint/lockorder.json with this after any "
+        "change to lock scopes",
     )
     ap.add_argument(
         "--list-rules", action="store_true",
@@ -77,6 +86,11 @@ def main(argv=None) -> int:
             )
             return 2
     findings = run_rules(proj, cfg, rule_ids)
+    if args.lockorder_out:
+        from tools.lint.rules.lockorder import render_artifact
+
+        Path(args.lockorder_out).write_text(render_artifact(proj, cfg))
+        print(f"lint: wrote {args.lockorder_out}", file=sys.stderr)
     if args.no_baseline:
         reported, suppressed = findings, []
     else:

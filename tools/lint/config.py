@@ -92,6 +92,19 @@ LOCK_REGISTRY: dict[str, LockSpec] = {
             "push_applied", "push_bytes_applied", "push_fallbacks",
         }),
     ),
+    # Prefix registry (r04, registered r19 for MLA007/MLA008's
+    # whole-program view): entry lookups/registrations race across
+    # encode executor threads; the counters are /metrics-scraped.
+    # Deliberately NOT listed: ``_wide`` — the widened-stack cache is
+    # mutated only at batch formation (the one dispatch thread at a
+    # time), the same single-writer contract as ``PagePool.layers``.
+    "PrefixCache": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            "_entries", "_building", "mix_warmed",
+            "hits", "misses", "fallbacks", "builds",
+        }),
+    ),
     "LatencyStats": LockSpec(
         locks=frozenset({"_lock"}),
         attrs=frozenset({"_ttft_ms", "_itl_ms"}),
@@ -124,6 +137,19 @@ DISTINCTIVE_ATTRS: dict[str, frozenset[str]] = {
     "_blobs": frozenset({"_lock"}),
     "spill_failures": frozenset({"_lock"}),
     "restore_failures": frozenset({"_lock"}),
+    # r17/r18 additions, registered here r19 (they postdated the
+    # registry and were cross-module-unchecked): the KVPush staging
+    # store + its byte accounting and sender records, the KVPeer
+    # warm-hint map and serve-side wire-image cache, and the
+    # PrefixCache counters engine.py bumps from encode threads.
+    "_staged": frozenset({"_lock"}),
+    "_staged_bytes": frozenset({"_lock"}),
+    "_xfers": frozenset({"_lock"}),
+    "_hints": frozenset({"_lock"}),
+    "_serve_cache": frozenset({"_lock"}),
+    "builds": frozenset({"_lock"}),
+    "fallbacks": frozenset({"_lock"}),
+    "mix_warmed": frozenset({"_lock"}),
 }
 
 # Methods on guarded attributes that mutate the container. Reads
@@ -133,6 +159,85 @@ MUTATING_METHODS = frozenset({
     "popleft", "remove", "clear", "update", "add", "discard",
     "setdefault", "move_to_end", "sort",
 })
+
+# -- MLA007: lock-order graph ----------------------------------------------
+# Attribute-name -> registered-class bindings the cross-module call
+# resolver uses when the assignment shape (``self.pool =
+# PagePool(...)``) is not visible in the AST (constructor args, plain
+# name rebinds like ``pool.tier = self.kv_tier``). Inferred bindings
+# (scanned from ``self.<attr> = <Class>(...)``) are merged first;
+# entries here win on conflict.
+INSTANCE_BINDINGS: dict[str, str] = {
+    "pool": "PagePool",
+    "tier": "KVTier",
+    "kv_tier": "KVTier",
+    "kv_peer": "KVPeer",
+    "kv_push": "KVPush",
+    "prefix": "PrefixCache",
+    "sched": "UnitScheduler",
+    "latency": "LatencyStats",
+    "eng": "TextGenerationEngine",
+    "engine": "TextGenerationEngine",
+    "batcher": "MicroBatcher",
+}
+# Where the machine-readable partial order is committed (the rule
+# recomputes it every run; the tier-1 test pins the committed file to
+# the recomputed graph so the artifact can never drift silently, and
+# the runtime witness loads it as the allowed order).
+LOCKORDER_ARTIFACT = "tools/lint/lockorder.json"
+
+# -- MLA008: thread-context inference --------------------------------------
+# Functions seeded DISPATCH-thread (the one device-stream owner):
+# BatchRun's unit generator and the scheduler's advance/loop. Thread
+# targets and run_in_executor callees seed WORKER; every async def in
+# a serving module seeds EVENT_LOOP.
+DISPATCH_SEEDS: tuple[tuple[str, str], ...] = (
+    ("BatchRun", "units"),
+    ("UnitScheduler", "_advance"),
+    ("UnitScheduler", "_loop"),
+)
+# Calls that BLOCK the calling thread — flagged when reachable in
+# event-loop context outside an executor hop. Dotted prefixes match
+# the trailing segments of the call chain (``np.savez`` matches
+# ``np.savez_compressed`` via the startswith check in the rule).
+EVENT_LOOP_BLOCKING_PREFIXES = (
+    "time.sleep",
+    "np.savez", "np.save", "np.load",
+    "numpy.savez", "numpy.save", "numpy.load",
+    "socket.socket", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen",
+    "urllib.request.urlopen", "request.urlopen",
+    "requests.get", "requests.post",
+    "http.client.HTTPConnection",
+)
+# Bare attribute names that block or dispatch device work regardless
+# of receiver: jax fences and host<->device transfers have no
+# business on the event loop (they belong to the dispatch thread or
+# an executor worker — the r13 spill-under-lock shape).
+EVENT_LOOP_BLOCKING_ATTRS = frozenset({
+    "block_until_ready", "device_put", "device_get",
+})
+
+# -- MLA009: terminal-frame wait discipline --------------------------------
+# Counters that only SETTLE after a stream's terminal frame (their
+# mutation runs on the dispatch thread during batch cleanup, strictly
+# after the last frame reaches the awaiting test): asserting them
+# lexically after a terminal read without a condition wait is the
+# r17/r18 flake class.
+SETTLE_AFTER_TERMINAL = ("kv_pages_in_use",)
+# An await of a call whose name contains one of these consumed a
+# stream to its terminal frame...
+TERMINAL_READ_HINTS = ("collect", "gather")
+# ...and one of these between the terminal read and the assert means
+# the test waited for the state to settle (condition waits, engine
+# stop/drain joins, and this suite's own `_quiesce`/`_settle`
+# helpers). A ``while`` loop polling the counter inline counts as a
+# wait too (the rule special-cases it).
+SETTLE_WAIT_HINTS = (
+    "wait", "stop", "drain", "join", "shutdown", "quiesce", "settle",
+)
 
 # -- MLA004: async purity --------------------------------------------------
 # Modules that run ON the event loop and must not import jax or call
@@ -205,3 +310,14 @@ class Config:
         default_factory=lambda: dict(DISTINCTIVE_ATTRS)
     )
     baseline_file: str = "tools/lint/baseline.txt"
+    # MLA007 / MLA008 / MLA009 knobs (fixture Configs override).
+    instance_bindings: dict = field(
+        default_factory=lambda: dict(INSTANCE_BINDINGS)
+    )
+    lockorder_artifact: str = LOCKORDER_ARTIFACT
+    dispatch_seeds: tuple = DISPATCH_SEEDS
+    blocking_prefixes: tuple = EVENT_LOOP_BLOCKING_PREFIXES
+    blocking_attrs: frozenset = EVENT_LOOP_BLOCKING_ATTRS
+    settle_counters: tuple = SETTLE_AFTER_TERMINAL
+    terminal_read_hints: tuple = TERMINAL_READ_HINTS
+    settle_wait_hints: tuple = SETTLE_WAIT_HINTS

@@ -6,10 +6,13 @@ renumbering would orphan every written justification).
 
 from tools.lint.rules.donation import DonationRule
 from tools.lint.rules.hygiene import TestHygieneRule
+from tools.lint.rules.lockorder import LockOrderRule
 from tools.lint.rules.locks import LockRule
 from tools.lint.rules.metrics_consistency import MetricsRule
 from tools.lint.rules.router_purity import RouterPurityRule
 from tools.lint.rules.seams import SeamRule
+from tools.lint.rules.terminal_wait import TerminalWaitRule
+from tools.lint.rules.threadctx import ThreadContextRule
 
 ALL_RULES = (
     DonationRule(),       # MLA001
@@ -18,4 +21,7 @@ ALL_RULES = (
     RouterPurityRule(),   # MLA004
     MetricsRule(),        # MLA005
     TestHygieneRule(),    # MLA006
+    LockOrderRule(),      # MLA007
+    ThreadContextRule(),  # MLA008
+    TerminalWaitRule(),   # MLA009
 )

@@ -1,0 +1,214 @@
+"""Shared cross-module resolution for the concurrency rules
+(MLA007 lock order, MLA008 thread contexts).
+
+Both rules need the same two facts the single-file rules never did:
+
+- **Which class does this expression refer to?** ``self.eng.pool``
+  means a :class:`PagePool` — knowable only through the repo's own
+  wiring. Bindings are INFERRED from the one assignment shape the
+  AST shows directly (``self.<attr> = <KnownClass>(...)`` anywhere in
+  production code) and then overridden by the explicit
+  ``config.INSTANCE_BINDINGS`` registry for the shapes it cannot see
+  (constructor-arg back-references like ``self.eng = engine``, plain
+  rebinds like ``pool.tier = self.kv_tier``). An attr name inferred
+  to TWO different classes is dropped as ambiguous unless the config
+  pins it — a wrong binding is worse than no binding.
+- **Where is this callee's body?** Methods are indexed per class
+  (class name -> method name -> def node), module functions per
+  file. Resolution is name-based and honest about its limits: an
+  unresolvable call is simply not followed, never guessed at.
+
+Like everything in this package the analysis is lexical — no
+instances, no inheritance walks (the serving classes are flat), no
+dynamic dispatch. That is exactly the shape of the contracts it
+feeds: the lock registry names concrete classes, and the thread
+seeds name concrete functions.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.lint.rules import common
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    file: str
+    node: ast.ClassDef
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    properties: frozenset[str] = frozenset()
+
+
+def production_index(proj, cfg):
+    """``(files, ProjectIndex)`` over the production file set —
+    built once per (project, config) and cached on the project, so
+    MLA007, MLA008, and the ``--lockorder-out`` artifact render all
+    share one repo-wide AST scan."""
+    cached = getattr(proj, "_prod_index", None)
+    if cached is not None and cached[0] is cfg:
+        return cached[1], cached[2]
+    files = [
+        f for f in proj.files
+        if f.path.startswith(cfg.production_prefix)
+        and f.tree is not None
+    ]
+    index = ProjectIndex(files, cfg)
+    proj._prod_index = (cfg, files, index)
+    return files, index
+
+
+class ProjectIndex:
+    """Classes, methods, module functions, and instance-attr ->
+    class bindings over the production file set. Built via
+    :func:`production_index` (cached per run), shared by MLA007 and
+    MLA008."""
+
+    def __init__(self, files, cfg):
+        self.classes: dict[str, ClassInfo] = {}
+        # (file, func_name) -> def node, module level only.
+        self.module_funcs: dict[tuple[str, str], ast.AST] = {}
+        # def node -> (class_name | None, file)
+        self.owner: dict[ast.AST, tuple[str | None, str]] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = self.classes.setdefault(
+                        node.name, ClassInfo(node.name, sf.path, node)
+                    )
+                    props = set(info.properties)
+                    for meth in node.body:
+                        if isinstance(
+                            meth,
+                            (ast.FunctionDef, ast.AsyncFunctionDef),
+                        ):
+                            info.methods.setdefault(meth.name, meth)
+                            self.owner[meth] = (node.name, sf.path)
+                            if "property" in common.decorator_names(
+                                meth
+                            ):
+                                props.add(meth.name)
+                    info.properties = frozenset(props)
+                elif isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self.module_funcs[(sf.path, node.name)] = node
+                    self.owner[node] = (None, sf.path)
+        self.bindings = self._infer_bindings(files, cfg)
+
+    def _infer_bindings(self, files, cfg) -> dict[str, str]:
+        inferred: dict[str, str | None] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                chain = common.attr_chain(node.value.func)
+                if not chain or chain[-1] not in self.classes:
+                    continue
+                cls = chain[-1]
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                    ):
+                        attr = t.attr
+                        if inferred.get(attr, cls) != cls:
+                            inferred[attr] = None  # ambiguous
+                        else:
+                            inferred[attr] = cls
+        out = {a: c for a, c in inferred.items() if c is not None}
+        out.update(cfg.instance_bindings)
+        return out
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_receiver(self, chain: list[str],
+                         enclosing_class: str | None) -> str | None:
+        """Class name an attribute-chain RECEIVER refers to: the
+        rightmost bound segment wins (``self.eng.pool`` -> the
+        ``pool`` binding); a bare ``self`` is the enclosing class."""
+        for seg in reversed(chain):
+            if seg in self.bindings:
+                return self.bindings[seg]
+        if chain and chain[0] == "self" and len(chain) == 1:
+            return enclosing_class
+        return None
+
+    def resolve_call(self, call: ast.Call, enclosing_class: str | None,
+                     file: str):
+        """``(def_node, class_name | None)`` for a call, or ``None``
+        when the callee's body is not findable. ``self.m()`` binds to
+        the enclosing class; ``<...>.bound.m()`` to the bound class;
+        bare ``f()`` to the same module's top level."""
+        chain = common.attr_chain(call.func)
+        if not chain:
+            return None
+        name = chain[-1]
+        if len(chain) == 1:
+            node = self.module_funcs.get((file, name))
+            return (node, None) if node is not None else None
+        recv = chain[:-1]
+        if recv == ["self"] and enclosing_class:
+            cls = self.classes.get(enclosing_class)
+        else:
+            cname = self.resolve_receiver(recv, enclosing_class)
+            cls = self.classes.get(cname) if cname else None
+        if cls is None:
+            return None
+        node = cls.methods.get(name)
+        return (node, cls.name) if node is not None else None
+
+
+def functions_with_class(sf):
+    """Every ``(enclosing_class | None, def)`` in a file — nested
+    defs included, each visited once with the correct class. The ONE
+    traversal both concurrency rules iterate, so they can never
+    disagree on the function universe."""
+    out = []
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                out.append((cls, child))
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(sf.tree, None)
+    return out
+
+
+def lock_owner(ctx_expr: ast.AST, enclosing_class: str | None,
+               index: ProjectIndex, lock_registry: dict):
+    """``(class_name, lock_name)`` when ``with <recv>.<lock>:``
+    acquires a REGISTERED class's registered lock, else ``None``."""
+    if not isinstance(ctx_expr, ast.Attribute):
+        return None
+    chain = common.attr_chain(ctx_expr)
+    if not chain or len(chain) < 2:
+        return None
+    lock_name = chain[-1]
+    recv = chain[:-1]
+    if recv == ["self"]:
+        cname = enclosing_class
+    else:
+        cname = index.resolve_receiver(recv, enclosing_class)
+    if cname is None:
+        return None
+    spec = lock_registry.get(cname)
+    if spec is None or lock_name not in spec.locks:
+        return None
+    return cname, lock_name

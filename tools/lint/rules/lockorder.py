@@ -1,0 +1,374 @@
+"""MLA007 — lock-order cycles across the registered locks.
+
+The serving stack now guards shared state with seven registered
+locks (PagePool, KVTier, UnitScheduler, LatencyStats, PrefixCache,
+KVPeer, KVPush) acquired from at least five thread contexts. Any two
+locks acquired in BOTH nesting orders by different threads is a
+deadlock waiting for load — and the partial order lived nowhere: the
+r13 review moved the pool spill outside the lock and the r17 review
+moved prefix hashing outside the peer lock precisely because nobody
+could see the whole graph. This rule builds it.
+
+**The graph.** Nodes are the registered lock-bearing classes. An
+edge ``A -> B`` means "A's lock is held while B's lock is acquired",
+discovered lexically from every ``with <recv>.<lock>:`` whose
+receiver resolves to a registered class (``tools/lint/config.py``'s
+binding registry + inferred ``self.x = Class()`` assignments —
+``rules/graph.py``):
+
+- a ``with`` nested inside the body acquiring a DIFFERENT registered
+  class's lock adds a direct edge;
+- a call inside the body is resolved to its def (same-class method,
+  bound-class method, or same-module function) and scanned
+  recursively (bounded, cycle-safe): any registered lock THAT body
+  acquires is an edge from the held class. A ``*_locked``-suffixed
+  callee acquires nothing by the repo's convention — its caller
+  already holds the lock, which the direct case above sees.
+- an attribute read of a bound class's ``@property`` whose body
+  acquires the class lock (``pool.pages_in_use`` under another lock)
+  counts like a call.
+
+**Findings.** Any cycle in the graph — including a self-edge
+``A -> A``, which is a self-deadlock on this repo's non-reentrant
+``threading.Lock``s — fails the run, with one finding per cycle
+naming an acquisition site for every edge on it.
+
+**The artifact.** The acyclic graph is emitted as
+``tools/lint/lockorder.json`` (``--lockorder-out``; the tier-1 test
+pins the committed file to the recomputed graph so it can never
+drift silently). It is the machine-readable partial order future PRs
+diff — and the contract ``tools/lint/witness.py`` enforces at
+RUNTIME: the witness records per-thread acquisition stacks and fails
+loudly on any inversion of an edge in this file, so the static order
+and the dynamic order are checked against each other.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+
+from tools.lint import Finding
+from tools.lint.rules import common
+from tools.lint.rules.graph import (
+    functions_with_class,
+    lock_owner,
+    production_index,
+)
+
+_MAX_DEPTH = 6  # recursion bound for followed calls (cycle-safe anyway)
+
+
+class _GraphBuilder:
+    def __init__(self, proj, cfg):
+        self.cfg = cfg
+        self.files, self.index = production_index(proj, cfg)
+        # (held_class, acquired_class) -> sorted set of "file:line".
+        self.edges: dict[tuple[str, str], set[str]] = {}
+        self._acquires_cache: dict[ast.AST, bool] = {}
+
+    # -- public --------------------------------------------------------
+
+    def build(self) -> dict[tuple[str, str], list[str]]:
+        for sf in self.files:
+            for cls_name, func in functions_with_class(sf):
+                self._scan_function(sf, cls_name, func)
+        return {
+            k: sorted(v) for k, v in sorted(self.edges.items())
+        }
+
+    # -- traversal -----------------------------------------------------
+
+    def _scan_function(self, sf, cls_name, func):
+        for node in common.walk_shallow(func):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                owner = lock_owner(
+                    item.context_expr, cls_name, self.index,
+                    self.cfg.lock_registry,
+                )
+                if owner is None:
+                    continue
+                held_cls = owner[0]
+                for stmt in node.body:
+                    self._scan_held(
+                        sf, cls_name, stmt, held_cls, _MAX_DEPTH,
+                        frozenset(),
+                    )
+
+    def _scan_held(self, sf, cls_name, root, held_cls, depth,
+                   visited):
+        """Walk code executing while ``held_cls``'s lock is held;
+        record every registered-lock acquisition as an edge."""
+        for node in _walk_shallow_tree(root):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    owner = lock_owner(
+                        item.context_expr, cls_name, self.index,
+                        self.cfg.lock_registry,
+                    )
+                    if owner is not None:
+                        self._edge(held_cls, owner[0], sf, node.lineno)
+            elif isinstance(node, ast.Call):
+                self._follow(sf, cls_name, node, held_cls, depth,
+                             visited)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                self._follow_property(sf, cls_name, node, held_cls)
+
+    def _follow(self, sf, cls_name, call, held_cls, depth, visited):
+        if depth <= 0:
+            return
+        chain = common.attr_chain(call.func)
+        if chain and chain[-1].endswith("_locked"):
+            return  # caller-holds-the-lock convention: acquires nothing
+        hit = self.index.resolve_call(call, cls_name, sf.path)
+        if hit is None:
+            return
+        callee, callee_cls = hit
+        if callee in visited:
+            return
+        if self._acquires_any_lock(callee):
+            # The callee's body runs entirely under held_cls's lock:
+            # scan it with the SAME holder, charging edges to the
+            # call site's file/line region (the callee's own nested
+            # orders are charged when its own with-blocks are
+            # scanned as holders).
+            self._scan_callee(
+                sf, call, callee, callee_cls, held_cls,
+                depth - 1, visited | {callee},
+            )
+
+    def _scan_callee(self, call_sf, call, callee, callee_cls,
+                     held_cls, depth, visited):
+        sf = self._sf_of(callee)
+        for node in common.walk_shallow(callee):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    owner = lock_owner(
+                        item.context_expr, callee_cls, self.index,
+                        self.cfg.lock_registry,
+                    )
+                    if owner is not None:
+                        self._edge(
+                            held_cls, owner[0], call_sf, call.lineno
+                        )
+                # Locks acquired INSIDE this with release before the
+                # outer holder does — no need to recurse with a new
+                # holder here (the callee's own scan covers it).
+            elif isinstance(node, ast.Call):
+                self._follow(sf or call_sf, callee_cls, node,
+                             held_cls, depth, visited)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                self._follow_property(
+                    sf or call_sf, callee_cls, node, held_cls,
+                    site=(call_sf, call.lineno),
+                )
+
+    def _follow_property(self, sf, cls_name, attr, held_cls,
+                         site=None):
+        chain = common.attr_chain(attr)
+        if not chain or len(chain) < 2:
+            return
+        recv = chain[:-1]
+        if recv == ["self"]:
+            cname = cls_name
+        else:
+            cname = self.index.resolve_receiver(recv, cls_name)
+        if cname is None or cname == held_cls:
+            return
+        cls = self.index.classes.get(cname)
+        if cls is None or attr.attr not in cls.properties:
+            return
+        prop = cls.methods.get(attr.attr)
+        if prop is not None and self._acquires_own_lock(prop, cname):
+            where = site or (sf, attr.lineno)
+            self._edge(held_cls, cname, where[0], where[1])
+
+    # -- predicates ----------------------------------------------------
+
+    def _acquires_any_lock(self, func) -> bool:
+        """Does this def's body (nested defs excluded) contain ANY
+        with-acquisition of a registered lock, or a call it might
+        chain through? Cheap pre-filter: any With at all, or any
+        Call — conservative, the real edge test runs in the scan."""
+        cached = self._acquires_cache.get(func)
+        if cached is None:
+            cached = any(
+                isinstance(n, (ast.With, ast.AsyncWith, ast.Call))
+                for n in common.walk_shallow(func)
+            )
+            self._acquires_cache[func] = cached
+        return cached
+
+    def _acquires_own_lock(self, func, cls_name) -> bool:
+        for node in common.walk_shallow(func):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    owner = lock_owner(
+                        item.context_expr, cls_name, self.index,
+                        self.cfg.lock_registry,
+                    )
+                    if owner is not None and owner[0] == cls_name:
+                        return True
+        return False
+
+    def _sf_of(self, func):
+        owner = self.index.owner.get(func)
+        if owner is None:
+            return None
+        for sf in self.files:
+            if sf.path == owner[1]:
+                return sf
+        return None
+
+    def _edge(self, a, b, sf, line):
+        self.edges.setdefault((a, b), set()).add(f"{sf.path}:{line}")
+
+
+def _walk_shallow_tree(root):
+    """walk_shallow over a statement (root included)."""
+    yield root
+    yield from common.walk_shallow(root)
+
+
+def build_lock_graph(proj, cfg):
+    """``{(held, acquired): [site, ...]}`` over production code —
+    computed once per (project, config): the rule and the
+    ``--lockorder-out`` render share the result."""
+    cached = getattr(proj, "_lock_graph", None)
+    if cached is not None and cached[0] is cfg:
+        return cached[1]
+    edges = _GraphBuilder(proj, cfg).build()
+    proj._lock_graph = (cfg, edges)
+    return edges
+
+
+def find_cycles(edges) -> list[list[str]]:
+    """Elementary cycles (as node lists, smallest-first start) via
+    DFS — the graph has a handful of nodes, nothing fancier needed.
+    Self-edges come out as ``[A]``."""
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: list[list[str]] = []
+    seen_keys: set[tuple[str, ...]] = set()
+
+    def dfs(start, node, path):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                # Key = the path itself (it already starts at the
+                # cycle's smallest node): two DISTINCT cycles over
+                # the same node set (both orientations of a ring)
+                # must each be reported.
+                key = tuple(path)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(list(path))
+            elif nxt not in path and nxt > start:
+                # Only explore nodes > start: each cycle is found
+                # exactly once, from its smallest node.
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return cycles
+
+
+def graph_as_json(edges, lock_registry) -> dict:
+    """The machine-readable artifact: nodes, edges with example
+    sites, and (when acyclic) one valid total order — deterministic,
+    so the committed file diffs cleanly across PRs."""
+    nodes = sorted(lock_registry)
+    out_edges = [
+        {"before": a, "after": b, "sites": sites}
+        for (a, b), sites in sorted(edges.items())
+    ]
+    order = _topo_order(nodes, edges)
+    return {
+        "version": 1,
+        "nodes": nodes,
+        "edges": out_edges,
+        "order": order,
+    }
+
+
+def _topo_order(nodes, edges) -> list[str] | None:
+    indeg = {n: 0 for n in nodes}
+    adj: dict[str, list[str]] = {n: [] for n in nodes}
+    for a, b in edges:
+        if a in indeg and b in indeg:
+            adj[a].append(b)
+            indeg[b] += 1
+    ready = sorted(n for n, d in indeg.items() if d == 0)
+    order: list[str] = []
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for m in sorted(adj[n]):
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                ready.append(m)
+        ready.sort()
+    return order if len(order) == len(nodes) else None
+
+
+def render_artifact(proj, cfg) -> str:
+    edges = build_lock_graph(proj, cfg)
+    doc = graph_as_json(edges, cfg.lock_registry)
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+class LockOrderRule:
+    id = "MLA007"
+    title = "registered locks must form a cycle-free acquisition order"
+
+    def run(self, proj, cfg):
+        edges = build_lock_graph(proj, cfg)
+        findings: list[Finding] = []
+        for cycle in find_cycles(edges):
+            sites = []
+            ring = cycle + [cycle[0]]
+            for a, b in zip(ring, ring[1:]):
+                es = edges.get((a, b))
+                if es:
+                    sites.append(f"{a}->{b} at {es[0]}")
+            anchor_file, anchor_line = self._anchor(edges, cycle)
+            if len(cycle) == 1:
+                msg = (
+                    f"lock self-deadlock: {cycle[0]}'s lock is "
+                    f"acquired while already held "
+                    f"({'; '.join(sites)}) — threading.Lock is not "
+                    f"reentrant"
+                )
+            else:
+                msg = (
+                    f"lock-order cycle {' -> '.join(ring)}: two "
+                    f"threads taking these in opposite order "
+                    f"deadlock under load ({'; '.join(sites)}) — "
+                    f"break one edge (move the call outside the "
+                    f"lock, the claim-under-lock/work-outside "
+                    f"pattern)"
+                )
+            findings.append(Finding(
+                rule=self.id, file=anchor_file, line=anchor_line,
+                message=msg,
+            ))
+        return findings
+
+    @staticmethod
+    def _anchor(edges, cycle):
+        ring = cycle + [cycle[0]]
+        for a, b in zip(ring, ring[1:]):
+            es = edges.get((a, b))
+            if es:
+                f, _, ln = es[0].rpartition(":")
+                try:
+                    return f, int(ln)
+                except ValueError:
+                    continue
+        return "tools/lint/config.py", 1

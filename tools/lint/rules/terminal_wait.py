@@ -1,0 +1,135 @@
+"""MLA009 — terminal-frame wait discipline in async tests.
+
+The exact flake class de-flaked by hand in r17 and r18 (four tests,
+all failing on the unmodified r18 seed): a stream's TERMINAL frame
+reaches the awaiting test strictly BEFORE the dispatch thread runs
+the batch's cleanup, so an assert on release-settled state — page
+refcounts back to zero, ``kv_pages_in_use == 0`` — placed lexically
+right after the stream read races a thread that has not released
+yet. It passes on a fast box, flakes on a loaded one, and every
+occurrence was "fixed" once already before someone wrote the
+condition wait.
+
+The rule, lexical like every incident it encodes — in test files,
+inside ``async def`` functions (sync tests drive ``generate_text``
+inline, where cleanup completes before the call returns: no race):
+
+- a **terminal read** is an ``await`` of a call whose name contains
+  a ``config.TERMINAL_READ_HINTS`` token (``_collect``,
+  ``asyncio.gather`` of collectors — the shapes this suite consumes
+  streams with);
+- a **settle event** is an ``await`` of a call whose name contains a
+  ``config.SETTLE_WAIT_HINTS`` token (``_wait_for``, ``stop``,
+  ``drain`` — condition waits and dispatch-thread joins), or a
+  ``while`` loop that reads the settled counter (the inline
+  deadline-poll shape);
+- an ``assert`` reading a ``config.SETTLE_AFTER_TERMINAL`` counter
+  (attribute access or a ``"...kv_pages_in_use"`` metrics key) is
+  flagged when the nearest preceding terminal read has no settle
+  event between it and the assert.
+
+``slow``/``heavy`` tests are NOT exempt here (unlike MLA006): the
+race is a correctness hole at any speed, not a machine-speed
+encoding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.rules import common
+
+
+class TerminalWaitRule:
+    id = "MLA009"
+    title = "settled-state asserts need a condition wait after stream end"
+
+    def run(self, proj, cfg):
+        findings: list[Finding] = []
+        for sf in proj.files:
+            if not sf.path.startswith(cfg.test_prefix):
+                continue
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    findings.extend(self._check(sf, node, cfg))
+        return findings
+
+    def _check(self, sf, func, cfg):
+        # (line, kind) events in lexical order; shallow walk so a
+        # nested helper def's internals are not this frame's events
+        # (lambdas handed to _wait_for stay invisible for free).
+        events: list[tuple[int, str, ast.AST]] = []
+        for node in common.walk_shallow(func):
+            if isinstance(node, ast.Await) and isinstance(
+                node.value, ast.Call
+            ):
+                kind = self._await_kind(node.value, cfg)
+                if kind:
+                    events.append((node.lineno, kind, node))
+            elif isinstance(node, ast.While):
+                # Only the CONDITION counts — a loop polling the
+                # counter is a wait; a loop that merely mentions it
+                # in its body (an assert message, say) is not.
+                if self._reads_counter(node.test, cfg):
+                    events.append((node.lineno, "settle", node))
+            elif isinstance(node, ast.Assert):
+                name = self._reads_counter(node.test, cfg)
+                if name:
+                    events.append((node.lineno, f"assert:{name}", node))
+        events.sort(key=lambda e: e[0])
+        findings = []
+        last_terminal: int | None = None
+        for line, kind, node in events:
+            if kind == "terminal":
+                last_terminal = line
+            elif kind == "settle":
+                last_terminal = None
+            elif kind.startswith("assert:") and last_terminal is not None:
+                name = kind.split(":", 1)[1]
+                findings.append(Finding(
+                    rule=self.id, file=sf.path, line=line,
+                    message=(
+                        f"`{name}` asserted after the stream-terminal "
+                        f"read at line {last_terminal} with no "
+                        f"condition wait in between — the release "
+                        f"runs on the dispatch thread AFTER the "
+                        f"terminal frame; wait on the counter "
+                        f"(`_wait_for`-style) first (the r17/r18 "
+                        f"flake class)"
+                    ),
+                    symbol=sf.symbol_at(line),
+                ))
+                # One finding per unsettled terminal read: the fix (one
+                # wait) settles every later assert in the run too.
+                last_terminal = None
+        return findings
+
+    @staticmethod
+    def _await_kind(call: ast.Call, cfg) -> str | None:
+        chain = common.attr_chain(call.func)
+        if not chain:
+            return None
+        name = chain[-1].lower()
+        if any(h in name for h in cfg.settle_wait_hints):
+            return "settle"
+        if any(h in name for h in cfg.terminal_read_hints):
+            return "terminal"
+        return None
+
+    @staticmethod
+    def _reads_counter(expr, cfg) -> str | None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and (
+                sub.attr in cfg.settle_counters
+            ):
+                return sub.attr
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                for c in cfg.settle_counters:
+                    if c in sub.value:
+                        return c
+        return None

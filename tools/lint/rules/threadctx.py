@@ -1,0 +1,238 @@
+"""MLA008 — thread-context inference: blocking work must not reach
+the event loop, device dispatch must not reach it either.
+
+The serving process runs in (at least) five thread contexts — the
+asyncio event loop, the scheduler's dispatch thread, encode/app
+executor workers, the KVPush sender thread, prefix registration
+threads — and the repo's worst bug class is work landing on the
+WRONG one: the r13 spill (device gather + npz write) reachable on
+the event loop through brownout's ``evict_idle``, the r17 prefix
+hashing serializing encode threads behind the peer lock. MLA004
+pins the router module; this rule infers contexts for everything
+else.
+
+**Seeding.** Per-function context sets start from what the AST shows
+directly:
+
+- every ``async def`` in a serving module runs ON the event loop;
+- ``config.DISPATCH_SEEDS`` (``BatchRun.units``, the scheduler's
+  ``_advance``/``_loop``) run on the dispatch thread;
+- ``run_in_executor(...)``/``Thread(target=...)`` callees run on a
+  worker thread (and the executor call is the HOP: it never
+  propagates the caller's event-loop context into its argument).
+
+**Propagation.** Contexts flow through the resolved call graph
+(``rules/graph.py``: same-class methods, bound-class methods,
+same-module functions) to a fixed point. A function reachable from
+both a worker and the loop keeps both — blocking on the loop is the
+bug regardless of who else calls it.
+
+**Flagging.** In any function carrying the event-loop context:
+
+- a call matching ``config.EVENT_LOOP_BLOCKING_PREFIXES``
+  (``time.sleep``, sync socket/subprocess I/O, npz writes) — one
+  blocked loop freezes every stream, timer, and health poll at once;
+- a call whose attribute is in ``EVENT_LOOP_BLOCKING_ATTRS``
+  (``block_until_ready``, ``device_put``, ``device_get``) — jax
+  dispatch belongs to the dispatch thread or an executor worker,
+  never the loop.
+
+Calls inside nested sync defs/lambdas handed to ``run_in_executor``
+are exempt (the documented hop), as are the async-pure modules
+(MLA004's domain, no double reports) and ``serving/faults.py`` (the
+delay action IS ``time.sleep`` — by design, and only on armed
+threads). Each finding names the seed path (``submit ->
+PagePool.evict_idle -> ...``) so the fix — an executor hop at the
+boundary — is visible from the message.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint import Finding
+from tools.lint.rules import common
+from tools.lint.rules.graph import functions_with_class, production_index
+
+EVENT_LOOP = "event-loop"
+DISPATCH = "dispatch"
+WORKER = "worker"
+
+
+def _is_executor_call(call: ast.Call) -> bool:
+    f = call.func
+    # attr check first: `asyncio.get_running_loop().run_in_executor`
+    # has a Call in its receiver chain, which attr_chain refuses.
+    if isinstance(f, ast.Attribute):
+        return f.attr in ("run_in_executor", "to_thread")
+    chain = common.attr_chain(f)
+    return bool(chain) and chain[-1] in (
+        "run_in_executor", "to_thread"
+    )
+
+
+def _thread_target(call: ast.Call):
+    """The ``target=`` expression of a ``threading.Thread(...)``
+    construction, else None."""
+    chain = common.attr_chain(call.func)
+    if not chain or chain[-1] != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+class ThreadContextRule:
+    id = "MLA008"
+    title = "blocking calls and jax dispatch must stay off the event loop"
+
+    def run(self, proj, cfg):
+        files, index = production_index(proj, cfg)
+        if not files:
+            return []
+        sf_by_path = {f.path: f for f in files}
+        # def node -> {context: provenance string}
+        ctx: dict[ast.AST, dict[str, str]] = {}
+        exempt = set(cfg.async_pure_modules) | {cfg.faults_module}
+
+        # -- seeds ----------------------------------------------------
+        funcs: list[tuple[object, str | None, ast.AST]] = []
+        for sf in files:
+            for cls_name, func in functions_with_class(sf):
+                funcs.append((sf, cls_name, func))
+                label = (
+                    f"{cls_name}.{func.name}" if cls_name
+                    else func.name
+                )
+                if isinstance(func, ast.AsyncFunctionDef):
+                    ctx.setdefault(func, {})[EVENT_LOOP] = (
+                        f"async {label}"
+                    )
+                if (cls_name, func.name) in cfg.dispatch_seeds or (
+                    (None, func.name) in cfg.dispatch_seeds
+                ):
+                    ctx.setdefault(func, {})[DISPATCH] = label
+        # Executor / thread targets seed WORKER.
+        for sf, cls_name, func in funcs:
+            for node in common.walk_shallow(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                targets = []
+                if _is_executor_call(node):
+                    # run_in_executor(executor, fn, *args) carries the
+                    # callee at [1]; asyncio.to_thread(fn, *args) at
+                    # [0].
+                    f = node.func
+                    attr = (
+                        f.attr if isinstance(f, ast.Attribute) else ""
+                    )
+                    idx = 0 if attr == "to_thread" else 1
+                    targets = list(node.args[idx:idx + 1])
+                t = _thread_target(node)
+                if t is not None:
+                    targets.append(t)
+                for tgt in targets:
+                    hit = self._resolve_expr(
+                        tgt, index, cls_name, sf.path
+                    )
+                    if hit is not None:
+                        ctx.setdefault(hit, {}).setdefault(
+                            WORKER, "executor/thread target"
+                        )
+
+        # -- propagation to a fixed point -----------------------------
+        changed = True
+        while changed:
+            changed = False
+            for sf, cls_name, func in funcs:
+                my = ctx.get(func)
+                if not my:
+                    continue
+                for node in common.walk_shallow(func):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _is_executor_call(node):
+                        continue  # the hop: callee already seeded worker
+                    hit = index.resolve_call(node, cls_name, sf.path)
+                    if hit is None:
+                        continue
+                    callee, callee_cls = hit
+                    dst = ctx.setdefault(callee, {})
+                    label = (
+                        f"{callee_cls}.{callee.name}" if callee_cls
+                        else callee.name
+                    )
+                    for c, prov in my.items():
+                        if c not in dst:
+                            dst[c] = f"{prov} -> {label}"
+                            changed = True
+
+        # -- flagging -------------------------------------------------
+        findings: list[Finding] = []
+        for sf, cls_name, func in funcs:
+            if sf.path in exempt:
+                continue
+            my = ctx.get(func)
+            if not my or EVENT_LOOP not in my:
+                continue
+            # Calls inside lambdas / nested defs are invisible to the
+            # shallow walk by construction — the run_in_executor
+            # lambda shape is exempt for free (a nested def is its
+            # own function with its own contexts).
+            for node in common.walk_shallow(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._blocking(node, cfg)
+                if label is None:
+                    continue
+                findings.append(Finding(
+                    rule=self.id, file=sf.path, line=node.lineno,
+                    message=(
+                        f"`{label}` is reachable on the event loop "
+                        f"(context: {my[EVENT_LOOP]}) — blocking/"
+                        f"device work freezes every stream and timer; "
+                        f"hop through run_in_executor at the async "
+                        f"boundary"
+                    ),
+                    symbol=sf.symbol_at(node.lineno),
+                ))
+        return findings
+
+    # -- helpers -------------------------------------------------------
+
+    @staticmethod
+    def _resolve_expr(expr, index, cls_name, path):
+        """A run_in_executor/Thread callee EXPRESSION -> its def node
+        (name, self-method, or bound method), else None."""
+        chain = common.attr_chain(expr)
+        if not chain:
+            return None
+        fake = ast.Call(
+            func=expr, args=[], keywords=[],
+        )
+        hit = index.resolve_call(fake, cls_name, path)
+        return hit[0] if hit is not None else None
+
+    @staticmethod
+    def _blocking(node: ast.Call, cfg) -> str | None:
+        chain = common.attr_chain(node.func)
+        if not chain:
+            return None
+        if chain[-1] in cfg.blocking_attrs:
+            return ".".join(chain[-2:]) if len(chain) > 1 else chain[-1]
+        dotted = ".".join(chain)
+        for pref in cfg.blocking_prefixes:
+            # Match at a trailing boundary: `time.sleep` matches
+            # `time.sleep` and `x.time.sleep`, never `mytime.sleeper`.
+            if dotted == pref or dotted.endswith("." + pref):
+                return pref
+            head, _, last = pref.rpartition(".")
+            if head and chain[-1].startswith(last) and (
+                dotted.startswith(pref)
+                or ("." + pref.rsplit(".", 1)[0] + ".") in "." + dotted + "."
+            ):
+                if ".".join(chain[:-1]).endswith(head):
+                    return dotted
+        return None
+

@@ -1,0 +1,256 @@
+"""Runtime lock-order witness: the dynamic half of MLA007.
+
+``tools/lint/lockorder.json`` is the STATIC partial order — lock
+acquisitions the AST can see. This module checks the order the
+process ACTUALLY takes: every registered lock is wrapped in a proxy
+that records per-thread acquisition stacks, and
+
+- acquiring lock B while holding lock A when the static order says
+  ``B before A`` is an ORDER INVERSION — the exact half of a
+  deadlock the static rule proved cannot come from the other side;
+- every observed (held, acquired) class pair is recorded, so a test
+  can assert the dynamic graph is a SUBSET of the static one — an
+  observed edge the analyzer cannot see means the analyzer (or the
+  binding registry) has a hole, and the smoke test fails until it is
+  taught;
+- a lock held longer than ``hold_budget_s`` (opt-in) is a convoy
+  violation — the r13 spill-under-lock class, caught while it
+  happens instead of in review.
+
+Deterministic and pure stdlib: the witness adds one thread-local
+list append/pop per acquisition when armed and EXISTS only when
+armed — production code never imports this module; tests opt in via
+the ``lock_witness`` fixture (``tests/conftest.py``) or
+``MLAPI_LOCK_WITNESS=1``.
+
+Wrapping preserves the UNDERLYING primitive: ``WitnessLock``
+delegates to the same ``threading.Lock`` object the class built, and
+``Condition`` attributes that shared the class lock are rebuilt
+around the proxy — mutual exclusion is untouched, only observation
+is added. Violations are RECORDED, not raised: raising inside
+``acquire`` would corrupt the very engine state under test; the
+fixture asserts the list is empty at teardown (and a negative test
+asserts it is not).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+_ARTIFACT = Path(__file__).resolve().parent / "lockorder.json"
+
+# Registered class -> "module:Class" for install(); lock NAMES come
+# from tools.lint.config.LOCK_REGISTRY (one source of truth). The
+# metrics trio (MetricsRegistry/Counter/Histogram) is deliberately
+# absent: leaf locks with no outgoing edges, wrapped cost on every
+# counter bump for nothing the order can say.
+_INSTALL_TARGETS = {
+    "PagePool": "mlapi_tpu.serving.paged_pool",
+    "KVTier": "mlapi_tpu.serving.kv_tier",
+    "PrefixCache": "mlapi_tpu.serving.prefix",
+    "KVPeer": "mlapi_tpu.serving.kv_peer",
+    "KVPush": "mlapi_tpu.serving.kv_peer",
+    "UnitScheduler": "mlapi_tpu.serving.scheduler",
+    "LatencyStats": "mlapi_tpu.serving.requests",
+}
+
+
+def load_order(path=None) -> set[tuple[str, str]]:
+    """The static edges ``{(before, after), ...}`` from the MLA007
+    artifact, expanded to their transitive closure."""
+    doc = json.loads(Path(path or _ARTIFACT).read_text())
+    edges = {(e["before"], e["after"]) for e in doc.get("edges", [])}
+    # Tiny graph: closure by iteration.
+    changed = True
+    while changed:
+        changed = False
+        for a, b in list(edges):
+            for c, d in list(edges):
+                if b == c and (a, d) not in edges:
+                    edges.add((a, d))
+                    changed = True
+    return edges
+
+
+class LockWitness:
+    """Shared recorder: per-thread held stacks, observed class-pair
+    edges, and the violation log."""
+
+    def __init__(self, order: set[tuple[str, str]] | None = None,
+                 hold_budget_s: float | None = None):
+        self.order = set(order or ())
+        self.hold_budget_s = hold_budget_s
+        self.violations: list[str] = []
+        self.observed_edges: set[tuple[str, str]] = set()
+        self._tls = threading.local()
+        self._vlock = threading.Lock()
+
+    @classmethod
+    def from_artifact(cls, path=None, hold_budget_s=None):
+        if hold_budget_s is None:
+            env = os.environ.get("MLAPI_LOCK_WITNESS_BUDGET_S")
+            hold_budget_s = float(env) if env else None
+        return cls(load_order(path), hold_budget_s=hold_budget_s)
+
+    # -- recording (called by WitnessLock) -----------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def note_acquire(self, cls_name: str, token: int) -> None:
+        st = self._stack()
+        for held_cls, held_token, _ in st:
+            if held_cls == cls_name:
+                continue  # instance-level pairs carry no class order
+            self.observed_edges.add((held_cls, cls_name))
+            if (cls_name, held_cls) in self.order:
+                self._violate(
+                    f"order inversion: acquired {cls_name} while "
+                    f"holding {held_cls}, but lockorder.json orders "
+                    f"{cls_name} before {held_cls} (thread "
+                    f"{threading.current_thread().name})"
+                )
+        st.append((cls_name, token, time.perf_counter()))
+
+    def note_release(self, cls_name: str, token: int) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][1] == token and st[i][0] == cls_name:
+                _, _, t0 = st.pop(i)
+                if (
+                    self.hold_budget_s is not None
+                    and time.perf_counter() - t0 > self.hold_budget_s
+                ):
+                    self._violate(
+                        f"hold-span budget exceeded: {cls_name} held "
+                        f"{time.perf_counter() - t0:.3f}s > "
+                        f"{self.hold_budget_s}s (thread "
+                        f"{threading.current_thread().name})"
+                    )
+                return
+        # A release the witness never saw acquired (the init-window
+        # old-Condition path): tolerated — observation only.
+
+    def _violate(self, msg: str) -> None:
+        with self._vlock:
+            self.violations.append(msg)
+
+
+class WitnessLock:
+    """Proxy around the class's OWN lock object: same mutual
+    exclusion, plus acquisition recording. Duck-compatible with
+    ``threading.Condition(lock=...)`` (acquire/release only — the
+    Condition falls back to its portable ``_is_owned`` /
+    ``_release_save`` paths, which route through this proxy)."""
+
+    def __init__(self, witness: LockWitness, cls_name: str, inner):
+        self._witness = witness
+        self._cls = cls_name
+        self._inner = inner
+        self._token = id(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness.note_acquire(self._cls, self._token)
+        return got
+
+    def release(self):
+        self._witness.note_release(self._cls, self._token)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def wrap_instance(witness: LockWitness, obj, cls_name: str,
+                  lock_names) -> None:
+    """Swap ``obj``'s registered lock attributes for witness proxies.
+    Lock attrs wrap in place (same primitive underneath); Condition
+    attrs are rebuilt around the proxy of their shared base lock.
+    Runs at construction time (no waiters exist yet)."""
+    conds: list[tuple[str, threading.Condition]] = []
+    proxies: dict[int, WitnessLock] = {}
+    for name in lock_names:
+        lk = getattr(obj, name, None)
+        if lk is None:
+            continue
+        if isinstance(lk, threading.Condition):
+            conds.append((name, lk))
+        else:
+            proxy = WitnessLock(witness, cls_name, lk)
+            proxies[id(lk)] = proxy
+            setattr(obj, name, proxy)
+    for name, cond in conds:
+        base = cond._lock
+        proxy = proxies.get(id(base))
+        if proxy is None:
+            proxy = WitnessLock(witness, cls_name, base)
+            proxies[id(base)] = proxy
+        setattr(obj, name, threading.Condition(proxy))
+
+
+def install(witness: LockWitness, targets=None):
+    """Patch the registered serving classes so every instance
+    constructed while armed is witness-wrapped; returns the
+    uninstall callable. Lock names come from the MLA002 registry —
+    the static and dynamic checks share one contract."""
+    import importlib
+
+    from tools.lint.config import LOCK_REGISTRY
+
+    originals = []
+    for cls_name, mod_name in (targets or _INSTALL_TARGETS).items():
+        spec = LOCK_REGISTRY.get(cls_name)
+        if spec is None:
+            continue
+        mod = importlib.import_module(mod_name)
+        cls = getattr(mod, cls_name)
+        orig = cls.__init__
+
+        def patched(self, *a, __orig=orig, __cls=cls_name,
+                    __locks=tuple(spec.locks), **k):
+            # Threads started DURING construction (UnitScheduler
+            # spawns its dispatch thread at the end of __init__) must
+            # not observe the pre-wrap locks: defer every start until
+            # after the swap. Test-only machinery — constructions in
+            # the suite are sequential, so the global patch window is
+            # effectively private.
+            deferred: list = []
+            real_start = threading.Thread.start
+            threading.Thread.start = lambda t: deferred.append(t)
+            try:
+                __orig(self, *a, **k)
+                wrap_instance(witness, self, __cls, __locks)
+            finally:
+                # Restore AND replay in the finally: a raising
+                # __init__ must still start any unrelated thread the
+                # process-wide patch swallowed, or its owner hangs.
+                threading.Thread.start = real_start
+                for t in deferred:
+                    real_start(t)
+
+        cls.__init__ = functools.wraps(orig)(patched)
+        originals.append((cls, orig))
+
+    def uninstall():
+        for cls, orig in originals:
+            cls.__init__ = orig
+
+    return uninstall
