@@ -1446,6 +1446,21 @@ def _sched_report(ck: str, env: dict) -> dict:
       scheduler-on it lanes immediately. The long stream's own
       inter-token gap is the cost side of the trade and is reported
       alongside (both subject to VARIANCE_NOTE on this box).
+    - **Fused fold (r20) — alternated in one window, dispatch counts
+      counter-asserted.** Three legs on the same solo workload:
+      fused-CHUNKED (the fold: tier-wide decode chunks as typed
+      units), legacy-fused (the retired whole-generation
+      ``generate_tier_fn`` program, still a library entry point —
+      the dispatch-count ceiling the fold is measured against), and
+      plain-chunked. Streams asserted identical across all three;
+      the dispatch saving is pinned from ``chunk_calls`` (fused pays
+      ~n/tier decode dispatches vs ~n/chunk), wall-clock medians
+      reported for the record.
+
+    Since r20 ``scheduler=False`` is the serial escape hatch (same
+    machinery pinned to one lane), so the off-mode counters are
+    serial-shaped (one live lane, units still ticking) rather than
+    zero.
     """
     src = f"""
 import asyncio, json, time
@@ -1539,7 +1554,9 @@ on, off = engines[True], engines[False]
 # arrival ran as a second live batch with units interleaved.
 assert on.sched_batches_live_max == 2, on.sched_batches_live_max
 assert on.sched_units_decode > 0 and on.sched_units_prefill > 0
-assert off.sched_units_decode == 0 and off.sched_batches_live_max == 0
+# r20: off is the serial escape hatch — same machinery, one lane.
+assert off.sched_batches_live_max <= 1, off.sched_batches_live_max
+assert off.sched_units_decode > 0 and off.sched_max_batches == 1
 q = lambda xs, f: round(sorted(xs)[min(len(xs) - 1,
                                        int(f * len(xs)))], 2)
 report["sched_on_incompat_ttft_p50_ms"] = q(ts[True][0], 0.5)
@@ -1555,7 +1572,59 @@ report["sched_units"] = dict(
     spec=on.sched_units_spec, admit=on.sched_units_admit,
     compact=on.sched_units_compact)
 report["sched_batches_live_max"] = on.sched_batches_live_max
+report["sched_lane_stall_max"] = on.sched_lane_stall_max
 report["sched_streams_identical"] = True
+
+# --- fused fold (r20): fused-chunked vs legacy-fused vs plain ------
+from mlapi_tpu.models.gpt import generate_tier_fn
+
+GEN_N, TIER = 64, 64
+fus = TextGenerationEngine(
+    model, params, **dict(kw, fused_single=True))
+pl = TextGenerationEngine(model, params, **kw)  # fused_single=False
+PROMPT = "warm me up"
+ids = np.asarray(tok.token_ids(PROMPT), np.int32)
+bkt = 16
+row = np.zeros((1, bkt), np.int32)
+row[0, bkt - len(ids):] = ids
+npad = np.asarray([bkt - len(ids)], np.int32)
+kd = np.asarray(jax.random.key_data(jax.random.key(0)))[None]
+tier_fn = generate_tier_fn(model, TIER)
+
+def legacy_leg():
+    toks = np.asarray(tier_fn(
+        params, row, kd, np.zeros((1,), np.float32), npad,
+        np.zeros((1,), np.int32), np.ones((1,), np.float32),
+        np.asarray([GEN_N], np.int32),
+    ))
+    return toks[0, :GEN_N].tolist()
+
+legs = {{
+    "fused_chunked": lambda: fus.generate_text(
+        PROMPT, max_new_tokens=GEN_N)["token_ids"],
+    "legacy_fused": legacy_leg,
+    "plain_chunked": lambda: pl.generate_text(
+        PROMPT, max_new_tokens=GEN_N)["token_ids"],
+}}
+fref = {{name: fn() for name, fn in legs.items()}}  # compile round
+assert (fref["fused_chunked"] == fref["legacy_fused"]
+        == fref["plain_chunked"])
+times = {{name: [] for name in legs}}
+for _ in range(6):                    # alternated: ONE window
+    for name, fn in legs.items():
+        t0 = time.perf_counter()
+        out = fn()
+        times[name].append((time.perf_counter() - t0) * 1e3)
+        assert out == fref[name], name
+for name in legs:
+    report[f"{{name}}_gen_ms_p50"] = q(times[name], 0.5)
+# The dispatch-count claim, from counters (never wall-clock): the
+# fold keeps ~n/tier decode dispatches vs the plain ~n/chunk.
+assert fus.fused_calls == 7 and fus.chunk_calls < pl.chunk_calls
+report["fused_fold_counters"] = dict(
+    fused_calls=fus.fused_calls, fused_chunk_calls=fus.chunk_calls,
+    plain_chunk_calls=pl.chunk_calls)
+report["fused_streams_identical"] = True
 print(json.dumps(report))
 """
     out = subprocess.run(
@@ -1955,9 +2024,10 @@ def bench_generate() -> None:
                     # --kv-peer-fetch; the round-trip itself is
                     # asserted in the _peer_report subprocess.
                     "generate.kv_peer_",
-                    # Scheduler v2 (r15): per-unit-type dispatch
-                    # counters — all zero with --scheduler off, the
-                    # interleaving evidence with it on.
+                    # Scheduler v2 (r15, default-on since r20): the
+                    # per-unit-type dispatch counters are the
+                    # interleaving evidence; serial-shaped (one live
+                    # lane) under --no-scheduler.
                     "generate.sched_",
                 ))
             })

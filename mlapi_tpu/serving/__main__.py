@@ -161,9 +161,10 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
         cmd += ["--no-prefill-interleave"]
-    if getattr(args, "scheduler", False):
-        cmd += ["--scheduler",
-                "--sched-max-batches",
+    if not getattr(args, "scheduler", True):
+        cmd += ["--no-scheduler"]
+    else:
+        cmd += ["--sched-max-batches",
                 str(getattr(args, "sched_max_batches", 2))]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
@@ -171,8 +172,6 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--draft-checkpoint", args.draft_checkpoint]
     if getattr(args, "spec_sample", False):
         cmd += ["--spec-sample"]
-    if getattr(args, "fused_batch", "auto") != "auto":
-        cmd += ["--fused-batch", args.fused_batch]
     if getattr(args, "default_deadline_ms", None) is not None:
         cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
     if not getattr(args, "admission_control", True):
@@ -710,25 +709,27 @@ def main(argv=None) -> None:
     )
     parser.add_argument(
         "--scheduler", action=argparse.BooleanOptionalAction,
-        default=False,
-        help="continuous-batching scheduler v2: run up to "
-             "--sched-max-batches decode batches CONCURRENTLY, "
+        default=True,
+        help="continuous-batching scheduler v2, DEFAULT ON: run up "
+             "to --sched-max-batches decode batches CONCURRENTLY, "
              "interleaved at typed-unit granularity (prefill chunk / "
              "decode chunk / spec round / admission / compaction) on "
              "one device stream, prioritized by deadline slack with "
              "TTFT/inter-token targets fed from the live latency "
              "reservoirs — bucket-incompatible traffic no longer "
              "waits out the running batch. Greedy streams are pinned "
-             "token-identical scheduler-on vs off. Watch "
+             "token-identical across modes. Watch "
              "generate.sched_units_* / sched_batches_live on "
-             "/metrics. Generative checkpoints only",
+             "/metrics. --no-scheduler (escape hatch, one release) "
+             "pins ONE lane — the legacy serial semantics on the "
+             "same machinery. Generative checkpoints only",
     )
     parser.add_argument(
         "--sched-max-batches", type=int, default=2,
-        help="with --scheduler: how many batches may be live at once "
-             "(lanes). Paged engines additionally gate new lanes on "
-             "the pool's free-page budget "
-             "(generate.sched_pages_deferred counts waits)",
+        help="how many batches may be live at once (lanes). Paged "
+             "engines additionally gate new lanes on the pool's "
+             "free-page budget (generate.sched_pages_deferred counts "
+             "waits)",
     )
     parser.add_argument(
         "--draft-checkpoint", default=None,
@@ -745,11 +746,12 @@ def main(argv=None) -> None:
              "byte-reproducible per seed (solo runs are)",
     )
     parser.add_argument(
-        "--fused-batch", choices=["auto", "on", "off"], default="auto",
-        help="fused BATCHED generation policy: 'auto' engages only on "
-             "a high-RTT attach (one dispatch per formed batch beats "
-             "per-chunk round trips there; continuous batching wins "
-             "locally — measured both ways), 'on'/'off' force it",
+        "--fused-batch", choices=["auto", "on", "off"], default=None,
+        help="DEPRECATED, ignored (removal next release): fused "
+             "whole-batch generation folded into the scheduler's "
+             "typed units — fused-eligible batches now dispatch "
+             "tier-wide decode chunks through the unit queue "
+             "(--fused-single still gates the width ladder)",
     )
     parser.add_argument(
         "--default-deadline-ms", type=float, default=None,
@@ -799,6 +801,29 @@ def main(argv=None) -> None:
         help="dev loop: restart the server when package sources change",
     )
     args = parser.parse_args(argv)
+
+    import sys
+
+    # r20 migration notes — loud, once, at startup (not parser.error:
+    # existing deployments keep working through one release).
+    _argv = argv if argv is not None else sys.argv[1:]
+    if args.fused_batch is not None:
+        _log.warning(
+            "--fused-batch is DEPRECATED and ignored (removal next "
+            "release): fused whole-batch generation folded into the "
+            "scheduler's typed units — fused-eligible batches "
+            "dispatch tier-wide decode chunks through the unit "
+            "queue, so deadlines/disaggregation/brownout apply to "
+            "fused traffic too. Drop the flag; --fused-single still "
+            "gates the width ladder."
+        )
+    if "--scheduler" in _argv:
+        _log.warning(
+            "--scheduler is now the DEFAULT (the flag is redundant "
+            "and will be removed next release); --no-scheduler is "
+            "the one-release escape hatch pinning the legacy serial "
+            "semantics."
+        )
 
     if args.reload:
         import os
@@ -939,9 +964,6 @@ def main(argv=None) -> None:
         scheduler=args.scheduler,
         sched_max_batches=args.sched_max_batches,
         mesh=mesh,
-        fused_batch={"auto": "auto", "on": True, "off": False}[
-            args.fused_batch
-        ],
     )
     app = build_app(
         engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,

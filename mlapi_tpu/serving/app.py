@@ -960,13 +960,10 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.spec_accepted"] = (
                 engine.spec_accepted
             )
+            # One fused_calls tick per batch that dispatched at least
+            # one fused-width decode chunk (r20: the whole-generation
+            # programs are gone — fused traffic rides the unit queue).
             snap["counters"]["generate.fused_calls"] = engine.fused_calls
-            snap["counters"]["generate.fused_spec_calls"] = (
-                engine.fused_spec_calls
-            )
-            snap["counters"]["generate.fused_batch_calls"] = (
-                engine.fused_batch_calls
-            )
             # Page-native prefill + interleaving (r10). adopt_bytes is
             # exact dtype/shape arithmetic: 0 on the page-native path,
             # one full prefill copy per formation/admission on the
@@ -1022,16 +1019,14 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             snap["counters"]["generate.faults_injected"] = (
                 engine.faults_injected
             )
-            # Continuous-batching scheduler v2 (r15): per-unit-type
+            # Continuous-batching scheduler v2 (r15; default-on and
+            # the ONE execution model since r20): per-unit-type
             # dispatch counters over the typed-unit queue — the
             # counters the concurrency claims are asserted from
             # (interleaving = two lanes' units both moving in one
-            # window, never wall-clock). All zero with --scheduler
-            # off. sched_units_admit is RESERVED in the taxonomy but
-            # stays 0 for now: concurrent lanes supersede the legacy
-            # mid-batch admission staging (an arrival becomes its own
-            # lane instead of scattering into a running batch), so no
-            # admit units dispatch until in-lane admission returns.
+            # window, never wall-clock). sched_units_admit ticks as
+            # lanes install staged joiners at unit boundaries (the
+            # r20 in-lane admission path).
             snap["counters"]["generate.sched_units_prefill"] = (
                 engine.sched_units_prefill
             )
@@ -1062,6 +1057,14 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             )
             snap["gauges"]["generate.sched_batches_live_max"] = (
                 engine.sched_batches_live_max
+            )
+            # Cross-lane head-of-line bound (r20): the longest run of
+            # consecutive units one lane dispatched while another was
+            # live — ≤ the alternation floor means fused traffic
+            # stalls concurrent lanes by at most ONE fused-chunk
+            # dispatch.
+            snap["gauges"]["generate.sched_lane_stall_max"] = (
+                engine.sched_lane_stall_max
             )
             # Fleet pressure the fronting router last reported
             # (x-mlapi-router-depth; 0 for direct traffic).

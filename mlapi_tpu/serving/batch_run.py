@@ -92,7 +92,8 @@ class BatchRun:
     of extra device programs.
     """
 
-    def __init__(self, eng, reqs: list, admit: bool) -> None:
+    def __init__(self, eng, reqs: list, admit: bool,
+                 fused_ok: bool = True) -> None:
         self.eng = eng
         self.reqs = reqs  # the engine's list object: admission appends
         self.admit = admit
@@ -133,6 +134,14 @@ class BatchRun:
         while b_max < eng.max_batch:
             b_max *= 2
         self.b, self.b_pad, self.b_max = b, b_pad, b_max
+        # Fused-chunk width (r20): the top dispatch width for a batch
+        # of non-streaming rows — tier-wide decode chunks through the
+        # SAME decode-chunk program family, one schedulable unit per
+        # fused chunk (0 pins the plain ``eng.chunk``; warmup's
+        # chunked grid passes fused_ok=False to compile the plain
+        # widths deliberately).
+        self.fused_w = eng.fused.chunk_width(self) if fused_ok else 0
+        self._fused_counted = False
 
         (self.prompt, self.n_pad, self.temps, self.topk, self.topp,
          self.keys) = eng._pack_rows(reqs, self.bucket, b_pad)
@@ -1111,8 +1120,7 @@ class BatchRun:
                 # (same reason they never group at formation): defer
                 # to the collector's next batch.
                 self._unstage(cand)
-                with eng._alock:
-                    eng._deferred.append(cand)
+                eng._defer(cand)
                 continue
             if self.p_len or cand.prefix_fp is not None:
                 # Prefix rows batch only at FORMATION time (incl.
@@ -1123,8 +1131,7 @@ class BatchRun:
                 # prefix mirrors (yet). Defer to the collector's next
                 # batch.
                 self._unstage(cand)
-                with eng._alock:
-                    eng._deferred.append(cand)
+                eng._defer(cand)
                 continue
             bkt = len(cand.row)
             cp = eng.prompt_buckets[-1]
@@ -1146,8 +1153,7 @@ class BatchRun:
                 # leaving it staged would block compaction and
                 # backpressure for the whole run.
                 self._unstage(cand)
-                with eng._alock:
-                    eng._deferred.append(cand)
+                eng._defer(cand)
                 continue
             if n_live + 1 > eng.max_batch:
                 break
@@ -1210,8 +1216,7 @@ class BatchRun:
                     )
                 if blocked:
                     self._unstage(cand)
-                    with eng._alock:
-                        eng._deferred.append(cand)
+                    eng._defer(cand)
                     continue
             if not free and not grow:
                 break
@@ -1251,8 +1256,7 @@ class BatchRun:
                     # live sequences — hand the joiner to the next
                     # batch instead of killing this one.
                     self._unstage(cand)
-                    with eng._alock:
-                        eng._deferred.append(cand)
+                    eng._defer(cand)
                     continue
             # True once a call that DONATES the batch cache has been
             # entered: past that point a failure may have consumed the
@@ -1443,8 +1447,7 @@ class BatchRun:
         bkt, used = len(cand.row), cand.used
         if eng._strict_admit and (cp, self.npv) not in eng._warmed_extend:
             self._unstage(cand)
-            with eng._alock:
-                eng._deferred.append(cand)
+            eng._defer(cand)
             return False
         # All-pad leading chunks are skipped (nothing attends them):
         # the dispatched window covers ceil(used/cp) chunks.
@@ -1464,8 +1467,7 @@ class BatchRun:
             # Can never finish inside this batch's cache window —
             # the collector forms it into its own batch instead.
             self._unstage(cand)
-            with eng._alock:
-                eng._deferred.append(cand)
+            eng._defer(cand)
             return False
         used_rows = {
             self.rows[i] for i, r in enumerate(self.reqs)
@@ -1490,8 +1492,7 @@ class BatchRun:
             # The pool is momentarily full of live sequences: hand
             # the joiner to the next batch, pool left consistent.
             self._unstage(cand)
-            with eng._alock:
-                eng._deferred.append(cand)
+            eng._defer(cand)
             return False
         ptab[0, lo_tile:hi_tile] = pages
         self._unstage(cand)
@@ -1716,6 +1717,12 @@ class BatchRun:
             else jnp.int32(self.p_lo),
         )
         self.chain.push(toks, size, live)
+        if size > eng.chunk:
+            # A fused-width program compiled (or reused) for this
+            # exact shape: record it at the dispatch site, so strict
+            # mode's fused-width gate can never disagree with what
+            # actually compiled.
+            eng.fused.warmed.add((self.b_cur, self.total, size))
         for i in live:
             self.sched[i] += size
         self.step = self.step + np.int32(size)
@@ -1881,13 +1888,27 @@ class BatchRun:
                 yield "spec"
                 if self.done[0]:
                     continue
+            # Fused-chunk width (r20): an all-non-streaming batch
+            # dispatches tier-wide decode chunks — the r03 dispatch
+            # saving, one schedulable unit per fused chunk instead of
+            # one uninterruptible whole-generation program. The width
+            # shrinks to the live rows' remaining budgets and drops
+            # to the plain chunk while a streaming joiner is hosted
+            # (serving/fused_single.py owns the policy).
+            w = self.fused_w and eng.fused.width_at(self, live)
+            if w and not self._fused_counted:
+                # Once per batch, at the first fused-width dispatch —
+                # a strict-mode fallback that never engages must not
+                # count as a fused run.
+                self._fused_counted = True
+                eng.fused_calls += 1
             # The final chunk may be remainder-sized: when
             # max_positions clamps the cache tier, (total - bucket)
             # need not be a chunk multiple, and a window-edge request
             # is owed the partial chunk (the old whole-chunk stop
             # silently ran past the cache end and corrupted the tail
             # positions).
-            size = min(eng.chunk, self.total - self.pos)
+            size = min(w or eng.chunk, self.total - self.pos)
             if size <= 0:
                 chain.drain()
                 break  # cache exhausted — safety net below

@@ -152,8 +152,7 @@ class InferenceEngine:
         replica_role: str = "mixed",
         draft_checkpoint=None,
         spec_sample: bool = False,
-        fused_batch: bool | str = "auto",
-        scheduler: bool = False,
+        scheduler: bool = True,
         sched_max_batches: int = 2,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
@@ -353,7 +352,6 @@ class InferenceEngine:
                 mesh=mesh,
                 draft=draft,
                 spec_sample=spec_sample,
-                fused_batch=fused_batch,
                 kv_page_size=kv_page_size,
                 kv_pages=kv_pages,
                 prefill_page_native=prefill_page_native,
@@ -377,7 +375,7 @@ class InferenceEngine:
                          if kv_peer_fetch else {}),
                       **({"replica_role": replica_role}
                          if replica_role != "mixed" else {}),
-                      **({"scheduler": True} if scheduler else {}),
+                      **({} if scheduler else {"scheduler": False}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
@@ -405,12 +403,10 @@ class InferenceEngine:
                 f"(they split prefill from decode); "
                 f"{type(inner).__name__} has neither"
             )
-        if scheduler:
-            raise ValueError(
-                "scheduler applies to generative checkpoints (it "
-                f"interleaves decode batches); {type(inner).__name__} "
-                "has no decode loop"
-            )
+        # ``scheduler``/``sched_max_batches`` are generative-only
+        # knobs (they shape the decode unit queue) and default ON —
+        # classification checkpoints simply ignore them rather than
+        # forcing every caller to special-case the default.
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
         feature_names = meta.config.get("feature_names", feature_names)
@@ -632,7 +628,6 @@ class TextGenerationEngine:
         spec_sample: bool = False,
         fused_single: bool = True,
         fused_max_new: int | None = None,
-        fused_batch: bool | str = "auto",
         kv_page_size: int | None = None,
         kv_pages: int | None = None,
         prefill_page_native: bool = True,
@@ -642,7 +637,7 @@ class TextGenerationEngine:
         kv_peer_fetch: bool = False,
         kv_peer_timeout_s: float = 5.0,
         replica_role: str = "mixed",
-        scheduler: bool = False,
+        scheduler: bool = True,
         sched_max_batches: int = 2,
     ):
         if tokenizer.vocab_size > model.vocab_size:
@@ -692,44 +687,27 @@ class TextGenerationEngine:
         # (re-engagement shifts the draft's stream offsets) — hence a
         # deployment flag (--spec-sample), not a default.
         self.spec_sample = bool(spec_sample)
-        # Batch-1 fast path: a solo non-streaming request runs as ONE
-        # fused XLA program (prefill + whole decode loop — plus the
-        # draft rounds when speculating) instead of chunked dispatches.
-        # Through a high-RTT attach every dispatch costs ~one round
-        # trip whether or not its inputs are chained on device (the
-        # r03 measurements: chunked-chained 194 tok/s vs fused 861 on
-        # the tunneled chip — exactly one RTT per dispatch), so the
-        # only way to the single-stream RTT floor is one dispatch per
-        # GENERATION. ``fused_max_new`` caps the eligible budget —
-        # one fused run is one uninterruptible device program, so the
-        # cap bounds how long a joiner can wait behind it.
+        # Fused-chunk widths (r20, serving/fused_single.py): a batch
+        # of non-streaming rows decodes in TIER-WIDE chunks through
+        # the same decode-chunk program family — the r03 dispatch
+        # saving (through a high-RTT attach every dispatch costs ~one
+        # round trip, so fewer, wider chunks are the single-stream
+        # RTT lever), but at unit granularity: each fused chunk is
+        # one schedulable unit, so deadlines, speculation, brownout,
+        # faults, and drain apply between fused chunks and a
+        # concurrent lane stalls at most one fused-chunk dispatch
+        # (sched_lane_stall_max). The r03-r05 whole-generation fused
+        # programs (one uninterruptible dispatch per generation, with
+        # per-path deadline/disagg decline gates) are retired —
+        # BENCH_r16.json holds the measurement. ``fused_max_new``
+        # caps the WIDTH ladder, bounding the largest single
+        # dispatch; fused_single=False pins the plain ``chunk``.
         self.fused_single = bool(fused_single)
         self.fused_max_new = int(
             fused_max_new
             if fused_max_new is not None
             else max(64, default_max_new_tokens)
         )
-        # Batched fused policy: "auto" = engage only on a high-RTT
-        # attach, where one dispatch per batch beats per-chunk round
-        # trips; continuous batching wins on local attaches (measured
-        # — see FusedSinglePath.try_run_batch). Validated here so the
-        # run gate and the warm grid can never disagree on the value.
-        if fused_batch not in (True, False, "auto"):
-            raise ValueError(
-                f"fused_batch must be True, False, or 'auto'; got "
-                f"{fused_batch!r}"
-            )
-        # fused_single=False pins the chunked path entirely (the
-        # batched fused programs ride the solo path's warm grid and
-        # dispatch machinery), so an explicit fused_batch=True would
-        # be silently inert — reject the contradiction here rather
-        # than at serve time.
-        if fused_batch is True and not self.fused_single:
-            raise ValueError(
-                "fused_batch=True requires fused_single=True; "
-                "fused_single=False disables every fused program"
-            )
-        self.fused_batch = fused_batch
         if mesh is not None and getattr(
             model, "decode_attn_impl", "einsum"
         ) == "flash" and "model" in getattr(
@@ -897,6 +875,12 @@ class TextGenerationEngine:
         # Batcher state (started by the app's startup hook).
         self._queue: asyncio.Queue | None = None
         self._task: asyncio.Task | None = None
+        # Cross-thread collector wake: set from the scheduler's
+        # dispatch thread (lane retired, request deferred) via
+        # ``_wake_collector`` so staged work re-enters dispatch
+        # without waiting out the poll interval.
+        self._kick: asyncio.Event | None = None
+        self._aloop: asyncio.AbstractEventLoop | None = None
         # Continuous-batching handoff: requests the collector has
         # popped while a batch is RUNNING, waiting to be admitted at a
         # chunk boundary (decode thread) or swept into the next batch
@@ -939,8 +923,6 @@ class TextGenerationEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.fused_calls = 0
-        self.fused_spec_calls = 0
-        self.fused_batch_calls = 0
         # Page-native prefill + interleaving observability (r10). All
         # byte counters are exact dtype/shape arithmetic
         # (ops/quant.kv_tree_bytes), never wall-clock:
@@ -1018,20 +1000,24 @@ class TextGenerationEngine:
         self.deadline_expired_decode = 0
         self.brownout_spec_suppressed = 0
         self.brownout_tokens_clamped = 0
-        # Continuous-batching scheduler v2 (r15, serving/scheduler.py):
-        # one typed-unit queue (prefill chunk / decode chunk / spec
-        # round / admission / compaction) across up to
-        # ``sched_max_batches`` CONCURRENT BatchRuns, SLO-prioritized
-        # by deadline slack with TTFT/ITL targets fed from the
-        # LatencyStats reservoirs. Off (default): the one-live-batch
-        # collector loop, bit for bit. The scheduler object itself is
-        # created by start() and torn down by stop().
+        # Continuous-batching scheduler v2 (r15, serving/scheduler.py;
+        # DEFAULT-ON since r20 — the one execution model): one
+        # typed-unit queue (prefill chunk / decode chunk / spec round
+        # / admission / compaction) across up to ``sched_max_batches``
+        # CONCURRENT BatchRuns, SLO-prioritized by deadline slack
+        # with TTFT/ITL targets fed from the LatencyStats reservoirs.
+        # ``scheduler=False`` (--no-scheduler, one release's escape
+        # hatch) pins ONE lane — the legacy serial semantics (one
+        # live batch + in-lane admission) on the same machinery. The
+        # scheduler object itself is created by start() and torn down
+        # by stop().
         self.scheduler_enabled = bool(scheduler)
-        self.sched_max_batches = max(1, int(sched_max_batches))
+        self.sched_max_batches = (
+            max(1, int(sched_max_batches)) if scheduler else 1
+        )
         self.sched = None
         # Per-unit-type dispatch counters + queue observability
-        # (exported on /metrics as sched_*; all zero with the
-        # scheduler off).
+        # (exported on /metrics as sched_*).
         self.sched_units_prefill = 0
         self.sched_units_decode = 0
         self.sched_units_spec = 0
@@ -1040,6 +1026,13 @@ class TextGenerationEngine:
         self.sched_deadline_preempts = 0
         self.sched_pages_deferred = 0
         self.sched_batches_live_max = 0
+        # Largest run of consecutive units ONE lane dispatched while
+        # another lane was live — the cross-lane head-of-line bound
+        # (r10's interleave_max_stall generalized across batches).
+        # With fused-chunk widths folded into units, the design pins
+        # a concurrent lane's stall behind a fused batch at ONE
+        # fused-chunk dispatch; always counters, never wall-clock.
+        self.sched_lane_stall_max = 0
         # Router backpressure (r15 satellite): the fleet backlog the
         # router observed when it forwarded the last request here
         # (x-mlapi-router-depth, EXCLUDING this replica's own share).
@@ -1697,18 +1690,19 @@ class TextGenerationEngine:
 
     def _form_batch(self, reqs: list, admit: bool,
                     fused_ok: bool = True):
-        """The formation preamble shared by ``_run_batch``
-        (scheduler-off) and the unit scheduler's lane start — ONE
-        definition, because the scheduler-on/off identity contract
-        rests on both modes gating formation identically. Sweeps
-        queue-expired requests (terminal frame, never a device
-        dispatch), routes the fused whole-generation fast paths, and
-        returns the formed :class:`BatchRun` — or ``None`` when the
-        group fully resolved here (everyone expired, or a fused
-        program served it). Requests whose deadline passed during the
-        queue wait never reach the device; the sweep edits ``reqs``
-        in place (admission appends to this list object and error
-        delivery iterates it)."""
+        """The formation preamble shared by ``_run_batch`` and the
+        unit scheduler's lane start — ONE definition, because the
+        serial/concurrent identity contract rests on both gating
+        formation identically. Sweeps queue-expired requests
+        (terminal frame, never a device dispatch) and returns the
+        formed :class:`BatchRun` — or ``None`` when everyone expired.
+        Requests whose deadline passed during the queue wait never
+        reach the device; the sweep edits ``reqs`` in place
+        (admission appends to this list object and error delivery
+        iterates it). ``fused_ok=False`` pins the plain chunk width
+        (warmup's chunked grid compiles those shapes deliberately);
+        otherwise the fused-chunk width is decided per dispatch
+        boundary inside the run (``serving/fused_single.py``)."""
         from mlapi_tpu.serving.batch_run import BatchRun
 
         alive = [
@@ -1718,32 +1712,15 @@ class TextGenerationEngine:
             return None
         reqs[:] = alive
         self.batch_calls += 1
-        if fused_ok and self.fused_single:
-            if (
-                len(reqs) == 1
-                and reqs[0].prefix_len == 0 and not reqs[0].stream
-                and not reqs[0].cancelled
-                # Disaggregated requests pin the chunked lifecycle:
-                # a fused whole-generation program has no chunk
-                # boundary to push at, and a pushed-KV row has no
-                # prefill for the fused program to run.
-                and reqs[0].push_to is None and reqs[0].pushed is None
-                and self.fused.try_run(reqs[0], admit)
-            ):
-                return None
-            if len(reqs) > 1 and self.fused.try_run_batch(reqs, admit):
-                return None
-        return BatchRun(self, reqs, admit)
+        return BatchRun(self, reqs, admit, fused_ok)
 
     def _run_batch(self, reqs: list, admit: bool = False,
                    fused_ok: bool = True) -> None:
-        """Serve one coalesced batch: the fused whole-generation fast
-        paths first (``serving/fused_single.py`` — a solo request or a
-        whole formed batch as ONE XLA program on a high-RTT attach),
-        then the continuous-batch lifecycle, which lives in
-        ``serving/batch_run.py`` as :class:`BatchRun` (formation +
-        prefill, speculative handoff, mid-batch admission, compaction,
-        chained chunk decode — see that module's seam table).
+        """Serve one coalesced batch through the continuous-batch
+        lifecycle, which lives in ``serving/batch_run.py`` as
+        :class:`BatchRun` (formation + prefill, speculative handoff,
+        mid-batch admission, compaction, chained chunk decode at
+        plain or fused-chunk widths — see that module's seam table).
 
         Error delivery stays HERE: admission appends joiners to
         ``reqs`` in place, so a mid-batch failure is delivered to
@@ -1770,7 +1747,9 @@ class TextGenerationEngine:
     async def start(self) -> None:
         if self._task is None:
             self._queue = asyncio.Queue(maxsize=self.max_queue)
-            if self.scheduler_enabled and self.sched is None:
+            self._kick = asyncio.Event()
+            self._aloop = asyncio.get_running_loop()
+            if self.sched is None:
                 from mlapi_tpu.serving.scheduler import UnitScheduler
 
                 self.sched = UnitScheduler(
@@ -1779,6 +1758,31 @@ class TextGenerationEngine:
             self._task = asyncio.create_task(
                 self._collect_loop(), name="genbatcher"
             )
+
+    def _wake_collector(self) -> None:
+        """Nudge the collector out of its blocking waits (queue pop /
+        dispatch backoff) from ANY thread — lanes retire and requests
+        defer on the scheduler's dispatch thread, and the staged work
+        those events unblock must not sit until the 50 ms poll. Safe
+        before start() and after the loop dies (wakes are then moot:
+        stop()'s sweeps deliver everything)."""
+        loop, ev = self._aloop, self._kick
+        if loop is None or ev is None:
+            return
+        try:
+            loop.call_soon_threadsafe(ev.set)
+        except RuntimeError:
+            pass  # loop already closed — nothing left to wake
+
+    def _defer(self, cand) -> None:
+        """Park an admission candidate for the collector to reclaim
+        (lane incompatible / no room / pages exhausted) and wake it —
+        the ONE deferral seam for the 8 batch-run decline sites, so a
+        deferred request re-enters dispatch immediately instead of
+        riding the poll interval."""
+        with self._alock:
+            self._deferred.append(cand)
+        self._wake_collector()
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -1864,9 +1868,21 @@ class TextGenerationEngine:
         return p_len + bucket + n_new <= self.model.max_positions
 
     async def _collect_loop(self) -> None:
-        if self.sched is not None:
-            await self._collect_loop_sched()
-            return
+        """The ONE collector (r20): forms window-compatible groups
+        (deadline-slack carry seed, r12) and routes every formed
+        group through ``_dispatch_group`` — in-lane admission when a
+        live lane can take it at a unit boundary (continuous
+        batching), a new scheduler lane otherwise, a bounded wait
+        when neither has room. Serial mode (``--no-scheduler``) is
+        the SAME loop with ``sched_max_batches`` pinned to 1: one
+        live batch plus in-lane admission — the legacy collector's
+        semantics on the scheduler's machinery, which is why the
+        legacy scheduler-off loop could be deleted.
+
+        Backpressure: dispatch blocks (rule 3) while lanes and the
+        staging lists are full, which stops the pop below — stalled
+        arrivals then fill the bounded queue and shed as 503s, the
+        same ``max_queue`` contract as always."""
         loop = asyncio.get_running_loop()
         # self._carry (window-incompatible leftovers, served next) is
         # initialized in __init__ and cleared in the finally below —
@@ -1874,27 +1890,52 @@ class TextGenerationEngine:
         # iteration (or left by a crashed predecessor, already pushed
         # terminal frames) can never be silently dropped.
         reqs: list = []
-        get = None  # in-flight queue pop (outer so the finally sees it)
+        get = None   # in-flight queue pop (outer so the finally sees it)
+        kick = None  # in-flight kick wait (outer for the same reason)
         try:
             while True:
-                # Requests a running batch could not admit come first.
-                # They were staged independently, so re-apply the
-                # window-compatibility check and the max_batch cap
-                # when forming the batch from them (the sweep can
-                # hold many mutually-incompatible requests; batching
-                # them blindly would truncate the long ones and pad
-                # the device batch past the warmed grid).
+                # Clear-then-check: every wake source (deferral, lane
+                # retirement) mutates state BEFORE setting _kick, so a
+                # mutation landing after this clear re-sets the event
+                # and the waits below wake, while one landing before
+                # it is visible to this iteration's sweep.
+                self._kick.clear()
+                # Requests a lane could not take come first. They
+                # were staged independently, so re-apply the window
+                # compatibility check and the max_batch cap when
+                # forming from them. ``_admit`` holds staged
+                # candidates a LIVE lane may still take at its next
+                # unit boundary — reclaim those only once no batch is
+                # live (lane admission defers what it can never
+                # admit, so nothing camps there).
                 with self._alock:
-                    self._carry = (
-                        self._deferred + self._admit + self._carry
-                    )
+                    self._carry = self._deferred + self._carry
                     self._deferred.clear()
-                    self._admit.clear()
+                    if (
+                        self.sched is not None
+                        and self.sched.batches_live == 0
+                    ):
+                        self._carry = self._admit + self._carry
+                        self._admit.clear()
                 if self._carry:
-                    reqs = [self._carry[0]]
+                    # Deadline-slack pick (absolute deadlines compare
+                    # directly); deadline-less carries keep FIFO order
+                    # behind every deadlined one — the r12 ``_carry[0]``
+                    # head-of-line fix: a tight-deadline
+                    # window-incompatible request no longer waits
+                    # behind every earlier carried one.
+                    seed_i = min(
+                        range(len(self._carry)),
+                        key=lambda i: (
+                            self._carry[i].deadline is None,
+                            self._carry[i].deadline or 0.0,
+                            i,
+                        ),
+                    )
+                    reqs = [self._carry.pop(seed_i)]
                     self._forming = reqs
                     rest: list = []
-                    for r in self._carry[1:]:
+                    for r in self._carry:
                         if (
                             len(reqs) < self.max_batch
                             and self._compatible(reqs, r)
@@ -1904,17 +1945,50 @@ class TextGenerationEngine:
                             rest.append(r)
                     self._carry = rest
                 else:
-                    reqs = [await self._queue.get()]
-                    # No await between the pop resuming and this
-                    # assignment, so drain() can never observe the
-                    # claimed request in neither the queue nor here.
-                    self._forming = reqs
-                    self._carry = []
-                    # A fault here kills the COLLECTOR between
-                    # claiming a request and serving it — the finally
-                    # below must still deliver terminal frames to
-                    # everything claimed, queued, or staged.
-                    faults.fire("collector_pop")
+                    # Blocking pop, multiplexed with the cross-thread
+                    # kick: a deferral or lane retirement while the
+                    # queue is idle must re-enter the sweep above, not
+                    # wait for the next arrival.
+                    get = asyncio.ensure_future(self._queue.get())
+                    kick = asyncio.ensure_future(self._kick.wait())
+                    await asyncio.wait(
+                        {get, kick}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if get.done() and not get.cancelled():
+                        reqs = [get.result()]
+                        # No await between the pop resuming and this
+                        # assignment, so drain() can never observe the
+                        # claimed request in neither the queue nor
+                        # here.
+                        self._forming = reqs
+                        get = None
+                        # A fault here kills the COLLECTOR between
+                        # claiming a request and serving it — the
+                        # finally below must still deliver terminal
+                        # frames to everything claimed, queued, or
+                        # staged.
+                        faults.fire("collector_pop")
+                        kick.cancel()
+                        await asyncio.wait({kick})
+                        kick = None
+                    else:
+                        # The kick won (or an external cancel lands on
+                        # the wait above and propagates): retract the
+                        # pop without dropping an item it claims in
+                        # the same instant — the same race-free dance
+                        # as the fill window below.
+                        kick.cancel()
+                        await asyncio.wait({kick})
+                        kick = None
+                        get.cancel()
+                        await asyncio.wait({get})
+                        if get.cancelled():
+                            get = None
+                            continue  # re-sweep staged work
+                        reqs = [get.result()]
+                        self._forming = reqs
+                        get = None
+                        faults.fire("collector_pop")
                 if self.max_wait_s > 0:
                     deadline = loop.time() + self.max_wait_s
                     while len(reqs) < self.max_batch:
@@ -1959,56 +2033,7 @@ class TextGenerationEngine:
                         else:
                             self._carry.append(nxt)
                             break
-                # One batch decodes at a time (single device stream).
-                # While it runs, keep draining arrivals into the
-                # admission list: the decode loop takes compatible
-                # ones at chunk boundaries (continuous batching); the
-                # rest are swept into the next batch above.
-                fut = loop.run_in_executor(None, self._run_batch, reqs, True)
-                while not fut.done():
-                    # Backpressure: once a full batch's worth of
-                    # requests is staged for admission, STOP draining
-                    # the bounded queue — otherwise `_admit` would
-                    # grow without bound during a long batch and
-                    # `max_queue` would stop meaning anything. Stalled
-                    # arrivals then fill the queue and shed as 503s.
-                    with self._alock:
-                        backlog = len(self._admit) + len(self._deferred)
-                    if backlog >= self.max_batch:
-                        await asyncio.wait({fut}, timeout=0.05)
-                        continue
-                    get = asyncio.ensure_future(self._queue.get())
-                    # noqa: the outer `get` keeps the last pop visible
-                    # to the finally below — a cancel mid-wait must
-                    # not strand a request the pop already claimed.
-                    await asyncio.wait(
-                        {fut, get}, return_when=asyncio.FIRST_COMPLETED
-                    )
-                    if get.done() and not get.cancelled():
-                        with self._alock:
-                            self._admit.append(get.result())
-                        get = None
-                    else:
-                        get.cancel()
-                        # ``asyncio.wait`` never re-raises the CHILD's
-                        # cancellation into the waiter, so our own
-                        # cancel of the pop stays silent on every
-                        # Python version, while an EXTERNAL cancel
-                        # (stop(), or a simulated collector death)
-                        # lands on this await and propagates. This
-                        # replaces a py3.11-only Task.cancelling()
-                        # disambiguation — on 3.10 that crashed the
-                        # collector with AttributeError, and any
-                        # flag-based fallback either deadlocks stop()
-                        # or un-cancels a killed collector.
-                        await asyncio.wait({get})
-                        if not get.cancelled():
-                            # get won the race with our cancel: the
-                            # queue item is in hand — keep it.
-                            with self._alock:
-                                self._admit.append(get.result())
-                        get = None
-                await fut
+                await self._dispatch_group(reqs)
                 reqs = []
                 self._forming = None
         finally:
@@ -2018,7 +2043,11 @@ class TextGenerationEngine:
             # queue NOR those still queued or awaiting admission (a
             # handler awaiting ``gen.queue.get()`` on a queued request
             # would otherwise hang forever after an unexpected
-            # collector death).
+            # collector death). What was handed to the scheduler is
+            # the scheduler's to deliver: its stop() sweeps lanes and
+            # pending groups.
+            if kick is not None:
+                kick.cancel()
             err = RuntimeError("generation engine stopped")
             queued = []
             if get is not None:
@@ -2040,153 +2069,56 @@ class TextGenerationEngine:
                     pass
             self._carry = []
 
-    async def _collect_loop_sched(self) -> None:
-        """The collector with the unit scheduler in front: forms
-        window-compatible groups exactly like the legacy loop but
-        NEVER blocks on a running batch — each formed group hands off
-        to :class:`~mlapi_tpu.serving.scheduler.UnitScheduler` (up to
-        ``sched_max_batches`` concurrent BatchRuns, interleaved at
-        unit granularity) and collection continues immediately, so
-        bucket-incompatible traffic runs concurrently instead of
-        taking serial ``_carry`` turns. Differences from the legacy
-        loop, on purpose:
+    async def _dispatch_group(self, reqs: list) -> None:
+        """Route one formed group, preferring the cheapest seat:
 
-        - The ``_admit``/``_deferred`` staging lists stay empty here —
-          an arrival that would have joined a RUNNING batch forms (or
-          joins) a new group and the scheduler interleaves the
-          batches' units (so ``sched_units_admit`` reads 0: reserved
-          in the taxonomy until in-lane admission returns). The
-          window-fill and terminal-frame sweep below deliberately
-          MIRROR the legacy loop line for line rather than sharing a
-          helper: the wait/cancel dance's race comments there are
-          load-bearing, and only the legacy loop multiplexes the pop
-          against a running batch future — keep the two in sync when
-          touching either.
-        - The carry seed is picked by DEADLINE SLACK, not FIFO — the
-          r12 ``_carry[0]`` head-of-line fix: a tight-deadline
-          window-incompatible request no longer waits behind every
-          earlier carried one.
-        - Backpressure: the scheduler's pending backlog is bounded at
-          one ``max_batch`` like ``_admit`` was, so ``max_queue``
-          keeps meaning something during long runs."""
-        loop = asyncio.get_running_loop()
-        reqs: list = []
-        get = None  # in-flight queue pop (outer so the finally sees it)
-        try:
-            while True:
-                with self._alock:
-                    self._carry = (
-                        self._deferred + self._admit + self._carry
-                    )
-                    self._deferred.clear()
-                    self._admit.clear()
-                if self._carry:
-                    # Deadline-slack pick (absolute deadlines compare
-                    # directly); deadline-less carries keep FIFO order
-                    # behind every deadlined one.
-                    seed_i = min(
-                        range(len(self._carry)),
-                        key=lambda i: (
-                            self._carry[i].deadline is None,
-                            self._carry[i].deadline or 0.0,
-                            i,
-                        ),
-                    )
-                    reqs = [self._carry.pop(seed_i)]
-                    self._forming = reqs
-                    rest: list = []
-                    for r in self._carry:
-                        if (
-                            len(reqs) < self.max_batch
-                            and self._compatible(reqs, r)
-                        ):
-                            reqs.append(r)
-                        else:
-                            rest.append(r)
-                    self._carry = rest
-                else:
-                    reqs = [await self._queue.get()]
-                    # No await between the pop resuming and this
-                    # assignment (drain visibility — same contract as
-                    # the legacy loop).
-                    self._forming = reqs
-                    faults.fire("collector_pop")
-                if self.max_wait_s > 0:
-                    deadline = loop.time() + self.max_wait_s
-                    while len(reqs) < self.max_batch:
-                        timeout = deadline - loop.time()
-                        if timeout <= 0:
-                            break
-                        # Same race-free wait/cancel dance as the
-                        # legacy loop (see its comments for why NOT
-                        # asyncio.wait_for).
-                        get = asyncio.ensure_future(self._queue.get())
-                        done, _ = await asyncio.wait(
-                            {get}, timeout=timeout
-                        )
-                        if not done:
-                            get.cancel()
-                            await asyncio.wait({get})
-                            if get.cancelled():
-                                get = None
-                                break
-                        nxt = get.result()
-                        get = None
-                        if self._compatible(reqs, nxt):
-                            reqs.append(nxt)
-                        else:
-                            self._carry.append(nxt)
-                            break  # keep the window short
-                else:
-                    while (
-                        len(reqs) < self.max_batch
-                        and not self._queue.empty()
+        1. IN-LANE ADMISSION — a live lane whose window fits every
+           request takes the group at its next unit boundary (the
+           continuous-batching growth path: no new lane, no extra
+           prefill program beyond the r10 interleave). Staging is
+           once-only (``GenRequest.staged``): a candidate the lane
+           then defers re-enters HERE and takes a lane of its own
+           instead of ping-ponging between the lists.
+        2. PENDING GROUP — hand off to the scheduler, which lanes it
+           when a slot and the page budget allow, in deadline-slack
+           order; its units then interleave with the other lanes' at
+           the typed-unit queue. Bounded at one ``max_batch`` of
+           pending requests, so ``max_queue`` keeps meaning something
+           during long runs.
+        3. WAIT — staging and backlog both full: block on the kick
+           (lane retirement / deferral) with a 50 ms poll backstop,
+           then re-check. The group stays in ``self._forming`` the
+           whole time, so drain() and the terminal-frame sweep always
+           see it.
+        """
+        while True:
+            sched = self.sched
+            if sched is None:
+                raise RuntimeError("scheduler stopped")
+            self._kick.clear()
+            with self._alock:
+                room = (
+                    self.max_batch - len(self._admit) - len(self._deferred)
+                    >= len(reqs)
+                )
+            if room and all(not r.staged for r in reqs):
+                for lane_reqs in sched.lane_groups():
+                    if lane_reqs and all(
+                        self._compatible(lane_reqs, r) for r in reqs
                     ):
-                        nxt = self._queue.get_nowait()
-                        if self._compatible(reqs, nxt):
-                            reqs.append(nxt)
-                        else:
-                            self._carry.append(nxt)
-                            break
-                # Bounded handoff: once a full batch's worth of formed
-                # requests is pending in the scheduler, stop draining
-                # the bounded queue — stalled arrivals then fill it
-                # and shed as 503s, exactly like the _admit bound.
-                while (
-                    self.sched is not None
-                    and self.sched.backlog >= self.max_batch
-                ):
-                    await asyncio.sleep(0.005)
-                if self.sched is None:
-                    raise RuntimeError("scheduler stopped")
-                self.sched.submit(reqs)
-                reqs = []
-                self._forming = None
-        finally:
-            self._forming = None
-            # Terminal frames for everything claimed, queued, or
-            # carried (the scheduler's own stop() handles what was
-            # already handed to it).
-            err = RuntimeError("generation engine stopped")
-            queued = []
-            if get is not None:
-                if get.done() and not get.cancelled():
-                    queued.append(get.result())
-                else:
-                    get.cancel()
-            if self._queue is not None:
-                while not self._queue.empty():
-                    queued.append(self._queue.get_nowait())
-            with self._alock:
-                queued += self._admit + self._deferred
-                self._admit.clear()
-                self._deferred.clear()
-            for r in (*reqs, *self._carry, *queued):
-                try:
-                    r.push(err)
-                except Exception:
-                    pass
-            self._carry = []
+                        for r in reqs:
+                            r.staged = True
+                        with self._alock:
+                            self._admit.extend(reqs)
+                        return
+            if sched.backlog < self.max_batch:
+                sched.submit(reqs)
+                return
+            waiter = asyncio.ensure_future(self._kick.wait())
+            try:
+                await asyncio.wait({waiter}, timeout=0.05)
+            finally:
+                waiter.cancel()
 
     async def submit(
         self,
@@ -2439,8 +2371,8 @@ class TextGenerationEngine:
                     )
                     sinks.append(_SyncSink(req, []))
                 # fused_ok=False: the warm grid exists to compile the
-                # CHUNKED programs (prefill/decode/compaction); the
-                # fused fast path has its own grid below.
+                # PLAIN-chunk programs (prefill/decode/compaction);
+                # the fused-chunk width ladder has its own grid below.
                 self._run_batch(sinks, fused_ok=False)
                 if sinks[0].error is not None:
                     raise sinks[0].error

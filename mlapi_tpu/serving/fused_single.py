@@ -1,40 +1,65 @@
-"""The batch-1 fused single-stream fast path of generative serving.
+"""Fused-chunk width policy for generative serving (r20).
 
-One :class:`FusedSinglePath` per :class:`TextGenerationEngine`: it
-owns the warmed-shape set and decides, per solo non-streaming request,
-whether the WHOLE generation runs as one XLA program
-(``models.gpt.generate_tier_fn`` / ``ops.speculative.fused_spec_fn``)
-instead of chunked dispatches — the single-stream RTT-floor lever
-through a high-RTT attach. Split out of ``engine.py`` (r04 VERDICT
-"Next" #7); the eligibility and byte-identity contract is documented
-on :meth:`try_run`.
+One :class:`FusedSinglePath` per :class:`TextGenerationEngine`. Up to
+r15 this module dispatched a solo (or whole-batch) generation as ONE
+uninterruptible XLA program — the r03 RTT-floor lever — which meant
+declining deadlines (r12) and disaggregation (r18) at per-path gates
+and blocking every concurrent scheduler lane for a whole generation.
+r20 folds that dispatch saving into the typed-unit execution model
+instead: a fused-eligible batch decodes through the SAME
+``decode_chunk_fn`` the chunked path uses, just at TIER-WIDE chunk
+sizes, so each fused chunk is one ``"decode"`` unit yielded at
+``BatchRun.units()`` boundaries. Deadlines, speculation, brownout,
+faults, roles, and drain all apply to fused traffic through that one
+seam, and a concurrent lane's head-of-line stall drops from a whole
+generation to one fused-chunk dispatch
+(``engine.sched_lane_stall_max`` pins it from counters).
+
+The retired whole-generation serving paths (``try_run`` /
+``try_run_batch`` and their warm grids) are measured against this
+fold in ``bench.py::_sched_report`` (BENCH_r16.json):
+``generate_tier_fn`` / ``fused_spec_fn`` remain available as LIBRARY
+entry points (``ops/speculative.py``, ``models/gpt.py``) but the
+serving engine no longer routes requests to them.
+
+What remains here is the WIDTH POLICY:
+
+- :meth:`tiers` — the fused width ladder (unchanged from r03/r04).
+- :meth:`chunk_width` — formation-time decision: the batch's top
+  fused width, 0 to pin the plain ``eng.chunk``.
+- :meth:`width_at` — per-boundary width: shrinks to the smallest
+  power-of-two-of-chunk covering the live rows' remaining budgets
+  (bounded program count), drops to the plain chunk while a
+  streaming row is live (incremental delivery) and, in strict mode,
+  for any (batch, cache, width) shape the warm grid did not compile.
+- :meth:`warm` — drives real solo runs at ladder budgets so the
+  fused-width decode-chunk programs compile off the request path;
+  the warmed set itself is populated at the dispatch site
+  (``BatchRun._decode_chunk``), so it can never disagree with what
+  actually compiled.
 """
 
 from __future__ import annotations
-
-import jax.numpy as jnp
-import numpy as np
 
 
 class FusedSinglePath:
     def __init__(self, engine):
         self.eng = engine
-        # (bucket, tier, "plain"|"spec"|"spec_sampled") fused programs
-        # proven compiled — strict mode takes the fast path only for
-        # these (an unwarmed fused shape falls back to the chunked
-        # programs rather than stalling on a remote compile).
+        # (b_cur, total, width) fused-width decode-chunk programs
+        # proven compiled (recorded at the dispatch site) — strict
+        # mode takes a fused width only for these; an unwarmed shape
+        # falls back to the plain chunk rather than stalling a
+        # concurrent lane on a remote compile.
         self.warmed: set = set()
 
     def tiers(self) -> list:
-        """The fused-program output-tier ladder, ascending: powers of
-        two (of ``chunk``) from the DEFAULT budget's tier up to the
-        ``fused_max_new`` cap's. The floor is the default tier because
-        ``n_actual`` is traced — the default-tier program already
-        serves every smaller budget, so smaller tiers would only
+        """The fused width ladder, ascending: powers of two (of
+        ``chunk``) from the DEFAULT budget's tier up to the
+        ``fused_max_new`` cap's. The floor is the default tier
+        because smaller budgets shrink per boundary via
+        :meth:`width_at` — extra rungs below the default would only
         multiply compiles. ONE definition shared by the request path
-        (``try_run``) and the warm grid (``warm``):
-        strict mode silently falls back to chunked on a warm-set miss,
-        so the two must be tier-identical by construction."""
+        (:meth:`chunk_width`) and the warm grid (:meth:`warm`)."""
         eng = self.eng
         t = eng.default_tier
         tiers = [t]
@@ -43,379 +68,93 @@ class FusedSinglePath:
             tiers.append(t)
         return tiers
 
-    def _spec_headroom(self, bucket: int, tier: int):
-        """Fused speculation's window check, ONE definition for the
-        run paths and the warm grids (strict mode rejects any shape
-        the warm grid skipped, so eligibility must match exactly):
-        returns ``(fits, k)`` where ``k`` is the per-tier draft depth
-        and ``fits`` says ``bucket + tier + k + 1`` slots fit BOTH
-        model windows."""
+    def chunk_width(self, run) -> int:
+        """Formation-time fused width for ``run``: the smallest
+        ladder tier covering the batch's token budget (the largest
+        rung when the budget exceeds ``fused_max_new`` — the cap now
+        bounds the DISPATCH width, not eligibility, so oversized
+        budgets ride fused chunks instead of declining). 0 pins the
+        plain ``eng.chunk``: the path is off, the batch hosts a
+        streaming consumer at formation (incremental delivery — a
+        joiner arriving later drops the width per boundary instead),
+        or the ladder would not beat the plain chunk anyway."""
         eng = self.eng
-        k = max(1, min(eng.spec_k, tier))
-        need = bucket + tier + k + 1
-        fits = (
-            eng.draft_model is not None
-            and need <= eng.model.max_positions
-            and need <= eng.draft_model.max_positions
-        )
-        return fits, k
+        if not eng.fused_single:
+            return 0
+        if any(r.stream for r in run.reqs):
+            return 0
+        w = eng.chunk
+        for t in self.tiers():
+            w = t
+            if t >= run.n_new_max:
+                break
+        return w if w > eng.chunk else 0
 
-    def try_run(self, r, admit: bool) -> bool:
-        """Batch-1 fast path: run ``r``'s WHOLE generation as one XLA
-        program (``generate_tier_fn``, or ``fused_spec_fn`` with the
-        draft) — one dispatch + one readback, the single-stream RTT
-        floor through a tunneled attach. Returns ``False`` to fall
-        through to the chunked path: streaming consumers, prefix rows,
-        long (chunked-prefill) prompts, budgets past ``fused_max_new``,
-        deadlined requests, unwarmed shapes in strict mode, and
-        batches with staged joiners all decode chunked exactly as
-        before. The emitted
-        stream is byte-identical to the chunked path (same pads, same
-        per-token PRNG stream indices; greedy speculation is
-        argmax-exact), so which path served a request is invisible in
-        the response.
-
-        One fused run is one uninterruptible device program — a
-        request arriving mid-run waits for it (bounded by
-        ``fused_max_new``), the price of removing per-chunk
-        dispatches. Mirrors the host spec phase's yield discipline at
-        ENTRY instead: staged admission candidates suppress the fast
-        path entirely.
-        """
+    def width_at(self, run, live: list) -> int:
+        """Per-boundary dispatch width for a fused batch: the
+        smallest power of two of ``chunk`` covering the live rows'
+        remaining budgets, capped at the formation width — the tail
+        of a generation never dispatches (and never page-allocates)
+        wider than it can use, and the program count stays
+        logarithmic. Falls back to the plain chunk (returns 0) while
+        a streaming row is live, and in strict (tunnel) mode for any
+        (batch width, cache length, width) shape not proven compiled
+        — those widths compile on demand only where a compile is
+        cheap."""
         eng = self.eng
-        # A deadlined request needs the chunked path's per-boundary
-        # expiry checks — one fused run is one uninterruptible device
-        # program with no boundary to check at, so a blown budget
-        # would still return 200 with the full completion.
-        if r.deadline is not None:
-            return False
-        if admit:
-            with eng._alock:
-                if eng._admit or eng._deferred:
-                    return False
-        bucket = len(r.row)
-        if bucket > eng.prompt_buckets[-1]:
-            return False  # chunked-prefill territory
-        n_new = r.n_new
-        if n_new > eng.fused_max_new:
-            return False
-        tier = next(t for t in self.tiers() if t >= n_new)
-        greedy = (
-            r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
-        )
-        fits, k = self._spec_headroom(bucket, tier)
-        spec = fits and (
-            greedy or (eng.spec_sample and r.temperature > 0.0)
-        )
-        if not spec and bucket + tier > eng.model.max_positions:
-            return False
-        # Greedy and sampled speculation are DIFFERENT compiled
-        # programs (``sampled`` is static in ``fused_spec_fn``) —
-        # strict warm-gating must distinguish them.
-        kind = (
-            "plain" if not spec
-            else ("spec_sampled" if r.temperature > 0.0 else "spec")
-        )
+        reqs = run.reqs
+        if any(reqs[i].stream for i in live):
+            return 0
+        need = max(reqs[i].n_new - run.sched[i] for i in live)
+        w = eng.chunk
+        while w < need:
+            w *= 2
+        w = min(w, run.fused_w)
+        if w <= eng.chunk:
+            return 0
         if (
             eng._strict_admit
-            and (bucket, tier, kind) not in self.warmed
+            and (run.b_cur, run.total, w) not in self.warmed
         ):
-            return False
-
-        from mlapi_tpu.models.gpt import generate_tier_fn
-
-        row = jnp.asarray(np.asarray(r.row)[None])
-        kd = jnp.asarray(eng._key_data(r.seed)[None])
-        temps = jnp.asarray(np.asarray([r.temperature], np.float32))
-        topk = jnp.asarray(np.asarray([r.top_k], np.int32))
-        topp = jnp.asarray(np.asarray([r.top_p], np.float32))
-        n_pad = jnp.asarray(np.asarray([bucket - r.used], np.int32))
-        if spec:
-            from mlapi_tpu.ops.speculative import fused_spec_fn
-
-            packed = np.asarray(
-                fused_spec_fn(
-                    eng.model, eng.draft_model, bucket, tier, k,
-                    r.temperature > 0.0,
-                )(
-                    eng.params, eng.draft_params, row, kd, temps,
-                    topk, topp, n_pad, jnp.int32(n_new),
-                )
-            )
-            ids = packed[:n_new]
-            eng.spec_rounds += int(packed[tier])
-            eng.spec_accepted += int(packed[tier + 1])
-            eng.spec_drafted += int(packed[tier + 2])
-            eng.fused_spec_calls += 1
-        else:
-            ids = np.asarray(
-                generate_tier_fn(eng.model, tier)(
-                    eng.params, row, kd, temps, n_pad, topk, topp,
-                    jnp.int32(n_new),
-                )
-            )[0, :n_new]
-            eng.fused_calls += 1
-        self.warmed.add((bucket, tier, kind))
-        if not r.cancelled:
-            r.push({"token_ids": ids.tolist()})
-            r.push(None)
-        return True
-
-    def try_run_batch(self, reqs, admit: bool) -> bool:
-        """A whole FORMED batch as one XLA program: ``generate_tier_fn``
-        is batch-polymorphic (per-row traced budgets, per-row PRNG
-        streams), so a collector batch of plain non-streaming requests
-        costs ONE dispatch + ONE readback — through a high-RTT attach
-        that replaces (max_budget / chunk) chunk dispatches with one
-        round trip for all rows. With a draft attached, an all-greedy
-        (or, under ``--spec-sample``, all-sampled) batch runs the
-        whole BATCHED SPECULATION as one program instead
-        (``fused_spec_batched_fn`` — vs the host batched phase's two
-        dispatches per round). Returns ``False`` to fall through to
-        continuous batching: streams, prefix rows, deadlined rows,
-        mixed greedy/sampled draft batches, long prompts, over-cap
-        budgets, staged joiners, and unwarmed shapes in strict mode. Each
-        row's stream stays byte-identical to its solo run (per-row
-        fold_in streams), so which path served a batch is invisible.
-        """
-        eng = self.eng
-        # Attach-dependent policy, measured both ways: on a HIGH-RTT
-        # attach one dispatch per batch beats per-chunk round trips
-        # (the tunnel economics); on a LOW-RTT attach the atomic fused
-        # batch blocks continuous admission and LOSES to chunked
-        # continuous batching (CPU: 4,347 tok/s fused-batched vs
-        # ~5,8-7,2k chunked at c8, and HOLB short-latency 27 ms vs 7).
-        # ``fused_batch="auto"`` therefore engages only when the
-        # dispatch RTT is tunnel-like; True/False force it for tests
-        # and deployments that know better.
-        batched_on = eng.fused_batch is True or (
-            eng.fused_batch == "auto" and not eng._admit_eager
-        )
-        if not batched_on:
-            return False
-        if admit:
-            with eng._alock:
-                if eng._admit or eng._deferred:
-                    return False
-        if any(
-            r.stream or r.cancelled or r.prefix_len
-            or r.deadline is not None
-            for r in reqs
-        ):
-            return False
-        bucket = max(len(r.row) for r in reqs)
-        if bucket > eng.prompt_buckets[-1]:
-            return False
-        n_max = max(r.n_new for r in reqs)
-        if n_max > eng.fused_max_new:
-            return False
-        tier = next(t for t in self.tiers() if t >= n_max)
-        # With a draft attached, the batch speculates as a whole —
-        # fused_spec_batched_fn, the last cell of the fused matrix —
-        # when every row is greedy (or, under --spec-sample, every
-        # row sampled; ``sampled`` is static in the program). Mixed
-        # batches and no-headroom windows fall through to the host
-        # phases.
-        spec = False
-        sampled = False
-        fits, k = self._spec_headroom(bucket, tier)
-        if eng.draft_model is not None:
-            all_greedy = all(
-                r.temperature <= 0.0 and r.top_k == 0 and r.top_p >= 1.0
-                for r in reqs
-            )
-            uniform_sampled = all(r.temperature > 0.0 for r in reqs)
-            all_sampled = eng.spec_sample and uniform_sampled
-            if fits and (all_greedy or all_sampled):
-                spec = True
-                sampled = all_sampled and not all_greedy
-            elif not (all_greedy or uniform_sampled):
-                # Genuinely MIXED greedy/sampled: ``sampled`` is
-                # static per program — the host batched-spec /
-                # chunked paths serve it.
-                return False
-            # No spec headroom — or a homogeneous sampled batch with
-            # spec_sample off (speculation can't serve it, but the
-            # plain program can, exactly like the solo path): degrade
-            # to the plain fused-batched program — one dispatch still
-            # beats the host loop through a tunnel.
-        if not spec and bucket + tier > eng.model.max_positions:
-            return False
-        b = len(reqs)
-        b_pad = 1
-        while b_pad < b:
-            b_pad *= 2
-        kind = (
-            f"spec_batched{'_s' if sampled else ''}{b_pad}"
-            if spec else f"batched{b_pad}"
-        )
-        if (
-            eng._strict_admit
-            and (bucket, tier, kind) not in self.warmed
-        ):
-            return False
-
-        prompt, n_pad, temps, topk, topp, keys = eng._pack_rows(
-            reqs, bucket, b_pad
-        )
-        n_vec = np.ones((b_pad,), np.int32)  # dummy rows: 1 token
-        for i, r in enumerate(reqs):
-            n_vec[i] = r.n_new
-        if spec:
-            from mlapi_tpu.ops.speculative import fused_spec_batched_fn
-
-            packed = np.asarray(
-                fused_spec_batched_fn(
-                    eng.model, eng.draft_model, bucket, tier, k, sampled
-                )(
-                    eng.params, eng.draft_params, jnp.asarray(prompt),
-                    jnp.asarray(keys), jnp.asarray(temps),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                    jnp.asarray(n_pad), jnp.asarray(n_vec),
-                )
-            )
-            out = packed[:, :tier]
-            eng.spec_rounds += int(packed[0, tier])
-            eng.spec_accepted += int(packed[:b, tier + 1].sum())
-            eng.spec_drafted += int(packed[:b, tier + 2].sum())
-        else:
-            from mlapi_tpu.models.gpt import generate_tier_fn
-
-            out = np.asarray(
-                generate_tier_fn(eng.model, tier)(
-                    eng.params, jnp.asarray(prompt), jnp.asarray(keys),
-                    jnp.asarray(temps), jnp.asarray(n_pad),
-                    jnp.asarray(topk), jnp.asarray(topp),
-                    jnp.asarray(n_vec),
-                )
-            )
-        self.warmed.add((bucket, tier, kind))
-        eng.fused_batch_calls += 1
-        for i, r in enumerate(reqs):
-            if not r.cancelled:
-                r.push({"token_ids": out[i, : r.n_new].tolist()})
-                r.push(None)
-        return True
+            return 0
+        return w
 
     def warm(self, full: bool) -> int:
-        """Compile the batch-1 fused-generation grid off the request
-        path: per prompt bucket, the whole-generation program at the
-        default-``max_new_tokens`` tier and at the ``fused_max_new``
-        tier (one program serves every budget in a tier — ``n_actual``
-        is traced), plus the fused speculation program when a draft is
-        attached. Executed with ``n_actual=1`` so the warm run costs
-        one prefill + one loop iteration, not a full generation.
-        Populates ``self.warmed``, which strict mode requires."""
+        """Compile the fused-width decode-chunk ladder off the
+        request path by running REAL solo batches (``_run_batch``
+        with ``fused_ok=True``) at each ladder budget — the exact
+        programs fused traffic dispatches, recorded into ``warmed``
+        at the dispatch site. Minimal warmup covers the first bucket;
+        full covers every bucket at the default tier's ladder plus
+        the larger tiers on the first bucket (wider multi-row shapes
+        fall back to the plain chunk in strict mode — already warm).
+        Returns the shape count for the warmup log."""
+        import numpy as np
+
+        from mlapi_tpu.serving.requests import GenRequest, _SyncSink
+
         eng = self.eng
-        from mlapi_tpu.models.gpt import generate_tier_fn
-
-        tiers = self.tiers()
         buckets = eng.prompt_buckets if full else eng.prompt_buckets[:1]
-        kd = jnp.asarray(eng._key_data(0)[None])
-        z1f = jnp.zeros((1,), jnp.float32)
-        z1i = jnp.zeros((1,), jnp.int32)
-        o1f = jnp.ones((1,), jnp.float32)
-        # Batched-fused grid: power-of-two batch sizes at the DEFAULT
-        # tier only (whole-generation compiles are the most expensive
-        # programs in the warmup; larger tiers stay chunked in strict
-        # mode rather than doubling the grid). Only warmed where the
-        # batched path can actually engage — ``try_run_batch``'s
-        # attach policy — so a local attach doesn't pay the compiles.
-        batch_sizes = []
-        batched_on = eng.fused_batch is True or (
-            eng.fused_batch == "auto" and not eng._admit_eager
-        )
-        if full and batched_on and eng.max_batch > 1:
-            bsz = 2
-            while bsz <= 1 << (eng.max_batch - 1).bit_length():
-                batch_sizes.append(bsz)
-                bsz *= 2
+        # Ladder budgets: every power-of-two width width_at can pick
+        # below the default tier, plus each full tier rung.
+        widths = []
+        w = 2 * eng.chunk
+        while w <= eng.default_tier:
+            widths.append(w)
+            w *= 2
         shapes = 0
-        for bucket in buckets:
-            row = jnp.asarray(
-                np.full((1, bucket), eng.tokenizer.pad_id, np.int32)
-            )
-            n_pad = jnp.asarray(np.asarray([bucket - 1], np.int32))
-            for tier in sorted(tiers):
-                if bucket + tier <= eng.model.max_positions:
-                    generate_tier_fn(eng.model, tier)(
-                        eng.params, row, kd, z1f, n_pad, z1i, o1f,
-                        jnp.int32(1),
-                    )
-                    self.warmed.add((bucket, tier, "plain"))
-                    shapes += 1
-                    if tier == tiers[0]:
-                        for bsz in batch_sizes:
-                            rows_b = jnp.asarray(np.broadcast_to(
-                                np.asarray(row), (bsz, bucket)
-                            ).copy())
-                            keys_b = jnp.asarray(np.stack(
-                                [eng._key_data(0)] * bsz
-                            ))
-                            zb_f = jnp.zeros((bsz,), jnp.float32)
-                            zb_i = jnp.zeros((bsz,), jnp.int32)
-                            ob_f = jnp.ones((bsz,), jnp.float32)
-                            npad_b = jnp.asarray(np.full(
-                                (bsz,), bucket - 1, np.int32
-                            ))
-                            ones_b = jnp.asarray(
-                                np.ones((bsz,), np.int32)
-                            )
-                            generate_tier_fn(eng.model, tier)(
-                                eng.params, rows_b, keys_b, zb_f,
-                                npad_b, zb_i, ob_f, ones_b,
-                            )
-                            self.warmed.add(
-                                (bucket, tier, f"batched{bsz}")
-                            )
-                            shapes += 1
-                            fits_b, k = self._spec_headroom(
-                                bucket, tier
-                            )
-                            if fits_b:
-                                from mlapi_tpu.ops.speculative import (
-                                    fused_spec_batched_fn,
-                                )
-
-                                variants = [(False, "")]
-                                if eng.spec_sample:
-                                    variants.append((True, "_s"))
-                                for smp, tag in variants:
-                                    fused_spec_batched_fn(
-                                        eng.model, eng.draft_model,
-                                        bucket, tier, k, smp,
-                                    )(
-                                        eng.params, eng.draft_params,
-                                        rows_b, keys_b,
-                                        ob_f if smp else zb_f,
-                                        zb_i, ob_f, npad_b, ones_b,
-                                    )
-                                    self.warmed.add((
-                                        bucket, tier,
-                                        f"spec_batched{tag}{bsz}",
-                                    ))
-                                    shapes += 1
-                if eng.draft_model is None:
+        for bi, bucket in enumerate(buckets):
+            grid = list(widths)
+            if full and bi == 0:
+                grid += [t for t in self.tiers() if t > eng.default_tier]
+            for n_new in grid:
+                if bucket + n_new > eng.model.max_positions:
                     continue
-                fits, k = self._spec_headroom(bucket, tier)
-                if fits:
-                    from mlapi_tpu.ops.speculative import fused_spec_fn
-
-                    # Greedy speculation serves every engine; the
-                    # sampled variant is a SECOND program, warmed
-                    # only when --spec-sample can route to it.
-                    variants = [(False, "spec")]
-                    if eng.spec_sample:
-                        variants.append((True, "spec_sampled"))
-                    for sampled, kind in variants:
-                        fused_spec_fn(
-                            eng.model, eng.draft_model, bucket,
-                            tier, k, sampled,
-                        )(
-                            eng.params, eng.draft_params, row, kd,
-                            z1f, z1i, o1f, n_pad, jnp.int32(1),
-                        )
-                        self.warmed.add((bucket, tier, kind))
-                        shapes += 1
+                row = np.full((bucket,), eng.tokenizer.pad_id, np.int32)
+                req = GenRequest(row, 1, n_new, 0.0, 0, None)
+                sink = _SyncSink(req, [])
+                eng._run_batch([sink])
+                if sink.error is not None:
+                    raise sink.error
+                shapes += 1
         return shapes
-

@@ -123,7 +123,7 @@ class GenRequest:
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
         "prompt_tokens", "stats", "t0", "t_last", "deadline",
-        "push_to", "pushed",
+        "push_to", "pushed", "staged",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
@@ -175,6 +175,10 @@ class GenRequest:
         self.pushed = pushed
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
+        # Staged-for-admission ONCE marker (collector dispatch): a
+        # candidate a lane deferred re-dispatches as its own group
+        # instead of being re-staged forever.
+        self.staged = False
         # Engine latency reservoirs (None for warmup requests): TTFT
         # and inter-token samples recorded as chunks are pushed.
         self.stats = stats
@@ -236,6 +240,7 @@ class _SyncSink:
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
+        self.staged = False
 
     def push(self, item) -> None:
         faults.fire("stream_push")

@@ -17,10 +17,15 @@ Design:
   generator. The generator yields one of the five typed units —
   ``prefill`` chunk, ``decode`` chunk, ``spec`` round/phase, ``admit``
   (joiner install), ``compact`` (batch resize) — after each unit of
-  device work. Scheduler-off, ``run()`` drains the same generator, so
-  the two modes execute identical code and greedy streams are
-  token-identical by construction (pinned across the 8-config matrix
-  in ``tests/test_scheduler.py``).
+  device work. Since r20 this is the ONE execution model (default-on):
+  serial mode (``--no-scheduler``) is the same machinery pinned to one
+  lane, so the two modes execute identical code and greedy streams are
+  token-identical by construction (pinned across the config matrix
+  in ``tests/test_scheduler.py``). Fused-eligible batches dispatch
+  tier-wide decode chunks through the same generator (one schedulable
+  unit per fused chunk — ``serving/fused_single.py``), so a concurrent
+  lane's head-of-line stall behind fused traffic is bounded at one
+  fused-chunk dispatch (``engine.sched_lane_stall_max``).
 - **One dispatch thread.** All lanes advance on THIS thread, one unit
   at a time — the device stream stays serial (the same property the
   single decode-executor gave), only the *order* across batches is now
@@ -73,12 +78,17 @@ Design:
   get the error as their terminal frame, and the other lanes stream
   on.
 
-The collector (``engine._collect_loop_sched``) forms groups exactly
-as before but never blocks on a running batch: groups hand off here
-and collection continues, so bucket-incompatible traffic runs as
-concurrent interleaved lanes instead of serial ``_carry`` turns.
-Pending groups are started in urgency order — the r12
-``_carry[0]``-FIFO head-of-line pick is gone.
+The collector (``engine._collect_loop``) forms groups exactly as
+before and routes each through ``engine._dispatch_group``: a group a
+live lane's window fits is STAGED for that lane's in-lane admission
+(the continuous-batching growth path — ``sched_units_admit`` ticks as
+the lane installs joiners at unit boundaries); otherwise it hands off
+here as a new lane and collection continues, so bucket-incompatible
+traffic runs as concurrent interleaved lanes instead of serial
+``_carry`` turns. Pending groups are started in urgency order — the
+r12 ``_carry[0]``-FIFO head-of-line pick is gone. Lane retirement
+wakes the collector (``engine._wake_collector``) so staged and
+deferred work re-enters dispatch immediately.
 """
 
 from __future__ import annotations
@@ -155,8 +165,9 @@ class UnitScheduler:
     """The engine-level typed-unit queue over concurrent BatchRuns.
 
     Owned by :class:`~mlapi_tpu.serving.engine.TextGenerationEngine`
-    when constructed with ``scheduler=True`` (``--scheduler``);
-    created by ``engine.start()``, torn down by ``engine.stop()``.
+    — ALWAYS (r20): ``engine.start()`` creates one unconditionally
+    (``--no-scheduler`` pins ``max_batches=1``), ``engine.stop()``
+    tears it down.
     """
 
     def __init__(self, eng, max_batches: int = 2):
@@ -175,6 +186,11 @@ class UnitScheduler:
         self._stopped = False
         self._pick_seq = 0
         self._lane_seq = 0
+        # Cross-lane head-of-line accounting: the lane the last unit
+        # dispatched for and its consecutive-dispatch streak while
+        # other lanes were live — feeds engine.sched_lane_stall_max.
+        self._last_lane = -1
+        self._streak = 0
         # LatencyStats.summary() sorts both reservoirs; the policy
         # only needs it at reservoir-drift granularity — cache it for
         # a window of picks instead of sorting per dispatched unit.
@@ -237,6 +253,17 @@ class UnitScheduler:
     def batches_live(self) -> int:
         with self._lock:
             return len(self._lanes)
+
+    def lane_groups(self) -> list:
+        """Snapshot of each live lane's request group (copies — lanes
+        mutate their lists on the dispatch thread as joiners install
+        and rows finish). The collector's in-lane-admission check
+        reads this; staleness is safe: a lane that retires between
+        the snapshot and the staging leaves the candidates in
+        ``_admit``, where the collector's no-batch-live sweep
+        reclaims them."""
+        with self._lock:
+            return [list(ln.run.reqs) for ln in self._lanes]
 
     @property
     def idle(self) -> bool:
@@ -399,22 +426,22 @@ class UnitScheduler:
     def _page_need(self, reqs) -> int:
         """Worst-case pool footprint of a group, from the BATCH
         geometry BatchRun will actually build: rows re-pack to the
-        GROUP's max bucket and every live row maps the same
-        ``[pos, pos+chunk)`` decode spans, so the per-row span is the
-        group's — prefix region + group bucket + the group's token
-        budget, chunk-rounded, plus the batched-spec headroom when a
-        draft is attached. Prefix sharing and early finishes only
-        make the real usage smaller (over-reservation costs a
-        deferred start, never a mid-decode exhaustion)."""
+        GROUP's max bucket and every live row maps the same decode
+        spans, so the per-row span is the group's full static cache
+        length (``engine._cache_len`` — the tier-quantized total a
+        fused-width dispatch may map in ONE chunk, so fused-chunk
+        lanes reserve what they can actually touch), plus the
+        batched-spec headroom when a draft is attached. Prefix
+        sharing and early finishes only make the real usage smaller
+        (over-reservation costs a deferred start, never a mid-decode
+        exhaustion)."""
         eng = self.eng
         page = eng.pool.page
-        span = (
+        span = eng._cache_len(
             max(r.prefix_len for r in reqs)
-            + max(len(r.row) for r in reqs)
-            + max(r.n_new for r in reqs)
-            + eng.chunk
-            + (eng.spec_k + 1 if eng.draft_model is not None else 0)
-        )
+            + max(len(r.row) for r in reqs),
+            max(r.n_new for r in reqs),
+        ) + (eng.spec_k + 1 if eng.draft_model is not None else 0)
         return len(reqs) * -(-span // page)
 
     def _claim_next_group(self) -> _Group | None:
@@ -535,19 +562,19 @@ class UnitScheduler:
 
     def _start_lane(self, g: _Group) -> None:
         """Formation as a unit: the engine's shared formation
-        preamble (``_form_batch`` — the SAME expiry sweep and fused
-        gates ``_run_batch`` applies, one definition so the two modes
-        can never diverge; a fused whole-generation program is ONE
-        uninterruptible unit — the RTT-floor lever, it builds
-        transient caches and never touches the pool), then the lane.
-        Failures deliver to every waiter, scoped to this group —
-        other lanes stream on."""
+        preamble (``_form_batch`` — the SAME expiry sweep
+        ``_run_batch`` applies, one definition so serial and
+        concurrent modes can never diverge), then the lane. A
+        fused-eligible group decodes tier-wide chunks through the
+        same units() generator — no uninterruptible whole-generation
+        unit remains. Failures deliver to every waiter, scoped to
+        this group — other lanes stream on."""
         eng, reqs = self.eng, g.reqs
         try:
             faults.fire("sched_unit")
             run = eng._form_batch(reqs, admit=True)
             if run is None:
-                return  # everyone expired, or a fused program served it
+                return  # everyone expired before formation
         except BaseException as e:  # noqa: BLE001 — delivered to waiters
             if eng.pool is not None:
                 # A failed paged formation may have DONATED the pool
@@ -580,8 +607,25 @@ class UnitScheduler:
             self._lanes.append(lane)
             live = len(self._lanes)
         self.trace.append((lane.lane_id, "prefill"))
+        self._note_dispatch(lane.lane_id, live)
         if live > eng.sched_batches_live_max:
             eng.sched_batches_live_max = live
+
+    def _note_dispatch(self, lane_id: int, n_live: int) -> None:
+        """Head-of-line accounting, counters not wall-clock: the
+        longest run of consecutive units ONE lane received while
+        another lane was live is the bound on how long concurrent
+        traffic stalls behind it — with fused chunks folded into
+        units, one fused-chunk dispatch (tests pin the gauge ≤ the
+        alternation floor; deadline preemption can legitimately
+        exceed it)."""
+        if n_live > 1 and lane_id == self._last_lane:
+            self._streak += 1
+        else:
+            self._streak = 1
+        self._last_lane = lane_id
+        if n_live > 1 and self._streak > self.eng.sched_lane_stall_max:
+            self.eng.sched_lane_stall_max = self._streak
 
     def _rebind_pool(self, lane: _Lane) -> None:
         """Another lane's donated dispatch consumed the pool arrays
@@ -666,6 +710,9 @@ class UnitScheduler:
             counter = f"sched_units_{kind}"
             setattr(eng, counter, getattr(eng, counter) + 1)
             self.trace.append((lane.lane_id, kind))
+            with self._lock:
+                n_live = len(self._lanes)
+            self._note_dispatch(lane.lane_id, n_live)
         if err is not None:
             _log.error(
                 "scheduler lane of %d failed: %s", len(run.reqs), err
@@ -678,3 +725,8 @@ class UnitScheduler:
                 except ValueError:
                     pass
                 self._work.notify_all()
+            # A retired lane frees a slot (and may strand staged
+            # _admit candidates): wake the collector so staged and
+            # deferred work re-enters dispatch immediately instead of
+            # riding the 50 ms poll.
+            eng._wake_collector()

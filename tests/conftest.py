@@ -129,7 +129,13 @@ _CACHE_FAMILIES = {
     # + the lock-witness module (r19): identical CFG once more — the
     # armed smoke re-drives the family's compiled prefix/scheduler
     # programs with wrapped locks; wrapping compiles nothing.
+    # + the fused-serving module (r20 fold): same CFG at page 8 /
+    # chunk 2 — fused-width decode chunks are the family's
+    # decode_chunk_fn at tier-wide sizes, so only the handful of
+    # fused-width shapes are new; prefill and plain-chunk programs
+    # come from the shared window.
     "paged-family": frozenset({
+        "test_serving_fused",
         "test_kv_peer",
         "test_kv_push",
         "test_lock_witness",

@@ -442,6 +442,16 @@ async def test_batched_spec_paged_realign(spec_models, page, counter):
             await eng.stop()
     assert outs["spec"] == outs["plain"]
     assert spec.spec_rounds > 0
+    # The batched phase pushes each row's terminal sentinel INSIDE the
+    # round loop the moment its budget is met; the handoff realign —
+    # and the counter this test pins — runs on the decode thread after
+    # the loop breaks. gather() returning therefore does NOT mean the
+    # batch tail ran: condition-wait on the counter itself (bounded
+    # poll on counters, never a bare sleep as synchronization).
+    for _ in range(500):
+        if getattr(spec, counter) >= 1:
+            break
+        await asyncio.sleep(0.01)
     assert getattr(spec, counter) >= 1, counter
     await _quiesce(spec)
     assert spec.kv_pages_in_use == 0

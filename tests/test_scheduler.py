@@ -1,5 +1,7 @@
-"""Continuous-batching scheduler v2 (``serving/scheduler.py``,
-``--scheduler``): one typed-unit queue across concurrent BatchRuns.
+"""Continuous-batching scheduler v2 (``serving/scheduler.py``):
+one typed-unit queue across concurrent BatchRuns — DEFAULT-ON since
+r20, with ``scheduler=False`` (``--no-scheduler``) the one-release
+serial escape hatch pinning the same machinery to ONE lane.
 
 The contract these tests pin, layer by layer — all interleaving and
 priority claims are asserted from DISPATCH COUNTERS and the bounded
@@ -8,10 +10,15 @@ unit trace, never wall-clock:
 - **Concurrency**: two bucket-incompatible request groups submitted
   together run as two live lanes with their units interleaved
   (``sched_batches_live_max == 2``; the trace alternates lane ids).
-- **Identity**: greedy streams are byte-identical scheduler-on vs
-  scheduler-off across {gpt-MHA, llama-GQA} x {none, int8} x
-  {einsum, flash} x {paged, contiguous} — the structural consequence
-  of both modes draining the same ``BatchRun.units()`` generator.
+- **Identity**: greedy streams are byte-identical concurrent
+  (default) vs serial (``scheduler=False``) across {gpt-MHA,
+  llama-GQA} x {none, int8} x {einsum, flash} x {paged, contiguous} —
+  the structural consequence of both modes draining the same
+  ``BatchRun.units()`` generator.
+- **Fused fold (r20)**: a fused-eligible batch's tier-wide decode
+  chunks are ordinary units, so a concurrent lane's head-of-line
+  stall behind fused traffic is at most ONE fused-chunk dispatch
+  (``sched_lane_stall_max``, a counter).
 - **SLO policy**: pending groups start in deadline-slack order (the
   r12 ``_carry[0]`` FIFO head-of-line fix), expired requests get
   their terminal frames at unit boundaries (``deadline_expired_*``
@@ -222,12 +229,13 @@ async def test_streams_identical_scheduler_on_off(
 ):
     """Scheduler-on vs off byte-identity across the full config
     matrix. The two requests are window-COMPATIBLE but submitted one
-    at a time through a zero-width window, so scheduler-on still runs
-    them as two concurrent interleaved lanes — while every program
-    shape (16-bucket prompts, default tier) is one the family window
-    already compiled (test_paged_kv's identity matrix), keeping the
-    16 configs cheap. The bucket-incompatible pair's identity is
-    pinned on the flagship config above."""
+    at a time through a zero-width window — default mode may take the
+    second via in-lane admission OR as its own lane depending on
+    arrival timing, and the streams must be byte-identical either way
+    — while every program shape (16-bucket prompts, default tier) is
+    one the family window already compiled (test_paged_kv's identity
+    matrix), keeping the 16 configs cheap. The bucket-incompatible
+    pair's identity is pinned on the flagship config above."""
     params = gpt_params if kind == "gpt_lm" else llama_params
     model = _model(kind, kv_quant=fmt, impl=impl)
     outs = []
@@ -249,9 +257,12 @@ async def test_streams_identical_scheduler_on_off(
             assert len(ta) == 12 and len(tb) == 6
             outs.append((ta, tb))
             if not scheduler:
-                assert eng.sched is None
-                assert eng.sched_units_decode == 0
-                assert eng.sched_batches_live_max == 0
+                # The serial escape hatch is the SAME machinery
+                # pinned to one lane — not a separate code path.
+                assert eng.sched is not None
+                assert eng.sched_max_batches == 1
+                assert eng.sched_batches_live_max <= 1
+                assert eng.sched_units_decode >= 1
         finally:
             await eng.stop()
     assert outs[0] == outs[1]
@@ -290,10 +301,18 @@ async def test_pending_groups_start_in_deadline_slack_order(gpt_params):
         # deliberately not pinned against a generous deadline: once it
         # has queued past ~2x the observed TTFT p95 the policy
         # promotes it — by design it may beat a 60s-slack deadline.)
-        # Both incompatible with the blocker's window and each other.
+        # Both incompatible with the blocker's window (128-bucket
+        # prompts: 128 + 40 > 160) — a window-COMPATIBLE group would
+        # instead be STAGED into the blocker's lane by r20's in-lane
+        # admission and never reach the pending queue this test
+        # orders. A is confirmed pending before B is submitted, so
+        # the collector can never window-merge the two into one
+        # group.
         ra = await eng.submit(
-            "aaaa", max_new_tokens=40, stream=True, deadline_ms=120000.0
+            "a" * 100, max_new_tokens=24, stream=True,
+            deadline_ms=120000.0,
         )
+        await _wait_for(lambda: eng.sched.backlog >= 1)
         rb = await eng.submit(
             _LONG[0], max_new_tokens=8, stream=True, deadline_ms=60000.0
         )
@@ -439,15 +458,22 @@ async def test_page_budget_defers_second_lane(gpt_params):
     live lane WAITS (counted) instead of racing the pool into a
     mid-decode PagePoolExhausted — and still completes after the
     first lane releases."""
-    # 15 usable pages: lane A (16-bucket + 30 new = 46 slots -> 6
-    # pages) fits; group B (16 + 64 = 80 slots -> 10 pages) does not
-    # fit beside it (15 - 6 = 9 free), but fits alone.
+    # 15 usable pages: lane A (16-bucket + 32-tier cache = 48 slots
+    # -> 6 pages) fits; group B (16 + 64 = 80 slots -> 10 pages) does
+    # not fit beside it (15 - 6 = 9 free), but fits alone. Under r20
+    # B first tries in-lane admission into A (window-compatible), is
+    # deferred there (64 new tokens exceed A's 48-slot cache), and
+    # re-dispatches as its own group — which is what the page gate
+    # then defers. The slowed decode keeps A's lane provably alive
+    # through that staging round-trip (30 tokens x 0.02 s/chunk-pair
+    # = a 0.3 s floor).
     eng = _engine(
         _model(), gpt_params, sched_max_batches=2,
         kv_page_size=8, kv_pages=16,
     )
     await eng.start()
     try:
+        faults.arm("decode:every=1:delay=0.02")
         ra = await eng.submit("hold", max_new_tokens=30, stream=True)
         await _wait_for(lambda: eng.sched_batches_live == 1)
         rb = await eng.submit("bbbb", max_new_tokens=64, stream=True)
@@ -459,6 +485,43 @@ async def test_page_budget_defers_second_lane(gpt_params):
         assert eng.sched_pages_deferred >= 1
         await _wait_for(lambda: eng.kv_pages_in_use == 0)
     finally:
+        faults.disarm()
+        await eng.stop()
+
+
+# --- fused chunks stay preemptible across lanes ------------------------
+
+
+async def test_fused_chunks_bound_cross_lane_stall(gpt_params):
+    """A fused-width generation sharing the machine with a plain
+    chunked lane never monopolises dispatch: fused chunks are typed
+    units yielded at the same boundaries, so the longest same-lane
+    dispatch streak while another lane is live stays <= 1 extra
+    dispatch (the one fused chunk in flight when the peer arrives)."""
+    eng = _engine(
+        _model(), gpt_params, fused_single=True, sched_max_batches=2,
+    )
+    await eng.start()
+    try:
+        # Slow decode so the two lanes provably overlap.
+        faults.arm("decode:every=1:delay=0.01")
+        rb = await eng.submit(_LONG[0], max_new_tokens=8, stream=True)
+        await _wait_for(lambda: eng.sched_batches_live == 1)
+        # Solo non-stream request: fused widths apply (34 new tokens
+        # -> one 64-wide fused decode unit per chunk boundary).
+        ra = await eng.submit(_SHORT[0], max_new_tokens=34)
+        (tb, eb), (ta, ea) = await asyncio.gather(
+            _collect(rb), _collect(ra)
+        )
+        assert ea is None and eb is None
+        assert len(ta) == 34 and len(tb) == 8
+        assert eng.fused_calls >= 1  # the fused path really ran
+        assert eng.sched_batches_live_max == 2  # lanes overlapped
+        # Max same-lane streak with >1 lane live: one fused chunk.
+        assert eng.sched_lane_stall_max <= 1
+        await _wait_for(lambda: eng.kv_pages_in_use == 0)
+    finally:
+        faults.disarm()
         await eng.stop()
 
 

@@ -80,8 +80,8 @@ def test_sharded_streams_match_unsharded(gpt_and_params, mesh_1x4):
 
 
 def test_sharded_spec_with_draft_on_mesh(gpt_and_params, mesh_1x4):
-    """The draft rides the same mesh: fused speculation runs with both
-    param trees sharded and stays byte-identical to plain greedy."""
+    """The draft rides the same mesh: speculative rounds run with both
+    param trees sharded and stay byte-identical to plain greedy."""
     model, params = gpt_and_params
     draft = get_model("gpt_lm", **D_CFG)
     dp = draft.init(jax.random.key(1))
@@ -93,7 +93,7 @@ def test_sharded_spec_with_draft_on_mesh(gpt_and_params, mesh_1x4):
     a = spec.generate_text(PROMPT, max_new_tokens=24)
     b = plain.generate_text(PROMPT, max_new_tokens=24)
     assert a["token_ids"] == b["token_ids"]
-    assert spec.fused_spec_calls == 1
+    assert spec.spec_rounds > 0 and spec.spec_drafted > 0
 
 
 def test_llama_generates_on_mesh(mesh_1x4):

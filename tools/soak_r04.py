@@ -3,8 +3,8 @@ byte-exactness checks.
 
 Mixed traffic — plain greedy, sampled (seeded), prefix-cached, NDJSON
 streams, a fraction cancelled mid-stream — against ONE engine with the
-round's fast paths forced on (``fused_batch=True``, solo fused default)
-so the soak exercises fused solo, fused batched, chunked streams,
+fast paths on (fused-chunk widths, solo fused default) so the soak
+exercises fused-width decode chunks, plain chunked streams,
 continuous admission, and the prefix KV path in the same run. Every
 completed non-stream response and every completed stream's final ids
 must be byte-identical to a solo reference run of the same request.
@@ -41,7 +41,7 @@ async def main() -> int:
     params = model.init(jax.random.key(0))
     eng = TextGenerationEngine(
         model, params, tokenizer=ByteTokenizer(), chunk=4,
-        max_batch=4, fused_batch=True,
+        max_batch=4,
     )
     ref = TextGenerationEngine(
         model, params, tokenizer=ByteTokenizer(), chunk=4,
@@ -113,7 +113,6 @@ async def main() -> int:
         "mismatches": mismatches,
         "batch_calls": eng.batch_calls,
         "fused_calls": eng.fused_calls,
-        "fused_batch_calls": eng.fused_batch_calls,
         "chunk_calls": eng.chunk_calls,
         "admitted": eng.admitted,
         "compactions": eng.compactions,
