@@ -152,25 +152,35 @@ class GptLM:
         b, l, h = x.shape
         nh, hd = self.num_heads, self.head_dim
 
+        # lora_apply: the per-tenant serving delta (adapter slot pool,
+        # serving/adapter_store.py) — a static no-op returning its
+        # ``y`` argument unchanged unless the dispatch augmented this
+        # layer dict with a "lora" sub-dict.
+        from mlapi_tpu.models.lora import lora_apply
+
         xn = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]).astype(cdt)
         qkv = xn @ layer["qkv"]["kernel"].astype(cdt) + layer["qkv"][
             "bias"
         ].astype(cdt)
+        qkv = lora_apply(layer, "qkv", xn, qkv)
         q, k, v = jnp.split(qkv.reshape(b, l, 3 * nh, hd), 3, axis=2)
         ctx = attend(q, k, v).reshape(b, l, -1)
         attn = ctx @ layer["attn_out"]["kernel"].astype(cdt) + layer[
             "attn_out"
         ]["bias"].astype(cdt)
+        attn = lora_apply(layer, "attn_out", ctx, attn)
         x = x + attn.astype(jnp.float32)
 
         xn = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]).astype(cdt)
         up = xn @ layer["ffn_up"]["kernel"].astype(cdt) + layer["ffn_up"][
             "bias"
         ].astype(cdt)
+        up = lora_apply(layer, "ffn_up", xn, up)
         up = jax.nn.gelu(up.astype(jnp.float32), approximate=True).astype(cdt)
         down = up @ layer["ffn_down"]["kernel"].astype(cdt) + layer[
             "ffn_down"
         ]["bias"].astype(cdt)
+        down = lora_apply(layer, "ffn_down", up, down)
         return x + down.astype(jnp.float32)
 
     def apply(self, params: dict, token_ids) -> jax.Array:

@@ -189,29 +189,48 @@ class LlamaLM:
     def _qkv(self, layer, xn, positions):
         """Project + rope one block's q/k/v. ``positions`` is the
         per-row effective position of every residual-stream slot."""
+        from mlapi_tpu.models.lora import lora_apply
+
         cdt = jnp.dtype(self.compute_dtype)
         b, l, _ = xn.shape
         nh, kvh, hd = self.num_heads, self.kv_heads, self.head_dim
-        q = (xn @ layer["wq"].astype(cdt)).reshape(b, l, nh, hd)
-        k = (xn @ layer["wk"].astype(cdt)).reshape(b, l, kvh, hd)
-        v = (xn @ layer["wv"].astype(cdt)).reshape(b, l, kvh, hd)
+        q = lora_apply(
+            layer, "wq", xn, xn @ layer["wq"].astype(cdt)
+        ).reshape(b, l, nh, hd)
+        k = lora_apply(
+            layer, "wk", xn, xn @ layer["wk"].astype(cdt)
+        ).reshape(b, l, kvh, hd)
+        v = lora_apply(
+            layer, "wv", xn, xn @ layer["wv"].astype(cdt)
+        ).reshape(b, l, kvh, hd)
         return _rope(q, positions, self.rope_theta), _rope(
             k, positions, self.rope_theta
         ), v
 
     def _block(self, layer, x, positions, attend):
+        # lora_apply: per-tenant serving delta — static no-op unless
+        # the dispatch augmented this layer with a "lora" sub-dict
+        # (serving/adapter_store.py slot pool).
+        from mlapi_tpu.models.lora import lora_apply
+
         cdt = jnp.dtype(self.compute_dtype)
         xn = _rms_norm(x, layer["rms1_scale"]).astype(cdt)
         q, k, v = self._qkv(layer, xn, positions)
         ctx = attend(q, k, v).reshape(x.shape[0], x.shape[1], -1)
-        x = x + (ctx @ layer["wo"].astype(cdt)).astype(jnp.float32)
+        wo = lora_apply(layer, "wo", ctx, ctx @ layer["wo"].astype(cdt))
+        x = x + wo.astype(jnp.float32)
 
         xn = _rms_norm(x, layer["rms2_scale"]).astype(cdt)
         gate = jax.nn.silu(
-            (xn @ layer["w_gate"].astype(cdt)).astype(jnp.float32)
+            lora_apply(
+                layer, "w_gate", xn, xn @ layer["w_gate"].astype(cdt)
+            ).astype(jnp.float32)
         ).astype(cdt)
-        up = xn @ layer["w_up"].astype(cdt)
-        down = (gate * up) @ layer["w_down"].astype(cdt)
+        up = lora_apply(layer, "w_up", xn, xn @ layer["w_up"].astype(cdt))
+        gu = gate * up
+        down = lora_apply(
+            layer, "w_down", gu, gu @ layer["w_down"].astype(cdt)
+        )
         return x + down.astype(jnp.float32)
 
     def _repeat_kv(self, k):

@@ -13,9 +13,14 @@ happens INSIDE the traced step, so the train step stays one fused XLA
 program with static shapes; ``b`` initializes to zero, so step 0 is
 byte-identical to the base model (the standard LoRA guarantee).
 
-Serving never sees LoRA: ``merge_params`` folds the adaptation back
-into a plain parameter tree that checkpoints and serves through the
-unchanged engines.
+Single-tenant serving never sees LoRA: ``merge_params`` folds the
+adaptation back into a plain parameter tree that checkpoints and
+serves through the unchanged engines. MANY-tenant serving keeps the
+base un-merged instead and applies per-request adapters from a
+device slot pool (``serving/adapter_store.py``); the serving-side
+helpers at the bottom of this module — :func:`lora_apply` inside the
+traced blocks, :func:`export_adapter` / :func:`merge_adapter` at the
+edges — carry that path.
 
 The reference (`/root/reference`) has no fine-tuning story at all —
 this exists for the framework's own pretrained-model scale.
@@ -178,3 +183,74 @@ class LoraModel:
             "base": self.inner.param_shardings(layout),
             "lora": lora,
         }
+
+
+# -- serving-side application (many-adapter slot pool) -----------------
+def lora_apply(layer, target, x, y):
+    """``y + adapter delta`` for a block matmul ``y = x @ W[target]``
+    when the layer dict carries serving adapter state, else ``y``
+    ITSELF — the presence check is a static Python branch at trace
+    time, so a build with no adapter traffic traces byte-identical
+    programs (no masked zero-delta ops riding every batch).
+
+    The state (installed by ``AdapterSlots.batch_params``) is
+    ``layer["lora"] = {target: {"a": [S, d_in, r], "b": [S, r,
+    d_out]}, ...}`` plus ONE marker: scalar ``"slot"`` (grouped batch
+    — a single tenant, one plain ``x @ A @ B`` per target) or int32
+    ``"rows"`` ``[B]`` (mixed tenants — the gathered BGMV path,
+    ``ops/bgmv.py``; base rows index the all-zero NULL slot 0)."""
+    lora = layer.get("lora") if isinstance(layer, dict) else None
+    if lora is None:
+        return y
+    ab = lora.get(target)
+    if ab is None:
+        return y
+    a, b = ab["a"], ab["b"]
+    rows = lora.get("rows")
+    if rows is not None:
+        from mlapi_tpu.ops.bgmv import bgmv
+
+        return y + bgmv(x, a, b, rows)
+    slot = lora["slot"]
+    return y + (x @ a[slot].astype(x.dtype)) @ b[slot].astype(x.dtype)
+
+
+def export_adapter(lora_params: dict, scale: float) -> dict:
+    """A trained adapter tree (``params["lora"]``: ``{"layer_0/qkv":
+    {a, b}}`` joined paths) → the CANONICAL serving payload
+    ``{layer: {target: {a, b}}}`` with ``b`` pre-scaled by
+    alpha/rank, so the serving delta is exactly ``x @ a @ b`` and no
+    scale rides the wire, the store, or the slot pool."""
+    import numpy as np
+
+    out: dict = {}
+    for joined, ab in lora_params.items():
+        path = joined.split("/")
+        out.setdefault(path[0], {})[path[-1]] = {
+            "a": np.asarray(ab["a"]),
+            "b": np.asarray(scale * ab["b"]),
+        }
+    return out
+
+
+def merge_adapter(params: dict, payload: dict) -> dict:
+    """Eagerly fold a serving payload into a fresh plain params tree:
+    ``W + a @ b`` per target (``b`` already carries the scale). The
+    merged-weights REFERENCE for the slot-path token-identity pins
+    (tests + bench) — and the escape hatch for serving one tenant on
+    an engine built without adapter slots."""
+    merged = jax.tree.map(lambda x: x, params)  # fresh containers
+    for ln, layer in payload.items():
+        for target, ab in layer.items():
+            node = merged[ln][target]
+            w = _kernel_of(node)
+            delta = (jnp.asarray(ab["a"]) @ jnp.asarray(ab["b"])).astype(
+                w.dtype
+            )
+            if isinstance(node, dict):
+                node = dict(node)
+                node["kernel"] = w + delta
+                merged[ln][target] = node
+            else:
+                merged[ln][target] = w + delta
+    return merged

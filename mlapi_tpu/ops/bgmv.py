@@ -1,0 +1,38 @@
+"""Batched gathered matrix-vector products for mixed-tenant LoRA.
+
+The serving slot pool (``serving/adapter_store.py``) holds every
+resident tenant's ``(A, B)`` pair stacked along a leading slot axis.
+A batch where every row shares one tenant applies its adapter as a
+plain ``x @ A @ B`` (the grouped fast path — no gather at all); a
+MIXED batch instead gathers each row's operands by slot index inside
+the traced step, so one compiled program serves any tenant mix at
+the same shapes. This is the BGMV formulation from the multi-tenant
+LoRA serving line (S-LoRA / Punica): rank is tiny, so the gathered
+matmuls are bandwidth-bound on the A/B reads — which the slot gather
+keeps at exactly one pair per row.
+
+A fused Pallas tile for the two einsums (gather + both contractions
+in one VMEM-resident kernel) is the noted follow-up; at serving
+ranks (r ≤ 64) the XLA einsum pair is already within the decode
+step's noise floor, and correctness — token-identity with the merged
+reference — is what this PR pins.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bgmv(x, a, b, rows):
+    """Per-row low-rank delta ``x[i] @ a[rows[i]] @ b[rows[i]]``.
+
+    ``x`` is ``[B, ..., d_in]`` (decode passes ``[B, L, d_in]``),
+    ``a`` is the slot pool ``[S, d_in, r]``, ``b`` is ``[S, r,
+    d_out]``, and ``rows`` is int32 ``[B]`` — slot 0 is the NULL
+    slot, all-zero by construction, so base-model rows in a mixed
+    batch pay the same two matmuls and gather an exactly-zero delta
+    (uniform shapes beat a branchy mask on TPU)."""
+    a_g = a[rows].astype(x.dtype)      # [B, d_in, r]
+    b_g = b[rows].astype(x.dtype)      # [B, r, d_out]
+    t = jnp.einsum("b...d,bdr->b...r", x, a_g)
+    return jnp.einsum("b...r,bro->b...o", t, b_g)

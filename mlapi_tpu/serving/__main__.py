@@ -152,6 +152,16 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--kv-tier-disk-dir", args.kv_tier_disk_dir]
     if getattr(args, "kv_peer_fetch", False):
         cmd += ["--kv-peer-fetch"]
+    if getattr(args, "adapter_slots", 0):
+        cmd += ["--adapter-slots", str(args.adapter_slots)]
+        if getattr(args, "adapter_store_bytes", 0):
+            cmd += ["--adapter-store-bytes", str(args.adapter_store_bytes)]
+        if getattr(args, "adapter_disk_dir", None):
+            # Same shared-dir discipline as --kv-tier-disk-dir: blob
+            # filenames are pid-scoped, so children can share one dir.
+            cmd += ["--adapter-disk-dir", args.adapter_disk_dir]
+        for spec in getattr(args, "adapter", None) or ():
+            cmd += ["--adapter", spec]
     if getattr(args, "replica_role", "mixed") != "mixed":
         # A uniform role for every child (the role-split supervisor
         # appends its own per-child --replica-role AFTER these, and
@@ -707,22 +717,26 @@ def main(argv=None) -> None:
              "--no-prefill-interleave defers long joiners to their "
              "own batch",
     )
+    # r21: the r20 one-release deprecated aliases retired on
+    # schedule — the redundant positive `--scheduler` (the scheduler
+    # is the default; passing the old flag now errors at parse, which
+    # IS the scheduled removal) and the ignored `--fused-batch`.
+    # `--no-scheduler` stays one more release as documented.
     parser.add_argument(
-        "--scheduler", action=argparse.BooleanOptionalAction,
+        "--no-scheduler", dest="scheduler", action="store_false",
         default=True,
-        help="continuous-batching scheduler v2, DEFAULT ON: run up "
-             "to --sched-max-batches decode batches CONCURRENTLY, "
-             "interleaved at typed-unit granularity (prefill chunk / "
-             "decode chunk / spec round / admission / compaction) on "
-             "one device stream, prioritized by deadline slack with "
-             "TTFT/inter-token targets fed from the live latency "
-             "reservoirs — bucket-incompatible traffic no longer "
-             "waits out the running batch. Greedy streams are pinned "
+        help="escape hatch (one more release, then removed): pin ONE "
+             "lane — the legacy serial semantics on the same "
+             "machinery. The continuous-batching scheduler v2 is the "
+             "default: up to --sched-max-batches decode batches "
+             "CONCURRENTLY, interleaved at typed-unit granularity "
+             "(prefill chunk / decode chunk / spec round / admission "
+             "/ compaction) on one device stream, prioritized by "
+             "deadline slack with TTFT/inter-token targets fed from "
+             "the live latency reservoirs. Greedy streams are pinned "
              "token-identical across modes. Watch "
              "generate.sched_units_* / sched_batches_live on "
-             "/metrics. --no-scheduler (escape hatch, one release) "
-             "pins ONE lane — the legacy serial semantics on the "
-             "same machinery. Generative checkpoints only",
+             "/metrics. Generative checkpoints only",
     )
     parser.add_argument(
         "--sched-max-batches", type=int, default=2,
@@ -746,12 +760,34 @@ def main(argv=None) -> None:
              "byte-reproducible per seed (solo runs are)",
     )
     parser.add_argument(
-        "--fused-batch", choices=["auto", "on", "off"], default=None,
-        help="DEPRECATED, ignored (removal next release): fused "
-             "whole-batch generation folded into the scheduler's "
-             "typed units — fused-eligible batches now dispatch "
-             "tier-wide decode chunks through the unit queue "
-             "(--fused-single still gates the width ladder)",
+        "--adapter-slots", type=int, default=0,
+        help="many-adapter LoRA serving: device-resident (A, B) slot "
+             "pool size — up to this many tenants' adapters resident "
+             "in HBM at once over the ONE shared base model "
+             "(HBM cost: base + N x generate.adapter_slot_bytes). "
+             "Requests name tenants via the 'adapter' field; mixed-"
+             "tenant batches apply per-row deltas via gathered BGMV. "
+             "0 (default) disables the subsystem entirely. "
+             "Generative checkpoints only",
+    )
+    parser.add_argument(
+        "--adapter-store-bytes", type=int, default=0,
+        help="with --adapter-slots: host-side adapter store LRU "
+             "byte budget (default 256 MiB when unset) — evicted "
+             "device slots refill from here without a peer fetch",
+    )
+    parser.add_argument(
+        "--adapter-disk-dir", default=None,
+        help="with --adapter-slots: spill directory for the host "
+             "adapter store (same pid-scoped blob discipline as "
+             "--kv-tier-disk-dir)",
+    )
+    parser.add_argument(
+        "--adapter", action="append", default=None, metavar="ID=PATH",
+        help="preload an adapter into the host store at startup "
+             "(repeatable): PATH is an exported adapter file "
+             "(models/lora.py export_adapter wire format) registered "
+             "under ID — the file's embedded id must match",
     )
     parser.add_argument(
         "--default-deadline-ms", type=float, default=None,
@@ -802,29 +838,6 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
-    import sys
-
-    # r20 migration notes — loud, once, at startup (not parser.error:
-    # existing deployments keep working through one release).
-    _argv = argv if argv is not None else sys.argv[1:]
-    if args.fused_batch is not None:
-        _log.warning(
-            "--fused-batch is DEPRECATED and ignored (removal next "
-            "release): fused whole-batch generation folded into the "
-            "scheduler's typed units — fused-eligible batches "
-            "dispatch tier-wide decode chunks through the unit "
-            "queue, so deadlines/disaggregation/brownout apply to "
-            "fused traffic too. Drop the flag; --fused-single still "
-            "gates the width ladder."
-        )
-    if "--scheduler" in _argv:
-        _log.warning(
-            "--scheduler is now the DEFAULT (the flag is redundant "
-            "and will be removed next release); --no-scheduler is "
-            "the one-release escape hatch pinning the legacy serial "
-            "semantics."
-        )
-
     if args.reload:
         import os
         import sys
@@ -855,6 +868,15 @@ def main(argv=None) -> None:
         # mis-pair must be equally loud in every mode (the engine
         # would reject it anyway, but only inside each child).
         parser.error("--kv-tier-disk-dir requires --kv-tier-bytes > 0")
+    if (
+        args.adapter_store_bytes or args.adapter_disk_dir or args.adapter
+    ) and not args.adapter_slots:
+        # Same before-the-fork loudness as the kv-tier mis-pair: the
+        # engine rejects it anyway, but only inside each child.
+        parser.error(
+            "--adapter-store-bytes/--adapter-disk-dir/--adapter "
+            "require --adapter-slots > 0"
+        )
     if args.router and args.workers > 1:
         parser.error(
             "--router and --workers are different topologies (distinct "
@@ -963,8 +985,33 @@ def main(argv=None) -> None:
         spec_sample=args.spec_sample,
         scheduler=args.scheduler,
         sched_max_batches=args.sched_max_batches,
+        adapter_slots=args.adapter_slots,
+        adapter_store_bytes=args.adapter_store_bytes,
+        adapter_disk_dir=args.adapter_disk_dir,
         mesh=mesh,
     )
+    for spec in args.adapter or ():
+        # Startup preload: ID=PATH into the host store (device slots
+        # install lazily, at the first request naming the tenant).
+        from mlapi_tpu.serving.adapter_store import load_adapter
+
+        aid, _, path = spec.partition("=")
+        if not aid or not path:
+            parser.error(f"--adapter {spec!r}: expected ID=PATH")
+        try:
+            file_aid, payload, rank, nbytes = load_adapter(path)
+        except (OSError, ValueError) as e:
+            parser.error(f"--adapter {spec!r}: {e}")
+        if file_aid != aid:
+            parser.error(
+                f"--adapter {spec!r}: file embeds adapter id "
+                f"{file_aid!r} — ids must match (rename the export, "
+                "not the flag)"
+            )
+        engine.register_adapter(aid, payload)
+        _log.info(
+            "preloaded adapter %r (rank %d, %d bytes)", aid, rank, nbytes
+        )
     app = build_app(
         engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         default_deadline_ms=args.default_deadline_ms,

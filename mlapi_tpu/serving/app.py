@@ -167,6 +167,17 @@ def _terminal_http(e: Exception) -> HTTPError | None:
 
     if isinstance(e, PagePoolExhausted):
         return HTTPError(503, str(e), headers={"retry-after": "1"})
+    from mlapi_tpu.serving.adapter_store import (
+        AdapterSlotsExhausted, AdapterUnavailable,
+    )
+
+    if isinstance(e, AdapterUnavailable):
+        # The named adapter does not exist anywhere this replica can
+        # reach — the resource is absent, not the server unhealthy.
+        return HTTPError(404, str(e))
+    if isinstance(e, AdapterSlotsExhausted):
+        # Momentary: every slot pinned by live batches. Retryable.
+        return HTTPError(503, str(e), headers={"retry-after": "1"})
     return None
 
 
@@ -226,6 +237,12 @@ def build_app(
             # endpoint would only be a cache-presence oracle handing
             # raw KV bytes to arbitrary direct callers.
             _install_kv_peer(app, engine)
+        if getattr(engine, "adapter_peer", None) is not None and (
+            _is_router_replica()
+        ):
+            # Same trust model as /kv/prefix: adapter weight blobs
+            # serve replica↔replica only, inside a router fleet.
+            _install_adapter_peer(app, engine)
         if (
             getattr(engine, "kv_push", None) is not None
             and getattr(engine, "replica_role", "mixed") == "decode"
@@ -350,6 +367,8 @@ def _install_generate(app: App, engine) -> None:
     (``TextGenerationEngine``); ``"stream": true`` returns NDJSON —
     one ``{"token_ids": [...]}`` line per decoded chunk as it lands,
     then a ``{"done": true, "text": ..., ...}`` line."""
+    from mlapi_tpu.serving.adapter_store import AdapterUnavailable
+
     schema = pydantic.create_model(
         "GenerateRequest",
         text=(str, ...),
@@ -369,6 +388,10 @@ def _install_generate(app: App, engine) -> None:
         # prefix + text, but the prefix's forward pass is computed
         # once and its KV reused by every request that names it.
         prefix=(str | None, None),
+        # Per-tenant LoRA adapter id (serving/adapter_store.py): the
+        # request decodes under base + this adapter's delta, batched
+        # with other tenants over the one HBM-resident base.
+        adapter=(str | None, None),
     )
     hard_cap = engine.model.max_positions - 1
 
@@ -411,6 +434,15 @@ def _install_generate(app: App, engine) -> None:
                 wp = _warm_peer(request)
                 if wp:
                     engine.kv_peer.note_hint(req.prefix, wp)
+            # Same hint, adapter tier: this forward missed the
+            # tenant's HRW-preferred replica, so a cold adapter
+            # fetches from the peer the router named (where the
+            # tenant's prefixes — and so its adapter — stay warm)
+            # instead of 404ing at the local store.
+            if engine.adapter_peer is not None and req.adapter:
+                wp = _warm_peer(request)
+                if wp:
+                    engine.adapter_peer.note_hint(req.adapter, wp)
         n_new = (
             req.max_new_tokens
             if req.max_new_tokens is not None
@@ -550,9 +582,16 @@ def _install_generate(app: App, engine) -> None:
                 stream=bool(req.stream) or bool(stops),
                 deadline_ms=req.deadline_ms,
                 kv_xfer=kv_xfer,
+                adapter=req.adapter,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
+        except AdapterUnavailable as e:
+            # Raised on the submit path (the encode thread resolves
+            # the id before the request queues): the named adapter is
+            # absent everywhere this replica can reach — 404, the
+            # resource, not the server.
+            raise HTTPError(404, str(e)) from None
         except ValueError as e:
             # An invalid prefix (too long for the model window, empty
             # after tokenization) is the requester's error, not a 500.
@@ -707,6 +746,37 @@ def _install_kv_peer(app: App, engine) -> None:
         if data is None:
             raise HTTPError(404, "no warm KV for that fingerprint")
         return Response(data, content_type="application/octet-stream")
+
+
+def _install_adapter_peer(app: App, engine) -> None:
+    """The internal replica↔replica adapter endpoint:
+    ``GET /adapter/<id>`` serves this replica's HOST-STORE copy of a
+    tenant's LoRA adapter in the wire format (geometry header +
+    raw leaves — ``serving/adapter_store.py``). Same shape as
+    ``GET /kv/prefix``: a GET with no engine-submit gate (a draining
+    replica keeps answering — exactly the window a peer needs its
+    tenants' adapters), resolve + serialize on an executor thread,
+    404 when the store has no such id. A middleware, not a route —
+    the router's exact (method, path) table has no path params, and
+    the id lives in the path (``kv_peer._http_get``-framed peers
+    request it that way)."""
+    peer = engine.adapter_peer
+
+    @app.middleware
+    async def _adapter_blob(request: Request, nxt):
+        if request.method == "GET" and request.path.startswith(
+            "/adapter/"
+        ):
+            aid = request.path[len("/adapter/"):]
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, peer.serve_wire, aid
+            )
+            if data is None:
+                raise HTTPError(404, "no such adapter on this replica")
+            return Response(
+                data, content_type="application/octet-stream"
+            )
+        return await nxt(request)
 
 
 def _install_kv_push(app: App, engine) -> None:
@@ -1241,6 +1311,70 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
                 )
                 snap["counters"]["generate.kv_push_fallbacks"] = (
                     engine.kv_push_fallbacks
+                )
+            if getattr(engine, "adapters", None) is not None:
+                # Many-adapter LoRA serving: the slot pool, the host
+                # store, and the fetch/application traffic. All byte
+                # gauges are exact dtype/shape arithmetic, never
+                # wall-clock — adapter_resident_bytes growing by
+                # EXACTLY adapter_slot_bytes per resident tenant over
+                # the base footprint IS the HBM-amortization claim,
+                # and adapter_fetch_hits moving while the local
+                # store's entries grow (prefix_builds-style) is the
+                # transferred-warmth claim, adapter tier.
+                snap["counters"]["generate.adapter_fetch_hits"] = (
+                    engine.adapter_fetch_hits
+                )
+                snap["counters"]["generate.adapter_fetch_misses"] = (
+                    engine.adapter_fetch_misses
+                )
+                snap["counters"]["generate.adapter_fetch_bytes"] = (
+                    engine.adapter_fetch_bytes
+                )
+                snap["counters"]["generate.adapter_fetch_failures"] = (
+                    engine.adapter_fetch_failures
+                )
+                snap["counters"]["generate.adapter_serve_count"] = (
+                    engine.adapter_serve_count
+                )
+                snap["counters"]["generate.adapter_serve_bytes"] = (
+                    engine.adapter_serve_bytes
+                )
+                snap["counters"]["generate.adapter_installs"] = (
+                    engine.adapter_installs
+                )
+                snap["counters"]["generate.adapter_evictions"] = (
+                    engine.adapter_evictions
+                )
+                snap["counters"]["generate.adapter_grouped_batches"] = (
+                    engine.adapter_grouped_batches
+                )
+                snap["counters"]["generate.adapter_gathered_batches"] = (
+                    engine.adapter_gathered_batches
+                )
+                snap["counters"]["generate.adapter_store_evictions"] = (
+                    engine.adapter_store_evictions
+                )
+                snap["counters"]["generate.sched_adapters_deferred"] = (
+                    engine.sched_adapters_deferred
+                )
+                snap["gauges"]["generate.adapter_slots_total"] = (
+                    engine.adapter_slots_total
+                )
+                snap["gauges"]["generate.adapter_slots_in_use"] = (
+                    engine.adapter_slots_in_use
+                )
+                snap["gauges"]["generate.adapter_slot_bytes"] = (
+                    engine.adapter_slot_bytes
+                )
+                snap["gauges"]["generate.adapter_resident_bytes"] = (
+                    engine.adapter_resident_bytes
+                )
+                snap["gauges"]["generate.adapter_store_bytes_in_use"] = (
+                    engine.adapter_store_bytes_in_use
+                )
+                snap["gauges"]["generate.adapter_store_entries"] = (
+                    engine.adapter_store_entries
                 )
         return snap
 

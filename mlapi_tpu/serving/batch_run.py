@@ -142,6 +142,17 @@ class BatchRun:
         # widths deliberately).
         self.fused_w = eng.fused.chunk_width(self) if fused_ok else 0
         self._fused_counted = False
+        # Per-row adapter slot mirror (serving/adapter_store.py):
+        # arow[row] is the device row's resident adapter slot, 0 (the
+        # all-zero NULL slot) for base-model rows. A host mirror like
+        # n_pad — it resizes through _mirrors_take and is reassigned
+        # whenever a row changes owner (admission, pf activation).
+        # _adapter_holds records every acquire for the run-end
+        # release; grouped/gathered is counted once per run, like
+        # fused_calls.
+        self.arow = np.zeros((b_pad,), np.int32)
+        self._adapter_holds: list = []
+        self._adapter_counted = False
 
         (self.prompt, self.n_pad, self.temps, self.topk, self.topp,
          self.keys) = eng._pack_rows(reqs, self.bucket, b_pad)
@@ -189,16 +200,29 @@ class BatchRun:
             )
             self._push = {"xfer": xfer, "n": n_run, "sent": 0}
             eng.kv_push.begin(xfer, host, int(port))
+        # rows[i]: request i's current row in the (possibly
+        # resized) device batch. Rows are independent (per-row
+        # mask/positions/PRNG streams), so gathering live rows
+        # into a different-size warmed program changes nothing
+        # but cost.
+        self.rows: list = list(range(b))
+        self.b_cur = b_pad
         try:
+            # Pin every member's adapter into a device slot BEFORE the
+            # prefill dispatches read _params() — a miss here (store
+            # empty, slots exhausted) fails the formation loudly with
+            # every hold rolled back and nothing half-installed.
+            for i, r in enumerate(reqs):
+                self.arow[i] = self._acquire_adapter(r)
+            s0 = int(self.arow[0])
+            if s0 and bool(np.all(self.arow[:b] == s0)):
+                # Single-tenant batch: paint the dummy pad rows with
+                # the same slot so the GROUPED (scalar-slot) program
+                # applies — dummy rows are fully masked, so the delta
+                # they compute is never read.
+                self.arow[:] = s0
             first = self._prefill()
             self.pos = self.p_len + self.bucket
-            # rows[i]: request i's current row in the (possibly
-            # resized) device batch. Rows are independent (per-row
-            # mask/positions/PRNG streams), so gathering live rows
-            # into a different-size warmed program changes nothing
-            # but cost.
-            self.rows: list = list(range(b))
-            self.b_cur = b_pad
             self._first_token(first)
             if self._push is not None:
                 # Finalize the transfer: the sampled first token (one
@@ -229,6 +253,7 @@ class BatchRun:
             # cleanup's own guard skips write-back when no cache
             # exists yet.
             self._paged_cleanup()
+            self._release_adapters()
             raise
 
     def _spec_brownout(self) -> bool:
@@ -244,6 +269,65 @@ class BatchRun:
             self._spec_supp_counted = True
             self.eng.brownout_spec_suppressed += 1
         return True
+
+    # -- per-tenant adapters (serving/adapter_store.py) ----------------
+
+    def _acquire_adapter(self, req) -> int:
+        """Resolve one request's adapter id to a resident device slot
+        (installing from the host store on a miss) and pin it — the
+        hold is released at the run's end, so a live batch's adapter
+        can never be evicted under it. 0 (the NULL slot) for base
+        requests: one attribute read, no locks."""
+        aid = getattr(req, "adapter", None)
+        if aid is None:
+            return 0
+        slot = self.eng.adapters.acquire(aid, self.eng.adapter_store)
+        self._adapter_holds.append(aid)
+        return slot
+
+    def _release_adapters(self) -> None:
+        """Drop every hold this run took (idempotent — the list
+        empties). Slots stay RESIDENT (warm for the tenant's next
+        request); they merely become evictable again."""
+        while self._adapter_holds:
+            self.eng.adapters.release(self._adapter_holds.pop())
+
+    def _params(self):
+        """The params pytree for this batch's next dispatch: plain
+        (no adapter rows — the byte-identical base programs),
+        GROUPED (every row one tenant: scalar slot marker, one
+        ``x @ A @ B`` per target), or GATHERED (mixed tenants:
+        per-row slot vector through ``ops/bgmv.py``; base and dummy
+        rows index the all-zero NULL slot). Host-side decision per
+        dispatch — the marker's pytree structure keys the traces
+        apart, and the mode is counted once per run at its first
+        adapter dispatch."""
+        eng = self.eng
+        if eng.adapters is None:
+            return eng.params
+        rows = self.arow[:self.b_cur]
+        if not rows.any():
+            return eng.params
+        if bool(np.all(rows == rows[0])):
+            if not self._adapter_counted:
+                self._adapter_counted = True
+                eng.adapter_grouped_batches += 1
+            return eng.adapters.batch_params(
+                eng.params, slot=int(rows[0])
+            )
+        if not self._adapter_counted:
+            self._adapter_counted = True
+            eng.adapter_gathered_batches += 1
+        return eng.adapters.batch_params(eng.params, rows=rows)
+
+    def _params1(self, slot: int):
+        """Solo-row dispatch params (joiner prefills run the single
+        candidate's row alone): the joiner's tenant via the grouped
+        marker, or the plain tree for a base joiner."""
+        eng = self.eng
+        if not slot:
+            return eng.params
+        return eng.adapters.batch_params(eng.params, slot=slot)
 
     # -- disaggregation: chunk-boundary KV push (prefill replica) -----
 
@@ -414,7 +498,7 @@ class BatchRun:
             first, self.cache = prefix_prefill_fn(
                 eng.model, bucket, total
             )(
-                eng.params, kv_arg, jnp.asarray(self.prompt),
+                self._params(), kv_arg, jnp.asarray(self.prompt),
                 jnp.asarray(self.n_pad), lo_arg,
                 jnp.asarray(self.keys), jnp.asarray(self.temps),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
@@ -441,7 +525,7 @@ class BatchRun:
                 self.cache, logits = extend_chunk_fn(
                     eng.model, cp, total
                 )(
-                    eng.params, self.cache,
+                    self._params(), self.cache,
                     jnp.asarray(self.prompt[:, c0:c0 + cp]),
                     jnp.int32(c0), n_pad_j,
                 )
@@ -455,7 +539,7 @@ class BatchRun:
             )
         else:
             first, self.cache = prefill_fn(eng.model, total)(
-                eng.params, jnp.asarray(self.prompt),
+                self._params(), jnp.asarray(self.prompt),
                 jnp.asarray(self.keys), jnp.asarray(self.temps),
                 jnp.asarray(self.n_pad), jnp.asarray(self.topk),
                 jnp.asarray(self.topp),
@@ -662,7 +746,7 @@ class BatchRun:
                     eng._expire_if_due(r, "prefill")
                 eng.prefill_chunks += 1
                 self.cache, logits = paged_extend_fn(eng.model, cp)(
-                    eng.params, self.cache,
+                    self._params(), self.cache,
                     jnp.asarray(self.prompt[:, c0:c0 + cp]),
                     jnp.int32(c0), n_pad_j, jnp.int32(0), jnp.int32(0),
                 )
@@ -682,7 +766,7 @@ class BatchRun:
             self.cache = paged_cache_tree(eng.pool.layers, self.tab)
             self._tab_dirty = False
             first, self.cache = paged_prefill_fn(eng.model, bucket)(
-                eng.params, self.cache, jnp.asarray(self.prompt),
+                self._params(), self.cache, jnp.asarray(self.prompt),
                 jnp.int32(0), jnp.asarray(self.keys),
                 jnp.asarray(self.temps), jnp.asarray(self.n_pad),
                 jnp.asarray(self.topk), jnp.asarray(self.topp),
@@ -693,7 +777,7 @@ class BatchRun:
         # program admission warms), adopted into pages — the extra
         # copy the page-native path exists to kill, kept measurable.
         first, mini = prefill_fn(eng.model, bucket)(
-            eng.params, jnp.asarray(self.prompt),
+            self._params(), jnp.asarray(self.prompt),
             jnp.asarray(self.keys), jnp.asarray(self.temps),
             jnp.asarray(self.n_pad), jnp.asarray(self.topk),
             jnp.asarray(self.topp),
@@ -841,7 +925,7 @@ class BatchRun:
             else jnp.int32(self.p_lo)
         )
         self.cache, logits = paged_extend_fn(eng.model, self.bucket)(
-            eng.params, self.cache, jnp.asarray(self.prompt),
+            self._params(), self.cache, jnp.asarray(self.prompt),
             jnp.int32(P), jnp.asarray(self.n_pad), jnp.int32(P),
             lo_arg,
         )
@@ -885,6 +969,11 @@ class BatchRun:
             # the draft replay from a wire-restored cache is a
             # surface r18 does not need).
             and reqs[0].push_to is None and reqs[0].pushed is None
+            # Adapter rows never speculate: the spec phase drafts and
+            # verifies against ``eng.params`` internally, which would
+            # emit the BASE model's stream for a tenant row. getattr —
+            # warmup requests are plain objects without the slot.
+            and getattr(reqs[0], "adapter", None) is None
             and (
                 (temps[0] <= 0.0 and topk[0] == 0 and topp[0] >= 1.0)
                 or (eng.spec_sample and temps[0] > 0.0)
@@ -910,6 +999,8 @@ class BatchRun:
             and self.total >= (
                 self.bucket + self.n_new_max + eng.spec_k + 1
             )
+            # Same adapter decline as the solo gate, batch-wide.
+            and all(getattr(r, "adapter", None) is None for r in reqs)
             # In strict (tunnel) mode an unwarmed batched-spec shape
             # would decline inside the phase anyway — decide at
             # formation so such batches keep the chained (deferred)
@@ -966,6 +1057,7 @@ class BatchRun:
             self.tok[sel], self.step[sel], self.lo[sel],
         )
         self.keys = self.keys[sel]
+        self.arow = self.arow[sel]
 
     def _grow(self) -> list:
         """Double the batch along the warmed power-of-two chain; the
@@ -1133,6 +1225,17 @@ class BatchRun:
                 self._unstage(cand)
                 eng._defer(cand)
                 continue
+            if (
+                getattr(cand, "adapter", None) is not None
+                and not eng.adapters.can_claim([cand.adapter])
+            ):
+                # Every adapter slot is pinned by this run's holds:
+                # the joiner's acquire would fail mid-admission. Hand
+                # it back — the next formation (fresh holds) pins its
+                # adapter before any device work.
+                self._unstage(cand)
+                eng._defer(cand)
+                continue
             bkt = len(cand.row)
             cp = eng.prompt_buckets[-1]
             if (
@@ -1272,6 +1375,12 @@ class BatchRun:
                 # scatter dispatch. The except below is the r12
                 # leak-window fix this point exists to pin.
                 faults.fire("table_install")
+                # Pin the joiner's adapter BEFORE its prefill
+                # dispatches: a miss here (slots exhausted despite the
+                # can_claim gate — racing acquire, or a store entry
+                # evicted since encode) is joiner-only, handled by the
+                # except below with nothing half-installed.
+                jslot = self._acquire_adapter(cand)
                 if self.pool is not None and eng.prefill_page_native:
                     # Page-native admission: ONE dispatch prefills the
                     # joiner's bucket straight into its freshly-mapped
@@ -1288,7 +1397,8 @@ class BatchRun:
                     )
                     donating = True  # paged_prefill_fn donates cache1
                     first1, cache1 = paged_prefill_fn(eng.model, bkt)(
-                        eng.params, cache1, jnp.asarray(cand.row[None]),
+                        self._params1(jslot), cache1,
+                        jnp.asarray(cand.row[None]),
                         jnp.int32(self.pos - bkt),
                         jnp.asarray(eng._key_data(cand.seed)[None]),
                         jnp.asarray(
@@ -1308,7 +1418,8 @@ class BatchRun:
                     eng._warmed_scatter.add((bkt, self.npv))
                 else:
                     first1, mini = prefill_fn(eng.model, bkt)(
-                        eng.params, jnp.asarray(cand.row[None]),
+                        self._params1(jslot),
+                        jnp.asarray(cand.row[None]),
                         jnp.asarray(eng._key_data(cand.seed)[None]),
                         jnp.asarray(
                             np.asarray([cand.temperature], np.float32)
@@ -1383,6 +1494,10 @@ class BatchRun:
             self.topk[row] = cand.top_k
             self.topp[row] = cand.top_p
             self.keys[row] = eng._key_data(cand.seed)
+            # Row changes owner: ALWAYS reassign its adapter slot —
+            # a reused row keeping a finished tenant's stale slot
+            # would apply that adapter to this (possibly base) joiner.
+            self.arow[row] = jslot
             self.tok[row] = ftok
             self.step[row] = 1
             reqs.append(cand)
@@ -1480,6 +1595,28 @@ class BatchRun:
             free = self._grow()
         row = free[0]
         self._release_row(row)  # a finished request's leftover pages
+        try:
+            # Pin the joiner's adapter before any pool pages move.
+            pf_slot = self._acquire_adapter(cand)
+        except Exception as e:  # noqa: BLE001 — joiner-only failure
+            from mlapi_tpu.serving.adapter_store import (
+                AdapterSlotsExhausted,
+            )
+
+            self._unstage(cand)
+            if isinstance(e, AdapterSlotsExhausted):
+                # Slots momentarily pinned by live runs: next batch.
+                eng._defer(cand)
+                return False
+            # Unresolvable (store entry evicted since encode): the
+            # error is this joiner's terminal frame; the batch and the
+            # pool were never touched.
+            try:
+                cand.push(e)
+            except Exception:
+                pass
+            cand.cancel()
+            return False
         # Private table: the prompt's pages belong to `ptab` until
         # activation — the batch row stays a null-table dummy, so
         # interleaved decode writes for it stay in the null page.
@@ -1500,6 +1637,7 @@ class BatchRun:
             "cand": cand, "row": row, "ptab": ptab, "A": A,
             "off": A - bkt, "cp": cp, "skip": (bkt - bkt_eff) // cp,
             "next": 0, "n_run": n_run, "logits": None,
+            "slot": pf_slot,
         }
         eng.interleaved_prefills += 1
         eng.prefill_chunk_queue_depth = n_run
@@ -1519,7 +1657,7 @@ class BatchRun:
         eng.prefill_chunks += 1
         cache1 = paged_cache_tree(self.cache, pf["ptab"])
         cache1, pf["logits"] = paged_extend_fn(eng.model, cp)(
-            eng.params, cache1,
+            self._params1(pf["slot"]), cache1,
             jnp.asarray(cand.row[None, c0:c0 + cp]),
             jnp.int32(pf["off"] + c0),
             jnp.asarray(np.asarray([pf["A"] - cand.used], np.int32)),
@@ -1593,6 +1731,9 @@ class BatchRun:
         self.topk[row] = cand.top_k
         self.topp[row] = cand.top_p
         self.keys[row] = eng._key_data(cand.seed)
+        # Row changes owner — same stale-slot rule as one-shot
+        # admission: always reassign, even to 0.
+        self.arow[row] = pf["slot"]
         self.tok[row] = ftok
         self.step[row] = 1
         self.reqs.append(cand)
@@ -1705,7 +1846,7 @@ class BatchRun:
         faults.fire("decode")
         eng.chunk_calls += 1
         toks, self.cache, last_tok = decode_chunk_fn(eng.model, size)(
-            eng.params, self.cache,
+            self._params(), self.cache,
             self.chain.tok_dev if self.chain.tok_dev is not None
             else jnp.asarray(self.tok),
             jnp.int32(self.pos),
@@ -1781,6 +1922,10 @@ class BatchRun:
             # the batch; that persistence is what makes prefix pages
             # shareable ACROSS batches.
             self._paged_cleanup()
+            # Drop every adapter hold this run took: the slots stay
+            # RESIDENT (warm for the tenants' next requests) but
+            # become evictable again.
+            self._release_adapters()
 
     def _units(self):
         eng, reqs, chain = self.eng, self.reqs, self.chain

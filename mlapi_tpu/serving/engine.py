@@ -154,6 +154,9 @@ class InferenceEngine:
         spec_sample: bool = False,
         scheduler: bool = True,
         sched_max_batches: int = 2,
+        adapter_slots: int = 0,
+        adapter_store_bytes: int = 0,
+        adapter_disk_dir: str | None = None,
     ) -> "InferenceEngine":
         """Build an engine from a committed checkpoint dir.
 
@@ -362,6 +365,9 @@ class InferenceEngine:
                 replica_role=replica_role,
                 scheduler=scheduler,
                 sched_max_batches=sched_max_batches,
+                adapter_slots=adapter_slots,
+                adapter_store_bytes=adapter_store_bytes,
+                adapter_disk_dir=adapter_disk_dir,
                 meta={"step": meta.step, "config_hash": meta.config_hash,
                       **({"quantized": quantize} if quantize else {}),
                       **({"kv_quant": kv_quant} if kv_quant else {}),
@@ -375,6 +381,8 @@ class InferenceEngine:
                          if kv_peer_fetch else {}),
                       **({"replica_role": replica_role}
                          if replica_role != "mixed" else {}),
+                      **({"adapter_slots": adapter_slots}
+                         if adapter_slots else {}),
                       **({} if scheduler else {"scheduler": False}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
@@ -402,6 +410,13 @@ class InferenceEngine:
                 "replica_role applies to generative checkpoints "
                 f"(they split prefill from decode); "
                 f"{type(inner).__name__} has neither"
+            )
+        if adapter_slots or adapter_store_bytes or adapter_disk_dir:
+            raise ValueError(
+                "adapter_slots/adapter_store_bytes/adapter_disk_dir "
+                "apply to generative checkpoints (they serve per-"
+                f"tenant LoRA adapters); {type(inner).__name__} does "
+                f"not"
             )
         # ``scheduler``/``sched_max_batches`` are generative-only
         # knobs (they shape the decode unit queue) and default ON —
@@ -639,6 +654,9 @@ class TextGenerationEngine:
         replica_role: str = "mixed",
         scheduler: bool = True,
         sched_max_batches: int = 2,
+        adapter_slots: int = 0,
+        adapter_store_bytes: int = 0,
+        adapter_disk_dir: str | None = None,
     ):
         if tokenizer.vocab_size > model.vocab_size:
             raise ValueError(
@@ -849,6 +867,40 @@ class TextGenerationEngine:
             from mlapi_tpu.serving.kv_peer import KVPush
 
             self.kv_push = KVPush(self)
+        # Many-adapter LoRA serving (serving/adapter_store.py): ONE
+        # HBM-resident base amortized across per-tenant adapters.
+        # adapter_slots > 0 allocates a device slot pool (per-target
+        # stacked (A, B) pools, slot 0 pinned all-zero for base rows)
+        # plus a host-RAM (optionally disk-backed) LRU store the slots
+        # install from, plus the fleet fetch tier (GET /adapter/<id>
+        # from the router-hinted warm peer — the kv_peer wire idiom).
+        # 0 = off (the default): no pools, no store, no endpoint —
+        # requests naming an adapter are rejected loudly and every
+        # base-model program traces byte-identical to before.
+        self.adapters = None
+        self.adapter_store = None
+        self.adapter_peer = None
+        if (adapter_store_bytes or adapter_disk_dir) and not adapter_slots:
+            raise ValueError(
+                "adapter_store_bytes/adapter_disk_dir require "
+                "adapter_slots > 0 (the slot pool enables adapter "
+                "serving; a silently-ignored store budget would "
+                "serve nothing)"
+            )
+        if adapter_slots:
+            from mlapi_tpu.serving.adapter_store import (
+                AdapterPeer, AdapterSlots, AdapterStore,
+            )
+
+            self.adapters = AdapterSlots(self, int(adapter_slots))
+            # Host tier defaults to 256 MiB — hundreds of rank-8/16
+            # adapters for the model sizes this repo serves; the flag
+            # overrides for bigger fleets.
+            self.adapter_store = AdapterStore(
+                int(adapter_store_bytes) or (1 << 28),
+                disk_dir=adapter_disk_dir,
+            )
+            self.adapter_peer = AdapterPeer(self)
         # Page-native prefill (r10): bucket prefill and admission write
         # K/V straight into pool pages through the page table — the
         # contiguous-then-adopt copy (one full extra write of
@@ -1000,6 +1052,13 @@ class TextGenerationEngine:
         self.deadline_expired_decode = 0
         self.brownout_spec_suppressed = 0
         self.brownout_tokens_clamped = 0
+        # Mixed-tenant batching observability: batch runs dispatched
+        # with the single-tenant grouped fast path (one x @ A @ B per
+        # target) vs the gathered BGMV path (per-row slot gather).
+        # Counted once per batch run, like fused_calls — never per
+        # chunk. Both 0 with adapter_slots off.
+        self.adapter_grouped_batches = 0
+        self.adapter_gathered_batches = 0
         # Continuous-batching scheduler v2 (r15, serving/scheduler.py;
         # DEFAULT-ON since r20 — the one execution model): one
         # typed-unit queue (prefill chunk / decode chunk / spec round
@@ -1025,6 +1084,10 @@ class TextGenerationEngine:
         self.sched_units_compact = 0
         self.sched_deadline_preempts = 0
         self.sched_pages_deferred = 0
+        # Group held back because its adapters could not all claim a
+        # device slot right now (free + hold-free-evictable < needed)
+        # — the adapter-slot term of the same reservation gate.
+        self.sched_adapters_deferred = 0
         self.sched_batches_live_max = 0
         # Largest run of consecutive units ONE lane dispatched while
         # another lane was live — the cross-lane head-of-line bound
@@ -1497,6 +1560,108 @@ class TextGenerationEngine:
     def kv_peer_serve_bytes(self) -> int:
         return self.kv_peer.serve_bytes if self.kv_peer else 0
 
+    # -- adapter accounting (state lives in serving/adapter_store.py).
+    # Byte counters are exact wire/dtype-shape arithmetic (header
+    # nbytes, ``slot_bytes`` closed forms), never wall-clock; all zero
+    # with adapter_slots off.
+    @property
+    def adapter_slots_total(self) -> int:
+        return self.adapters.slots_total if self.adapters else 0
+
+    @property
+    def adapter_slots_in_use(self) -> int:
+        return self.adapters.slots_in_use if self.adapters else 0
+
+    @property
+    def adapter_evictions(self) -> int:
+        return self.adapters.evictions if self.adapters else 0
+
+    @property
+    def adapter_installs(self) -> int:
+        return self.adapters.installs if self.adapters else 0
+
+    @property
+    def adapter_slot_bytes(self) -> int:
+        """Device bytes ONE resident adapter costs (per-target
+        ``a [d_in, r] + b [r, d_out]`` rows at the base kernel dtype):
+        the HBM-amortization claim is asserted as ``base params bytes
+        + N x adapter_slot_bytes`` for N resident tenants. 0 until the
+        first install fixes the engine-wide rank."""
+        return self.adapters.slot_bytes() if self.adapters else 0
+
+    @property
+    def adapter_resident_bytes(self) -> int:
+        """The closed-form HBM total the amortization claim pins:
+        base parameter bytes + slots_in_use x adapter_slot_bytes."""
+        if self.adapters is None:
+            return 0
+        base = sum(
+            v.size * v.dtype.itemsize
+            for v in jax.tree.leaves(self.params)
+            if hasattr(v, "dtype")
+        )
+        return base + self.adapters.slots_in_use * (
+            self.adapters.slot_bytes()
+        )
+
+    @property
+    def adapter_fetch_hits(self) -> int:
+        """Peer adapter blobs fetched AND stored — each one a tenant
+        onboarded without its weights riding the client request."""
+        return self.adapter_peer.fetch_hits if self.adapter_peer else 0
+
+    @property
+    def adapter_fetch_misses(self) -> int:
+        return self.adapter_peer.fetch_misses if self.adapter_peer else 0
+
+    @property
+    def adapter_fetch_bytes(self) -> int:
+        return self.adapter_peer.fetch_bytes if self.adapter_peer else 0
+
+    @property
+    def adapter_fetch_failures(self) -> int:
+        return self.adapter_peer.fetch_failures if self.adapter_peer else 0
+
+    @property
+    def adapter_serve_count(self) -> int:
+        return self.adapter_peer.serve_count if self.adapter_peer else 0
+
+    @property
+    def adapter_serve_bytes(self) -> int:
+        return self.adapter_peer.serve_bytes if self.adapter_peer else 0
+
+    @property
+    def adapter_store_bytes_in_use(self) -> int:
+        return self.adapter_store.bytes_in_use if self.adapter_store else 0
+
+    @property
+    def adapter_store_entries(self) -> int:
+        return self.adapter_store.entries if self.adapter_store else 0
+
+    @property
+    def adapter_store_evictions(self) -> int:
+        return self.adapter_store.evictions if self.adapter_store else 0
+
+    def register_adapter(self, aid: str, payload: dict) -> int:
+        """Install a pre-scaled adapter payload (``{layer: {target:
+        {a, b}}}``, ``b`` already carrying alpha/rank — see
+        ``models/lora.export_adapter``) into the HOST store under
+        ``aid``; device slots install lazily at first request. The
+        CLI's ``--adapter id=path`` and tests load through here.
+        Returns the stored wire-image byte count."""
+        from mlapi_tpu.serving import adapter_store as _as
+
+        if self.adapter_store is None:
+            raise ValueError(
+                "engine built without adapter slots "
+                "(--adapter-slots 0): cannot register adapters"
+            )
+        if not _as.ADAPTER_ID_RE.match(aid or ""):
+            raise ValueError(f"bad adapter id {aid!r}")
+        _as.adapter_rank(payload)  # loud on ragged/empty payloads
+        nbytes = self.adapter_store.put(aid, payload)
+        return nbytes
+
     # -- disaggregation accounting (state lives in serving/kv_peer.py's
     # KVPush) — byte counters are exact payload arithmetic (each
     # chunk's ``span × per-slot kv bytes`` closed form), never
@@ -1563,14 +1728,56 @@ class TextGenerationEngine:
         never this."""
         return self.prefix.builds
 
+    def _resolve_adapter(self, aid: str) -> None:
+        """Resolve an adapter id into the HOST store (encode executor
+        thread — never the dispatch thread): already registered, or
+        already resident on device, or fetched from the router-hinted
+        warm peer and staged. Raises ``AdapterUnavailable`` (mapped to
+        404) when this replica cannot serve the tenant — feature off,
+        malformed id, or no blob anywhere — BEFORE the request ever
+        queues, so a mistyped tenant id costs a hash lookup, not a
+        batch slot."""
+        from mlapi_tpu.serving.adapter_store import (
+            ADAPTER_ID_RE, AdapterUnavailable,
+        )
+
+        if self.adapters is None:
+            raise AdapterUnavailable(
+                "this replica serves no adapters (--adapter-slots 0)"
+            )
+        if not isinstance(aid, str) or not ADAPTER_ID_RE.match(aid):
+            raise AdapterUnavailable(f"malformed adapter id {aid!r:.80}")
+        if self.adapters.resident(aid) or self.adapter_store.has(aid):
+            return
+        got = self.adapter_peer.fetch(aid) if self.adapter_peer else None
+        if got is not None:
+            self.adapter_store.put(aid, got[0])
+            return
+        raise AdapterUnavailable(
+            f"adapter {aid!r} is not registered on this replica"
+        )
+
     def _encode(self, text: str, n_new: int, temperature: float, seed: int,
                 loop, top_k: int = 0, top_p: float = 1.0,
                 prefix: str | None = None,
                 stream: bool = False,
                 deadline_ms: float | None = None,
-                push_to=None, kv_xfer: str | None = None) -> GenRequest:
+                push_to=None, kv_xfer: str | None = None,
+                adapter: str | None = None) -> GenRequest:
         entry = None
         raw = None
+        if adapter is not None:
+            self._resolve_adapter(adapter)
+            if prefix:
+                # The prefix cache holds BASE-model KV; reusing it
+                # under a tenant's adapted weights would condition the
+                # suffix on the wrong model. Fold the prefix into the
+                # prompt instead — identical semantics, zero cache
+                # pollution — and count it where the cache's other
+                # declined reuses land.
+                self.prefix.count_fallback()
+                text = prefix + text
+                prefix = None
         if prefix:
             raw = self.tokenizer.token_ids(text)
             if not raw:
@@ -1656,6 +1863,7 @@ class TextGenerationEngine:
             row, used, n_new, temperature, seed, loop, top_k, top_p,
             prefix=entry, stream=stream, stats=self.latency,
             deadline_ms=deadline_ms, push_to=push_to, pushed=pushed,
+            adapter=adapter,
         )
 
     # -- the batched decode (runs on a worker thread) ----------------------
@@ -2134,6 +2342,7 @@ class TextGenerationEngine:
         deadline_ms: float | None = None,
         push_to=None,
         kv_xfer: str | None = None,
+        adapter: str | None = None,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
@@ -2233,7 +2442,7 @@ class TextGenerationEngine:
                 text, n_new, float(temperature), int(seed), loop,
                 int(top_k), float(top_p), prefix=prefix,
                 stream=bool(stream), deadline_ms=deadline_ms,
-                push_to=push_to, kv_xfer=kv_xfer,
+                push_to=push_to, kv_xfer=kv_xfer, adapter=adapter,
             ),
         )
         if push_to is not None:
@@ -2279,6 +2488,7 @@ class TextGenerationEngine:
         deadline_ms: float | None = None,
         push_to=None,
         kv_xfer: str | None = None,
+        adapter: str | None = None,
     ) -> dict:
         """One prompt → generated continuation (text + ids), through
         the same ``_run_batch`` the batcher uses — including its
@@ -2294,6 +2504,7 @@ class TextGenerationEngine:
             text, n_new, float(temperature), int(seed), None,
             int(top_k), float(top_p), prefix=prefix,
             deadline_ms=deadline_ms, push_to=push_to, kv_xfer=kv_xfer,
+            adapter=adapter,
         )
         if push_to is not None:
             # Same contract as submit(): encode with the client's

@@ -98,6 +98,20 @@ POINTS = (
     # thread.
     "kv_push_send",     # before a chunk's POST /kv/push leaves the sender
     "kv_push_recv",     # before a pushed chunk is parsed/staged
+    # Many-adapter LoRA serving (serving/adapter_store.py).
+    # ``adapter_fetch`` fires on the encode executor thread BEFORE
+    # the GET /adapter/<id> wire request — a raise is a counted fetch
+    # failure and the request resolves against whatever the host
+    # store already holds (absent ⇒ AdapterUnavailable ⇒ 404), slots
+    # and pages conserved (the fetch never touches the device).
+    # ``adapter_install`` fires on the dispatch thread AFTER payload
+    # validation but BEFORE the slot allocation and donated scatter —
+    # a raise rejects the install on untouched state (no slot popped,
+    # no victim evicted, nothing half-installed) and the affected
+    # requests get the error as their terminal frame; a delay slows
+    # formation, never breaks it.
+    "adapter_fetch",    # before the GET /adapter/<id> wire request
+    "adapter_install",  # before an adapter's slot alloc + scatter
 )
 
 ENV_VAR = "MLAPI_FAULTS"

@@ -123,14 +123,14 @@ class GenRequest:
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
         "prompt_tokens", "stats", "t0", "t_last", "deadline",
-        "push_to", "pushed", "staged",
+        "push_to", "pushed", "staged", "adapter",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
                  top_k=0, top_p=1.0, prefix=None, stream=False,
                  stats: LatencyStats | None = None,
                  deadline_ms: float | None = None,
-                 push_to=None, pushed=None):
+                 push_to=None, pushed=None, adapter=None):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -173,6 +173,12 @@ class GenRequest:
         # bit-identical to the fields never existing.
         self.push_to = push_to
         self.pushed = pushed
+        # Per-tenant LoRA adapter id (serving/adapter_store.py), or
+        # None for the base model. _encode resolved it into the HOST
+        # store before this request was queued; batch formation turns
+        # it into a resident device slot. Requests with different
+        # adapters still co-batch (the gathered BGMV path).
+        self.adapter = adapter
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
         # Staged-for-admission ONCE marker (collector dispatch): a
@@ -237,6 +243,7 @@ class _SyncSink:
         self.stats, self.t0, self.t_last = req.stats, req.t0, None
         self.deadline = req.deadline
         self.push_to, self.pushed = req.push_to, req.pushed
+        self.adapter = req.adapter
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False

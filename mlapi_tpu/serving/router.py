@@ -402,15 +402,17 @@ class Router:
     def routing_key_of(self, obj: dict | None) -> bytes | None:
         """The affinity key of a parsed body: the ``prefix`` field
         when present (the shared-prompt cache unit — every request
-        naming it must land where its KV lives), else the prompt
-        ``text``; truncated to the first K bytes. The router
-        tokenizes nothing — raw UTF-8 bytes hash the same on every
-        router process. ``None`` (unparseable body, no text) routes
-        by load only; the replica still owns rejecting the bad
+        naming it must land where its KV lives), else the ``adapter``
+        id (a tenant's requests land where its LoRA slot — and, when
+        it also uses prefixes, its prefix KV — is already warm), else
+        the prompt ``text``; truncated to the first K bytes. The
+        router tokenizes nothing — raw UTF-8 bytes hash the same on
+        every router process. ``None`` (unparseable body, no text)
+        routes by load only; the replica still owns rejecting the bad
         body."""
         if obj is None:
             return None
-        src = obj.get("prefix") or obj.get("text")
+        src = obj.get("prefix") or obj.get("adapter") or obj.get("text")
         if not isinstance(src, str) or not src:
             return None
         return src.encode("utf-8", "surrogatepass")[
@@ -429,13 +431,18 @@ class Router:
         (r14/r17) already serves — its suffix prefill is small by
         construction, so disaggregating it buys nothing and would
         complicate the prefix-region transfer. Unparseable bodies
-        route normally (the replica owns rejecting them)."""
+        route normally (the replica owns rejecting them). Adapter
+        requests stay single-hop too: the prefill replica would need
+        the tenant's slot resident just to run the prompt, doubling
+        every adapter's working-set across both role pools for no
+        prefill win."""
         if not self.role_split or obj is None:
             return False
         return (
             isinstance(obj.get("text"), str)
             and bool(obj.get("text"))
             and not obj.get("prefix")
+            and not obj.get("adapter")
         )
 
     def _pick_role(
@@ -654,6 +661,10 @@ class Router:
                 # transfer it never produced.
                 b"x-mlapi-decode-peer",
                 b"x-mlapi-kv-xfer",
+                # The tenant marker is router-authored from the
+                # body's validated adapter id — a client-sent copy is
+                # an impersonation/header-injection vector.
+                b"x-mlapi-adapter",
             ):
                 head += k + b": " + v + b"\r\n"
         head += b"content-length: %d\r\n" % len(request.body)
@@ -856,13 +867,25 @@ class Router:
         return pref
 
     async def forward(
-        self, request: Request, key: bytes | None = None
+        self, request: Request, key: bytes | None = None,
+        adapter: str | None = None,
     ) -> Response:
         """Route + forward one request, with the failover-once rule:
         at most one extra hop, and only for submits that provably
         never started work (connect failure, pre-submit injected
         fault, a whole-response 503)."""
         self.forwarded += 1
+        extra = None
+        if adapter:
+            from mlapi_tpu.serving.adapter_store import ADAPTER_ID_RE
+
+            # Router-authored tenant marker on the hop (client copies
+            # are stripped in _build_upstream). Validated against the
+            # id charset BEFORE entering a header line — an id with
+            # CR/LF or other junk would be header injection; such a
+            # body forwards unmarked and the replica rejects it.
+            if ADAPTER_ID_RE.match(adapter):
+                extra = {"x-mlapi-adapter": adapter}
         # The key's HRW head, computed ONCE over all replicas and
         # threaded through BOTH attempts: the failover's second
         # choose() has no memory of the preferred replica (it
@@ -881,7 +904,7 @@ class Router:
             )
         try:
             return await self._attempt(
-                first, request, self._hint_for(pref, first)
+                first, request, self._hint_for(pref, first), extra
             )
         except _SubmitError as e1:
             if e1.retryable:
@@ -902,7 +925,8 @@ class Router:
                     )
                     try:
                         return await self._attempt(
-                            second, request, self._hint_for(pref, second)
+                            second, request,
+                            self._hint_for(pref, second), extra,
                         )
                     except _SubmitError as e2:
                         return self._submit_error_response(e2, e1)
@@ -1165,14 +1189,32 @@ def build_router_app(router: Router) -> App:
             # Role-split fleet + plain prompt: the two-hop
             # prefill→decode path (r18). Prefix-carrying requests
             # stay on the affinity path below — their warmth story is
-            # the r14/r17 machinery.
+            # the r14/r17 machinery; adapter-carrying ones too (the
+            # gate above keeps a tenant's slot working-set on ONE
+            # replica).
             return await router.forward_disagg(request, key)
-        return await router.forward(request, key=key)
+        aid = obj.get("adapter") if obj else None
+        return await router.forward(
+            request, key=key,
+            adapter=aid if isinstance(aid, str) else None,
+        )
 
     @app.post("/predict")
     async def predict(request: Request):
         # No prefix economics on classification rows: route by load
-        # (power of two choices over the routable set).
+        # (power of two choices over the routable set) — unless the
+        # row names a tenant adapter, which routes by the same HRW
+        # affinity as /generate (the tenant's slot lives somewhere).
+        obj = router.parse_body(request.body)
+        aid = obj.get("adapter") if obj else None
+        if isinstance(aid, str) and aid:
+            return await router.forward(
+                request,
+                key=aid.encode("utf-8", "surrogatepass")[
+                    : router.affinity_prefix_bytes
+                ],
+                adapter=aid,
+            )
         return await router.forward(request)
 
     @app.post("/files/")

@@ -485,12 +485,28 @@ class UnitScheduler:
                 order = list(enumerate(self._pending))
             held = sum(ln.reserved for ln in self._lanes)
             for _, g in order:
-                if (
+                pages_ok = (
                     pool is None
                     or not self._lanes
                     or self._page_need(g.reqs) + held
                     <= pool.pages_total
-                ):
+                )
+                # Adapter-slot term of the same reservation gate: the
+                # group's adapters must be installable NOW (free slots
+                # plus hold-free evictable ones), or its formation
+                # acquire would fail loudly mid-batch. With no lanes
+                # live the group starts unconditionally — the loud
+                # AdapterSlotsExhausted beats silent starvation when
+                # the slot pool is simply too small for one batch.
+                slots_ok = (
+                    self.eng.adapters is None
+                    or not self._lanes
+                    or self.eng.adapters.can_claim({
+                        r.adapter for r in g.reqs
+                        if getattr(r, "adapter", None) is not None
+                    })
+                )
+                if pages_ok and slots_ok:
                     self._pending.remove(g)
                     # Claimed: visible to idle/backlog/sweep via the
                     # forming slot until the lane exists.
@@ -499,7 +515,10 @@ class UnitScheduler:
                 if not g.deferred_counted:
                     # Once per deferral episode, not per re-check.
                     g.deferred_counted = True
-                    self.eng.sched_pages_deferred += 1
+                    if pages_ok:
+                        self.eng.sched_adapters_deferred += 1
+                    else:
+                        self.eng.sched_pages_deferred += 1
             return None
 
     def _cached_summary(self):

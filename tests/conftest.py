@@ -134,11 +134,18 @@ _CACHE_FAMILIES = {
     # decode_chunk_fn at tier-wide sizes, so only the handful of
     # fused-width shapes are new; prefill and plain-chunk programs
     # come from the shared window.
+    # + the lora-serving module (r21): same CFG and engine shapes at
+    # page 8 / chunk 2 — adapter traffic reaches the family's
+    # prefill/decode programs through the one decode_chunk_fn seam;
+    # only the lora-augmented trace variants (grouped scalar-slot and
+    # gathered rows) are new, and they compile once in the shared
+    # window instead of re-paying the whole ladder.
     "paged-family": frozenset({
         "test_serving_fused",
         "test_kv_peer",
         "test_kv_push",
         "test_lock_witness",
+        "test_lora_serving",
         "test_paged_kv",
         "test_paged_kv_tier",
         "test_scheduler",

@@ -1,0 +1,778 @@
+"""Many-adapter LoRA serving (``serving/adapter_store.py`` +
+``models/lora.py``, the r21 tenant tier; ``--adapter-slots``).
+
+The contract, layer by layer:
+
+- **Wire format**: serialize → deserialize round-trips every adapter
+  leaf byte-identically with the geometry header intact; the payload
+  byte count is EXACT dtype/shape arithmetic (``adapter_bytes``);
+  truncated/garbled/mismatched bodies raise (counted misses at the
+  fetch seam, never installed). The disk artifact IS the wire image
+  (``save_adapter``/``load_adapter``, one validator).
+- **The slot path**: greedy streams are TOKEN-IDENTICAL slot-path vs
+  the eagerly-merged ``W + a @ b`` reference across
+  {gpt-MHA, llama-GQA} × {none, int8} caches, paged and contiguous —
+  grouped (one scalar-slot program per single-tenant batch) and
+  gathered (per-row slot indices, mixed tenants in ONE batch) both;
+  mixed-batch per-row streams equal each tenant run solo; base
+  programs stay byte-identical before and after adapter traffic.
+- **Residency**: host-store LRU with optional disk spill; device
+  slots install once (donated scatter), are pinned by live batches,
+  and evict LRU when hold-free; exhaustion is a LOUD
+  ``AdapterSlotsExhausted`` with nothing half-installed, and the
+  scheduler's reservation gate defers rather than forming a lane
+  that would die on it.
+- **The amortization pin**: HBM is ``base_bytes + N × slot_bytes``
+  in closed form (the /metrics gauge), never wall-clock; a cold
+  tenant onboards by peer fetch with ``prefix_builds``-family
+  counters flat (no prefill FLOPs spent on weight movement).
+
+Engines reuse the family CFG (conftest ``paged-family``) so the
+jitted program factories are shared instead of compiled again.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mlapi_tpu.models import get_model
+from mlapi_tpu.models.lora import DEFAULT_TARGETS, _kernel_of, merge_adapter
+from mlapi_tpu.serving import faults
+from mlapi_tpu.serving.adapter_store import (
+    ADAPTER_ID_RE,
+    AdapterSlotsExhausted,
+    AdapterStore,
+    AdapterUnavailable,
+    adapter_bytes,
+    deserialize_adapter,
+    load_adapter,
+    save_adapter,
+    serialize_adapter,
+)
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.requests import _SyncSink
+from mlapi_tpu.text import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+CFG = dict(
+    vocab_size=260,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    max_positions=160,
+    compute_dtype="float32",
+)
+
+
+def _model(kind="gpt_lm", kv_quant="none"):
+    kw = dict(CFG, kv_quant=kv_quant)
+    if kind == "llama_lm":
+        kw["num_kv_heads"] = 2  # GQA: 4 query heads over 2 KV heads
+    return get_model(kind, **kw)
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    return _model().init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return _model("llama_lm").init(jax.random.key(0))
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("chunk", 2)
+    kw.setdefault("fused_single", False)
+    kw.setdefault("kv_page_size", 8)
+    kw.setdefault("adapter_slots", 4)
+    return TextGenerationEngine(
+        model, params, tokenizer=ByteTokenizer(), **kw
+    )
+
+
+RANK = 4
+
+
+def _mk_adapter(params, seed=0, rank=RANK, scale=0.3):
+    """A random CANONICAL serving payload against ``params`` — every
+    DEFAULT_TARGET the model holds, at the base kernel dtype, ``b``
+    already carrying its scale (the ``export_adapter`` contract). The
+    scale is large enough that greedy continuations actually CHANGE
+    vs base (the identity pins would pass vacuously otherwise)."""
+    rng = np.random.default_rng(seed)
+    payload: dict = {}
+    for ln in sorted(
+        (k for k in params if k.startswith("layer_")),
+        key=lambda k: int(k.split("_")[1]),
+    ):
+        for t in DEFAULT_TARGETS:
+            node = params[ln].get(t) if t in params[ln] else None
+            kernel = _kernel_of(node) if node is not None else None
+            if kernel is None:
+                continue
+            d_in, d_out = kernel.shape
+            dt = np.dtype(kernel.dtype)
+            payload.setdefault(ln, {})[t] = {
+                "a": (scale * rng.standard_normal((d_in, rank))).astype(dt),
+                "b": (scale * rng.standard_normal((rank, d_out))).astype(dt),
+            }
+    return payload
+
+
+def _wire(warm_engine):
+    """An in-process transport serving ``warm_engine``'s host-store
+    adapters — the exact serve path (``AdapterPeer.serve_wire``)
+    without a socket, so the fetch client, wire format, counters, and
+    install path are all real."""
+
+    def transport(host, port, path, timeout_s):
+        aid = path.rsplit("/", 1)[1]
+        data = warm_engine.adapter_peer.serve_wire(aid)
+        return (200, data) if data is not None else (404, b"")
+
+    return transport
+
+
+def _link(cold_engine, warm_engine, aid):
+    cold_engine.adapter_peer._transport = _wire(warm_engine)
+    cold_engine.adapter_peer.note_hint(aid, "127.0.0.1:19")
+
+
+# --- wire format + artifact --------------------------------------------
+
+
+def test_wire_roundtrip_and_validation(gpt_params, tmp_path):
+    payload = _mk_adapter(gpt_params)
+    data = serialize_adapter("t1", payload)
+    out, rank, nbytes = deserialize_adapter("t1", data)
+    assert rank == RANK and nbytes == adapter_bytes(payload)
+    for ln, layer in payload.items():
+        for t, pair in layer.items():
+            for ab in ("a", "b"):
+                np.testing.assert_array_equal(out[ln][t][ab], pair[ab])
+
+    # Every corruption class raises (→ a counted miss at the fetch
+    # seam), never a wrong install.
+    for bad in (
+        b"garbage with no header",
+        b"{}\n",                                  # header missing fields
+        data[: len(data) // 2],                   # truncated payload
+        data + b"x",                              # trailing bytes
+        data.replace(b'"nbytes": ', b'"nbytes": 9', 1),  # total lies
+    ):
+        with pytest.raises(ValueError):
+            deserialize_adapter("t1", bad)
+    # The id is part of the validated manifest: a blob served under
+    # the wrong name is refused (tenant isolation on the wire).
+    with pytest.raises(ValueError):
+        deserialize_adapter("other-tenant", data)
+    # Ragged ranks across leaves are refused — slot pools force ONE
+    # engine-wide rank.
+    head_line, _, rest = data.partition(b"\n")
+    head = json.loads(head_line)
+    head["leaves"][0][3] = [head["leaves"][0][3][0], RANK + 1]
+    with pytest.raises(ValueError):
+        deserialize_adapter(
+            "t1", json.dumps(head).encode() + b"\n" + rest
+        )
+
+    # The disk artifact is the wire image, same validator.
+    p = tmp_path / "t1.lora"
+    assert save_adapter(str(p), "t1", payload) == adapter_bytes(payload)
+    aid, loaded, rank2, nbytes2 = load_adapter(str(p))
+    assert (aid, rank2, nbytes2) == ("t1", RANK, adapter_bytes(payload))
+    np.testing.assert_array_equal(
+        loaded["layer_0"]["qkv"]["a"], payload["layer_0"]["qkv"]["a"]
+    )
+
+
+def test_adapter_id_grammar():
+    for ok in ("t1", "tenant-42", "A.b_c-d", "x" * 64):
+        assert ADAPTER_ID_RE.match(ok)
+    for bad in ("", "x" * 65, "a b", "a/b", "a\nb", "../etc", "ü"):
+        assert not ADAPTER_ID_RE.match(bad)
+
+
+# --- the slot path: token identity vs the merged reference -------------
+
+
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+@pytest.mark.parametrize("kind", ["gpt_lm", "llama_lm"])
+def test_slot_stream_identity(kind, fmt, gpt_params, llama_params):
+    """The acceptance pin: greedy streams are TOKEN-IDENTICAL slot
+    path vs the eagerly-merged ``W + a @ b`` reference, MHA and GQA,
+    both cache formats — and the adapter demonstrably bites (differs
+    from base), installs exactly once, runs the GROUPED program for
+    the single-tenant batch, and leaves the base programs serving
+    byte-identical streams afterwards."""
+    params = gpt_params if kind == "gpt_lm" else llama_params
+    model = _model(kind, fmt)
+    payload = _mk_adapter(params, seed=3)
+    eng = _engine(model, params)
+    eng.register_adapter("t1", payload)
+
+    base_ref = eng.generate_text(" q1", max_new_tokens=8)
+    merged = _engine(
+        model, merge_adapter(params, payload), adapter_slots=0
+    )
+    ref = merged.generate_text(" q1", max_new_tokens=8)
+    out = eng.generate_text(" q1", max_new_tokens=8, adapter="t1")
+    assert out["token_ids"] == ref["token_ids"]
+    assert out["token_ids"] != base_ref["token_ids"]  # it bites
+    assert eng.adapter_installs == 1
+    assert eng.adapter_grouped_batches == 1
+    assert eng.adapter_gathered_batches == 0
+    # Steady state: the slot is resident — no second install.
+    out2 = eng.generate_text(" q1", max_new_tokens=8, adapter="t1")
+    assert out2["token_ids"] == ref["token_ids"]
+    assert eng.adapter_installs == 1
+    # Base traffic after adapter traffic: byte-identical to before
+    # (slot 0 is the permanently-zero NULL row; plain params build
+    # the very same no-adapter program).
+    assert eng.generate_text(
+        " q1", max_new_tokens=8
+    )["token_ids"] == base_ref["token_ids"]
+
+
+def test_slot_stream_identity_contiguous(gpt_params):
+    """The same pin on the CONTIGUOUS cache (no paged pool): the lora
+    trace rides the one dispatch seam, whichever cache family."""
+    model = _model()
+    payload = _mk_adapter(gpt_params, seed=3)
+    eng = _engine(model, gpt_params, kv_page_size=None)
+    assert eng.pool is None
+    eng.register_adapter("t1", payload)
+    merged = _engine(
+        model, merge_adapter(gpt_params, payload),
+        adapter_slots=0, kv_page_size=None,
+    )
+    ref = merged.generate_text(" q1", max_new_tokens=8)
+    out = eng.generate_text(" q1", max_new_tokens=8, adapter="t1")
+    assert out["token_ids"] == ref["token_ids"]
+
+
+def test_mixed_tenant_batch_matches_solo(gpt_params):
+    """Mixed tenants in ONE batch (the gathered-BGMV program):
+    per-row streams equal each tenant run solo — including a base
+    (slot-0) row gathering its exactly-zero delta — and a same-tenant
+    pair still takes the grouped scalar-slot program."""
+    model = _model()
+    p1 = _mk_adapter(gpt_params, seed=3)
+    p2 = _mk_adapter(gpt_params, seed=4)
+    eng = _engine(model, gpt_params)
+    eng.register_adapter("t1", p1)
+    eng.register_adapter("t2", p2)
+
+    prompts = [" alpha", " brav0", " charl"]
+    tenants = ["t1", None, "t2"]
+    solos = [
+        eng.generate_text(p, max_new_tokens=6, adapter=a)["token_ids"]
+        for p, a in zip(prompts, tenants)
+    ]
+    grouped0 = eng.adapter_grouped_batches
+
+    outs: list = [[] for _ in prompts]
+    sinks = [
+        _SyncSink(
+            eng._encode(p, 6, 0.0, 0, None, adapter=a), outs[i]
+        )
+        for i, (p, a) in enumerate(zip(prompts, tenants))
+    ]
+    eng._run_batch(sinks)
+    assert all(s.error is None for s in sinks)
+    assert outs == solos                          # per-row identity
+    assert eng.adapter_gathered_batches == 1
+    assert eng.adapter_grouped_batches == grouped0  # not grouped
+
+    # Same-tenant pair: all live rows share one slot → grouped.
+    outs2: list = [[], []]
+    sinks2 = [
+        _SyncSink(eng._encode(p, 6, 0.0, 0, None, adapter="t1"), o)
+        for p, o in zip(prompts[:2], outs2)
+    ]
+    eng._run_batch(sinks2)
+    assert all(s.error is None for s in sinks2)
+    assert outs2[0] == solos[0]
+    assert eng.adapter_grouped_batches == grouped0 + 1
+    assert eng.adapter_gathered_batches == 1
+
+    # No leakage: base traffic after the mixed batches is untouched.
+    assert eng.generate_text(
+        prompts[1], max_new_tokens=6
+    )["token_ids"] == solos[1]
+
+
+def test_prefix_with_adapter_folds_into_prompt(gpt_params):
+    """The prefix cache holds BASE-model KV; an adapter request
+    naming a prefix folds it into the prompt (identical semantics,
+    zero cache pollution) and counts the decline where the cache's
+    other fallbacks land."""
+    model = _model()
+    eng = _engine(model, gpt_params)
+    eng.register_adapter("t1", _mk_adapter(gpt_params, seed=3))
+    pre = "You are a helpful bot."
+    ref = eng.generate_text(pre + " q1", max_new_tokens=6, adapter="t1")
+    fb0 = eng.prefix.fallbacks
+    out = eng.generate_text(
+        " q1", max_new_tokens=6, prefix=pre, adapter="t1"
+    )
+    assert out["token_ids"] == ref["token_ids"]
+    assert eng.prefix.builds == 0                 # never built base KV
+    assert eng.prefix.fallbacks == fb0 + 1
+
+
+# --- residency: store LRU/spill, slot LRU, exhaustion ------------------
+
+
+def test_store_lru_and_disk_spill(gpt_params, tmp_path):
+    """Host-store mechanics, no device: LRU eviction under a byte
+    budget; disk mode keeps the index RAM-light (the blob lives as
+    its wire file) and restores byte-identically; a vanished file is
+    a miss, not a crash."""
+    p1 = _mk_adapter(gpt_params, seed=1)
+    nb = adapter_bytes(p1)
+    ram = AdapterStore(max_bytes=2 * nb + 1)
+    for i, s in enumerate((1, 2, 3)):
+        ram.put(f"t{i}", _mk_adapter(gpt_params, seed=s))
+    assert ram.entries == 2 and ram.evictions == 1
+    assert not ram.has("t0") and ram.has("t2")    # t0 was coldest
+    assert ram.bytes_in_use == 2 * nb
+    # get() touches LRU order: t1 read → t2 becomes the next victim.
+    assert ram.get("t1") is not None
+    ram.put("t3", _mk_adapter(gpt_params, seed=4))
+    assert ram.has("t1") and not ram.has("t2")
+
+    disk = AdapterStore(max_bytes=8 * nb, disk_dir=str(tmp_path))
+    disk.put("t1", p1)
+    files = list(tmp_path.glob("adstore-*.bin"))
+    assert len(files) == 1                        # spilled to its file
+    got, rank, nbytes = disk.get("t1")
+    assert rank == RANK and nbytes == nb
+    np.testing.assert_array_equal(
+        got["layer_0"]["qkv"]["b"], p1["layer_0"]["qkv"]["b"]
+    )
+    files[0].unlink()                             # simulate loss
+    assert disk.get("t1") is None                 # miss, index dropped
+    assert disk.entries == 0
+
+
+def test_slot_exhaustion_loud_and_lru_eviction(gpt_params):
+    """Slot-pool mechanics through the engine: a held (live-batch)
+    adapter is pinned; installing past capacity with every slot held
+    is a LOUD AdapterSlotsExhausted with nothing half-installed;
+    releasing makes the LRU resident evictable and the next install
+    recycles its slot."""
+    model = _model()
+    eng = _engine(model, gpt_params, adapter_slots=1)
+    p1 = _mk_adapter(gpt_params, seed=1)
+    p2 = _mk_adapter(gpt_params, seed=2)
+    eng.register_adapter("t1", p1)
+    eng.register_adapter("t2", p2)
+    ref2 = _engine(
+        model, merge_adapter(gpt_params, p2), adapter_slots=0
+    ).generate_text(" q1", max_new_tokens=6)
+
+    slot = eng.adapters.acquire("t1", eng.adapter_store)  # pin t1
+    assert slot == 1 and eng.adapter_slots_in_use == 1
+    assert not eng.adapters.can_claim(["t2"])
+    with pytest.raises(AdapterSlotsExhausted):
+        eng.adapters.acquire("t2", eng.adapter_store)
+    assert eng.adapter_slots_in_use == 1          # nothing half-done
+    assert eng.adapters.resident("t1")
+    eng.adapters.release("t1")
+    assert eng.adapters.can_claim(["t2"])
+
+    # The next t2 request evicts hold-free t1 and reuses ITS slot —
+    # and decodes correctly through the recycled row.
+    out = eng.generate_text(" q1", max_new_tokens=6, adapter="t2")
+    assert out["token_ids"] == ref2["token_ids"]
+    assert eng.adapter_evictions == 1
+    assert eng.adapters.resident("t2") and not eng.adapters.resident("t1")
+    # Double-release is a loud assert, not a silent negative hold.
+    with pytest.raises(AssertionError):
+        eng.adapters.release("t2")
+
+
+async def test_scheduler_defers_on_slot_pressure(gpt_params):
+    """The reservation-gate satellite: with ONE slot and a live
+    tenant lane holding it, a second tenant's group DEFERS (counted)
+    instead of forming a lane that would die on exhaustion — then
+    claims, evicts, and serves once the holder finishes."""
+    model = _model()
+    eng = _engine(
+        model, gpt_params, adapter_slots=1, max_wait_ms=0.0,
+    )
+    eng.register_adapter("t1", _mk_adapter(gpt_params, seed=1))
+    eng.register_adapter("t2", _mk_adapter(gpt_params, seed=2))
+    await eng.start()
+    try:
+        ra = await eng.submit(
+            " a", max_new_tokens=48, adapter="t1", stream=True
+        )
+        # Wait for t1's first streamed chunk: its lane is now LIVE and
+        # holds the only slot, with decode units still pending — so
+        # t2's group below must hit the gate, not slip in after t1
+        # drained (the race a loaded 1-core box loses).
+        first = await ra.queue.get()
+        assert first is not None and not isinstance(first, Exception)
+        rb = await eng.submit(
+            " b", max_new_tokens=4, adapter="t2", stream=True
+        )
+
+        async def collect(req, pre=()):
+            out: list = list(pre)
+            while True:
+                item = await req.queue.get()
+                if item is None:
+                    return out, None
+                if isinstance(item, Exception):
+                    return out, item
+                out.extend(item["token_ids"])
+
+        (ta, ea), (tb, eb) = await asyncio.gather(
+            collect(ra, first["token_ids"]), collect(rb)
+        )
+        assert ea is None and eb is None
+        assert len(ta) == 48 and len(tb) == 4
+        assert eng.sched_adapters_deferred >= 1
+        assert eng.adapter_evictions == 1         # t2 recycled t1's slot
+    finally:
+        await eng.stop()
+
+
+# --- cold fetch: tenant onboarding over the wire -----------------------
+
+
+def test_cold_fetch_from_peer_counters_flat(gpt_params):
+    """A cold replica serving a tenant it never saw fetches the blob
+    from its hinted warm peer and streams TOKEN-IDENTICAL — with the
+    wire bytes the exact closed form, the blob staged into the host
+    store, and the ``prefix_builds``-family counters FLAT (onboarding
+    moves weights, never spends prefill FLOPs)."""
+    model = _model()
+    payload = _mk_adapter(gpt_params, seed=3)
+    warm = _engine(model, gpt_params)
+    warm.register_adapter("t1", payload)
+    ref = warm.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    cold = _engine(model, gpt_params)
+    _link(cold, warm, "t1")
+
+    out = cold.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    assert out["token_ids"] == ref["token_ids"]
+    closed = adapter_bytes(payload)
+    assert cold.adapter_fetch_hits == 1
+    assert cold.adapter_fetch_bytes == closed
+    assert warm.adapter_serve_count == 1
+    assert warm.adapter_serve_bytes == closed
+    assert cold.adapter_store_entries == 1        # staged locally
+    assert cold.adapter_installs == 1
+    assert cold.prefix.builds == 0                # counters flat
+    assert cold.prefix.fallbacks == 0
+    # Steady state: resident — no second wire hop.
+    out2 = cold.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    assert out2["token_ids"] == ref["token_ids"]
+    assert cold.adapter_fetch_hits == 1
+
+
+def test_fetch_failure_modes(gpt_params):
+    """404 → counted miss AND the hint dropped (the peer is not warm
+    after all); corrupt body → counted miss, never installed;
+    transport error → counted failure. Every mode surfaces as the
+    404-mapped AdapterUnavailable, request never queued."""
+    model = _model()
+    cold = _engine(model, gpt_params)
+    cold.adapter_peer._transport = lambda h, p, path, t: (404, b"")
+    cold.adapter_peer.note_hint("t1", "127.0.0.1:19")
+    with pytest.raises(AdapterUnavailable):
+        cold.generate_text(" q1", max_new_tokens=4, adapter="t1")
+    assert cold.adapter_fetch_misses == 1
+    assert cold.adapter_peer.hint_for("t1") is None
+
+    cold.adapter_peer._transport = lambda h, p, path, t: (200, b"junk")
+    cold.adapter_peer.note_hint("t1", "127.0.0.1:19")
+    with pytest.raises(AdapterUnavailable):
+        cold.generate_text(" q1", max_new_tokens=4, adapter="t1")
+    assert cold.adapter_fetch_misses == 2
+    assert cold.adapter_store_entries == 0        # never installed
+
+    def boom(h, p, path, t):
+        raise ConnectionRefusedError("peer is down")
+
+    cold.adapter_peer._transport = boom
+    cold.adapter_peer.note_hint("t1", "127.0.0.1:19")
+    with pytest.raises(AdapterUnavailable):
+        cold.generate_text(" q1", max_new_tokens=4, adapter="t1")
+    assert cold.adapter_fetch_failures == 1
+    # Malformed / unknown ids 404 before any queueing.
+    with pytest.raises(AdapterUnavailable):
+        cold.generate_text(" q1", max_new_tokens=4, adapter="../etc")
+    off = _engine(model, gpt_params, adapter_slots=0)
+    with pytest.raises(AdapterUnavailable):
+        off.generate_text(" q1", max_new_tokens=4, adapter="t1")
+
+
+def test_adapter_fault_matrix(gpt_params):
+    """The r12-style fault-matrix satellite: a raise at
+    ``adapter_fetch`` is a counted fetch failure resolving to the 404
+    contract; a raise at ``adapter_install`` fails the batch LOUDLY on
+    untouched slot state (free list intact, nothing resident) and the
+    next clean run installs and serves; delays slow, never break."""
+    model = _model()
+    payload = _mk_adapter(gpt_params, seed=3)
+    warm = _engine(model, gpt_params)
+    warm.register_adapter("t1", payload)
+    ref = warm.generate_text(" q1", max_new_tokens=6, adapter="t1")
+
+    cold = _engine(model, gpt_params)
+    _link(cold, warm, "t1")
+    with faults.active("adapter_fetch:raise"):
+        with pytest.raises(AdapterUnavailable):
+            cold.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    assert cold.adapter_fetch_failures == 1
+    assert cold.adapter_fetch_hits == 0
+    assert warm.adapter_serve_count == 0          # raised before wire
+
+    eng = _engine(model, gpt_params)
+    eng.register_adapter("t1", payload)
+    with faults.active("adapter_install:raise"):
+        with pytest.raises(faults.InjectedFault):
+            eng.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    assert eng.adapter_installs == 0              # untouched state
+    assert eng.adapter_slots_in_use == 0
+    out = eng.generate_text(" q1", max_new_tokens=6, adapter="t1")
+    assert out["token_ids"] == ref["token_ids"]   # clean recovery
+    assert eng.adapter_installs == 1
+
+    cold = _engine(model, gpt_params)
+    _link(cold, warm, "t1")
+    with faults.active("adapter_fetch:delay=0.01,adapter_install:delay=0.01"):
+        out = cold.generate_text(" q1", max_new_tokens=6, adapter="t1")
+        assert faults.injected_count() == 2
+    assert out["token_ids"] == ref["token_ids"]
+    assert cold.adapter_fetch_hits == 1
+
+
+# --- the amortization pin ----------------------------------------------
+
+
+def test_hbm_amortization_closed_form(gpt_params):
+    """HBM is ``base_bytes + N × slot_bytes``, all three terms pure
+    dtype/shape arithmetic recomputed here independently — never
+    wall-clock, never device introspection. Each resident tenant
+    costs EXACTLY one slot row across every pool leaf."""
+    model = _model()
+    eng = _engine(model, gpt_params, adapter_slots=4)
+    base = sum(
+        int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+        for v in jax.tree.leaves(eng.params)
+        if hasattr(v, "dtype")
+    )
+    slot = 0
+    for ln in (k for k in eng.params if k.startswith("layer_")):
+        for t in DEFAULT_TARGETS:
+            kernel = (
+                _kernel_of(eng.params[ln][t]) if t in eng.params[ln]
+                else None
+            )
+            if kernel is None:
+                continue
+            d_in, d_out = kernel.shape
+            itemsize = np.dtype(kernel.dtype).itemsize
+            slot += (d_in * RANK + RANK * d_out) * itemsize
+    assert eng.adapter_slot_bytes == 0            # pools not built yet
+    assert eng.adapter_resident_bytes == base
+
+    for i, s in enumerate((1, 2, 3)):
+        eng.register_adapter(f"t{i}", _mk_adapter(gpt_params, seed=s))
+        eng.generate_text(" q", max_new_tokens=2, adapter=f"t{i}")
+        assert eng.adapter_slots_in_use == i + 1
+        assert eng.adapter_slot_bytes == slot
+        assert eng.adapter_resident_bytes == base + (i + 1) * slot
+
+
+# --- the replica surface (endpoint, schema, hints, metrics) ------------
+
+
+async def _asgi_client(app):
+    import httpx
+
+    await app.startup()
+    transport = httpx.ASGITransport(app=app)
+    return httpx.AsyncClient(transport=transport, base_url="http://t")
+
+
+async def test_adapter_endpoint_and_metrics(gpt_params, monkeypatch):
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    model = _model()
+    payload = _mk_adapter(gpt_params, seed=3)
+    eng = _engine(model, gpt_params)
+    eng.register_adapter("t1", payload)
+    ref = eng.generate_text(" q1", max_new_tokens=4, adapter="t1")
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        r = await cl.get("/adapter/t1")
+        assert r.status_code == 200
+        assert r.headers["content-type"] == "application/octet-stream"
+        got, rank, nbytes = deserialize_adapter("t1", r.content)
+        assert rank == RANK and nbytes == adapter_bytes(payload)
+        assert eng.adapter_serve_count == 1
+        assert (await cl.get("/adapter/nope")).status_code == 404
+        assert (await cl.get("/adapter/..%2Fetc")).status_code == 404
+
+        # The /generate schema field: routed through the slot path,
+        # identical to the engine-level stream; unknown tenants 404.
+        r = await cl.post(
+            "/generate",
+            json={"text": " q1", "max_new_tokens": 4, "adapter": "t1"},
+        )
+        assert r.status_code == 200
+        assert r.json()["token_ids"] == ref["token_ids"]
+        r = await cl.post(
+            "/generate",
+            json={"text": " q1", "max_new_tokens": 4, "adapter": "ghost"},
+        )
+        assert r.status_code == 404
+
+        snap = (await cl.get("/metrics")).json()
+        c, g = snap["counters"], snap["gauges"]
+        assert c["generate.adapter_serve_count"] == 1
+        assert c["generate.adapter_installs"] == 1
+        assert c["generate.adapter_grouped_batches"] >= 1
+        for k in ("fetch_hits", "fetch_misses", "fetch_bytes",
+                  "fetch_failures", "gathered_batches",
+                  "store_evictions", "evictions"):
+            assert c[f"generate.adapter_{k}"] == 0
+        assert c["generate.sched_adapters_deferred"] == 0
+        assert g["generate.adapter_slots_total"] == 4
+        assert g["generate.adapter_slots_in_use"] == 1
+        assert g["generate.adapter_slot_bytes"] == eng.adapter_slot_bytes
+        assert g["generate.adapter_resident_bytes"] == (
+            eng.adapter_resident_bytes
+        )
+        assert g["generate.adapter_store_entries"] == 1
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_endpoint_and_metrics_absent_when_disabled(
+    gpt_params, monkeypatch,
+):
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+    eng = _engine(_model(), gpt_params, adapter_slots=0)
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        assert (await cl.get("/adapter/t1")).status_code == 404
+        # An adapter-carrying request against a slotless replica is
+        # the same 404 contract (resolved before queueing).
+        r = await cl.post(
+            "/generate",
+            json={"text": " q", "max_new_tokens": 2, "adapter": "t1"},
+        )
+        assert r.status_code == 404
+        snap = (await cl.get("/metrics")).json()
+        assert not any(
+            k.startswith("generate.adapter")
+            for k in {**snap["counters"], **snap["gauges"]}
+        )
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_endpoint_absent_on_non_replica(gpt_params, monkeypatch):
+    """Replica-gated like GET /kv/prefix: a direct-facing server must
+    not hand tenant weight blobs to arbitrary callers."""
+    from mlapi_tpu.serving import build_app
+
+    monkeypatch.delenv("MLAPI_TPU_REPLICA", raising=False)
+    monkeypatch.delenv("MLAPI_TPU_REPLICAS", raising=False)
+    eng = _engine(_model(), gpt_params)
+    eng.register_adapter("t1", _mk_adapter(gpt_params))
+    app = build_app(eng)
+    cl = await _asgi_client(app)
+    try:
+        assert (await cl.get("/adapter/t1")).status_code == 404
+        assert eng.adapter_serve_count == 0
+    finally:
+        await cl.aclose()
+        await app.shutdown()
+
+
+async def test_warm_peer_hint_gated_to_replicas(gpt_params, monkeypatch):
+    """The EXISTING x-mlapi-warm-peer header doubles as the adapter
+    warmth hint (the tenant's prefix-affinity peer is where its
+    adapter is warm) — trusted only on router replicas."""
+    from mlapi_tpu.serving import build_app
+
+    async def post(replica: bool):
+        if replica:
+            monkeypatch.setenv("MLAPI_TPU_REPLICA", "1")
+        else:
+            monkeypatch.delenv("MLAPI_TPU_REPLICA", raising=False)
+        eng = _engine(_model(), gpt_params)
+        eng.register_adapter("t1", _mk_adapter(gpt_params))
+        app = build_app(eng)
+        cl = await _asgi_client(app)
+        try:
+            r = await cl.post(
+                "/generate",
+                json={"text": " q", "max_new_tokens": 2, "adapter": "t1"},
+                headers={"x-mlapi-warm-peer": "10.0.0.9:8001"},
+            )
+            assert r.status_code == 200
+        finally:
+            await cl.aclose()
+            await app.shutdown()
+        return eng
+
+    eng = await post(True)
+    assert eng.adapter_peer.hint_for("t1") == ("10.0.0.9", 8001)
+    eng = await post(False)
+    assert eng.adapter_peer.hint_for("t1") is None
+
+
+def test_router_key_precedence_and_disagg_gate():
+    """Router policy units (pure functions, no sockets): the affinity
+    key prefers prefix > adapter > text, and adapter bodies never
+    take the role-split two-hop path (the tenant's slot working set
+    stays in one role pool)."""
+    from mlapi_tpu.serving.router import Router
+
+    r = Router([("127.0.0.1", 1), ("127.0.0.1", 2)])
+    assert r.routing_key_of({"prefix": "P", "adapter": "t1"}) == b"P"
+    assert r.routing_key_of({"adapter": "t1", "text": "x"}) == b"t1"
+    assert r.routing_key_of({"text": "x"}) == b"x"
+    assert r.routing_key_of({"adapter": 7, "text": ""}) is None
+
+    rs = Router(
+        [("127.0.0.1", 1), ("127.0.0.1", 2)],
+        roles=["prefill", "decode"],
+    )
+    assert rs.wants_disagg_of({"text": "x"})
+    assert not rs.wants_disagg_of({"text": "x", "adapter": "t1"})
+    assert not rs.wants_disagg_of({"text": "x", "prefix": "P"})

@@ -763,6 +763,11 @@ _ENGINE_POINTS = tuple(
         # either point fails the transfer and the decode replica
         # cold-prefills with kv_pages_in_use conserved on both ends.
         "kv_push_send", "kv_push_recv",
+        # The adapter seams (crossed only with --adapter-slots > 0 and
+        # a request naming a tenant) have their matrix in
+        # test_lora_serving.py: a fetch raise is a counted miss / 404,
+        # an install raise rejects the joiner with the pool intact.
+        "adapter_fetch", "adapter_install",
     )
 )
 

@@ -105,6 +105,42 @@ LOCK_REGISTRY: dict[str, LockSpec] = {
             "hits", "misses", "fallbacks", "builds",
         }),
     ),
+    # r21 per-tenant adapter state. Deliberately NOT listed:
+    # AdapterSlots.pools / AdapterSlots.rank — mutated only by
+    # install()/_materialize() on the one dispatch thread (the same
+    # single-writer contract as PagePool.layers); the donated scatter
+    # could not tolerate a concurrent reader anyway.
+    "AdapterStore": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            # Host LRU index + byte accounting: registrations arrive
+            # from the event loop (register_adapter), fetch staging
+            # from encode executor threads, spill/evict from either;
+            # evictions is /metrics-scraped.
+            "_blobs", "_bytes", "_seq", "evictions",
+        }),
+    ),
+    "AdapterSlots": LockSpec(
+        locks=frozenset({"lock"}),
+        attrs=frozenset({
+            # Slot map + holds: acquire/release cross from the
+            # dispatch thread (batch formation/teardown) while
+            # can_claim reads from the scheduler's advance; installs/
+            # evictions are /metrics-scraped.
+            "_slot_of", "_holds", "_free", "installs", "evictions",
+        }),
+    ),
+    "AdapterPeer": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({
+            # Warm-peer hints land from the event loop, fetch
+            # counters from encode executor threads, serve counters
+            # from the app executor — the KVPeer shape exactly.
+            "_hints",
+            "fetch_hits", "fetch_misses", "fetch_bytes",
+            "fetch_failures", "serve_count", "serve_bytes",
+        }),
+    ),
     "LatencyStats": LockSpec(
         locks=frozenset({"_lock"}),
         attrs=frozenset({"_ttft_ms", "_itl_ms"}),
@@ -150,6 +186,12 @@ DISTINCTIVE_ATTRS: dict[str, frozenset[str]] = {
     "builds": frozenset({"_lock"}),
     "fallbacks": frozenset({"_lock"}),
     "mix_warmed": frozenset({"_lock"}),
+    # r21 adapter containers (batch_run holds/releases through the
+    # AdapterSlots API today, but a future direct mutation of the
+    # slot map or hold table from outside the class must still sit
+    # under the instance's lock).
+    "_slot_of": frozenset({"lock"}),
+    "_holds": frozenset({"lock"}),
 }
 
 # Methods on guarded attributes that mutate the container. Reads
@@ -179,6 +221,9 @@ INSTANCE_BINDINGS: dict[str, str] = {
     "eng": "TextGenerationEngine",
     "engine": "TextGenerationEngine",
     "batcher": "MicroBatcher",
+    "adapter_store": "AdapterStore",
+    "adapters": "AdapterSlots",
+    "adapter_peer": "AdapterPeer",
 }
 # Where the machine-readable partial order is committed (the rule
 # recomputes it every run; the tier-1 test pins the committed file to
