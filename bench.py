@@ -1640,10 +1640,11 @@ def _sched_report(ck: str, env: dict) -> dict:
       ~n/tier decode dispatches vs ~n/chunk), wall-clock medians
       reported for the record.
 
-    Since r20 ``scheduler=False`` is the serial escape hatch (same
-    machinery pinned to one lane), so the off-mode counters are
-    serial-shaped (one live lane, units still ticking) rather than
-    zero.
+    Since r20 the serial escape hatch is the same machinery pinned
+    to one lane (``sched_max_batches=1``; the ``scheduler=`` kwarg
+    and ``--no-scheduler`` flag were retired in r22), so the
+    off-mode counters are serial-shaped (one live lane, units still
+    ticking) rather than zero.
     """
     src = f"""
 import asyncio, json, time
@@ -1712,7 +1713,7 @@ async def measure():
     engines = {{}}
     for mode in (True, False):
         engines[mode] = TextGenerationEngine(
-            model, params, scheduler=mode, sched_max_batches=2, **kw)
+            model, params, sched_max_batches=(2 if mode else 1), **kw)
         await engines[mode].start()
     try:
         ref = {{}}
@@ -1818,6 +1819,164 @@ print(json.dumps(report))
     )
     if out.returncode != 0:
         return {"sched_report_error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _multi_report(ck: str, env: dict) -> dict:
+    """Subprocess: multi-model co-residency on the SAME checkpoint
+    (``BENCH_GEN_MULTI=1``) — a generative engine plus a scoring
+    fast path (r22 ``ScorePath``) sharing the ONE unit scheduler.
+    Claim classes per the variance rule:
+
+    - **One scheduler — counter-asserted.** Every scoring device
+      call the co-resident legs make runs as a typed ``score`` unit:
+      ``sched_dispatches == device_calls`` on the path and the
+      engine's ``sched_units_score`` matches exactly. Greedy streams
+      asserted IDENTICAL between the solo and co-resident legs,
+      in-subprocess — scoring traffic never perturbs decode math.
+    - **Coalescing — counter-asserted, never wall-clock.** A plugged
+      first batch lets a 24-request burst pile up; release drains it
+      in ceil(24/16) device calls, so requests/device_calls lands at
+      25/3 with a 16-row max batch — asserted >= 3 at max batch >= 8
+      (the acceptance floor). Pool backend on purpose: plugging the
+      runner under the sched backend would stall the dispatch thread
+      (and the decode lanes with it); both backends run the same
+      collection loop, so the coalescing claim carries over.
+    - **Running-stream inter-token, solo vs co-resident — measured,
+      alternated inside ONE window.** The long stream's gap
+      distribution with a scoring burst co-resident is the cost side
+      of sharing the machine (cross-lane stall is bounded at 1 by
+      the alternation policy); both legs subject to VARIANCE_NOTE on
+      this box.
+    """
+    src = f"""
+import asyncio, json, threading, time
+import numpy as np
+from mlapi_tpu.utils.platform import apply_platform_override
+apply_platform_override()
+from mlapi_tpu.checkpoint import load_checkpoint
+from mlapi_tpu.models import get_model
+from mlapi_tpu.serving.engine import TextGenerationEngine
+from mlapi_tpu.serving.scoring import ScorePath
+from mlapi_tpu.text import ByteTokenizer
+
+params, meta = load_checkpoint({ck!r})
+model = get_model(meta.config["model"], **meta.config["model_kwargs"])
+tok = ByteTokenizer()
+kw = dict(tokenizer=tok, chunk=8, fused_single=False,
+          kv_page_size=16, prompt_buckets=(16, 64), max_wait_ms=0.0)
+GEN_N, BURST = 64, 24
+report = {{}}
+
+class ScoreStub:
+    # Tabular-classifier stand-in: the claims here are about
+    # BATCHING and SCHEDULING, not the predict math, and a
+    # generative checkpoint has no classification head to borrow.
+    max_batch = 16
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.batch_sizes = []
+    def predict_labels(self, batch):
+        self.gate.wait()
+        self.batch_sizes.append(len(batch))
+        return ([str(float(r[0])) for r in batch],
+                np.full(len(batch), 0.5))
+
+async def stream_round(eng, sp):
+    stamps = []
+    r = await eng.submit("warm me up", max_new_tokens=GEN_N,
+                         stream=True)
+    score = None
+    if sp is not None:
+        score = asyncio.gather(*[
+            sp.submit(np.full(4, float(i))) for i in range(4)])
+    out = []
+    while True:
+        item = await r.queue.get()
+        if item is None:
+            break
+        if isinstance(item, Exception):
+            raise item
+        stamps.append((time.perf_counter(), len(item["token_ids"])))
+        out.extend(item["token_ids"])
+    if score is not None:
+        labels = [lab for lab, _ in await score]
+        assert labels == [str(float(i)) for i in range(4)], labels
+    gaps = [
+        (stamps[i][0] - stamps[i - 1][0]) * 1e3 / max(1, stamps[i][1])
+        for i in range(1, len(stamps))
+    ]
+    return gaps, out
+
+async def co_resident():
+    eng = TextGenerationEngine(model, params, sched_max_batches=2,
+                               **kw)
+    await eng.start()
+    sp = ScorePath(ScoreStub(), model_id="clf", max_wait_ms=0.0,
+                   sched_source=lambda: eng.sched)
+    await sp.start()
+    try:
+        _, ref = await stream_round(eng, None)  # compile, off clock
+        gaps = {{"solo": [], "co": []}}
+        for _ in range(4):                  # alternated: ONE window
+            for leg, path in (("solo", None), ("co", sp)):
+                g, out = await stream_round(eng, path)
+                assert out == ref, leg      # streams identical
+                gaps[leg].extend(g)
+        assert sp.sched_dispatches == sp.device_calls > 0
+        assert eng.sched_units_score == sp.sched_dispatches
+        report["multi_sched_dispatches"] = sp.sched_dispatches
+        report["multi_units_score"] = eng.sched_units_score
+        return gaps
+    finally:
+        await sp.stop()
+        await eng.stop()
+
+async def coalesce():
+    stub = ScoreStub()
+    sp = ScorePath(stub, model_id="clf", max_batch=16,
+                   max_wait_ms=5.0, max_inflight=1)
+    await sp.start()
+    try:
+        stub.gate.clear()                   # plug the device
+        plug = asyncio.ensure_future(sp.submit(np.zeros(4)))
+        while sp.device_calls < 1:          # plug holds the one slot
+            await asyncio.sleep(0.001)
+        burst = [asyncio.ensure_future(sp.submit(np.full(4, float(i))))
+                 for i in range(BURST)]
+        while sp.queue_depth < BURST:       # all queued behind it
+            await asyncio.sleep(0.001)
+        stub.gate.set()                     # release: burst coalesces
+        await asyncio.gather(plug, *burst)
+        assert sp.device_calls == 1 + -(-BURST // 16), sp.device_calls
+        ratio = sp.requests / sp.device_calls
+        assert ratio >= 3.0 and max(stub.batch_sizes) >= 8
+        report["multi_coalesce_ratio"] = round(ratio, 2)
+        report["multi_score_batch_max"] = max(stub.batch_sizes)
+        report["multi_score_device_calls"] = sp.device_calls
+    finally:
+        await sp.stop()
+
+gaps = asyncio.run(co_resident())
+asyncio.run(coalesce())
+q = lambda xs, f: round(sorted(xs)[min(len(xs) - 1,
+                                       int(f * len(xs)))], 2)
+report["multi_solo_intertoken_p50_ms"] = q(gaps["solo"], 0.5)
+report["multi_solo_intertoken_p95_ms"] = q(gaps["solo"], 0.95)
+report["multi_co_intertoken_p50_ms"] = q(gaps["co"], 0.5)
+report["multi_co_intertoken_p95_ms"] = q(gaps["co"], 0.95)
+report["multi_streams_identical"] = True
+print(json.dumps(report))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        env=dict(os.environ, **env), capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", "480")),
+    )
+    if out.returncode != 0:
+        return {"multi_report_error": out.stderr[-400:]}
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
@@ -2220,7 +2379,7 @@ def bench_generate() -> None:
                     # Scheduler v2 (r15, default-on since r20): the
                     # per-unit-type dispatch counters are the
                     # interleaving evidence; serial-shaped (one live
-                    # lane) under --no-scheduler.
+                    # lane) at --sched-max-batches 1.
                     "generate.sched_",
                 ))
             })
@@ -2282,6 +2441,14 @@ def bench_generate() -> None:
             # window; interleaving asserted from sched_* counters and
             # streams asserted identical in-subprocess.
             kv_extras.update(_sched_report(ck, server_env))
+        if os.environ.get("BENCH_GEN_MULTI") == "1":
+            # Multi-model serving (r22): generation-only vs
+            # generation+scoring-co-resident legs alternated in one
+            # window on the ONE scheduler — score-unit dispatches and
+            # the burst-coalescing ratio asserted from counters
+            # (never wall-clock), greedy streams asserted identical
+            # in-subprocess.
+            kv_extras.update(_multi_report(ck, server_env))
         if os.environ.get("BENCH_GEN_DISAGG") == "1":
             # Prefill/decode disaggregation: P=1+D=1 role-split vs 2
             # mixed replicas alternated in one window on a

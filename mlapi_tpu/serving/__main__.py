@@ -171,11 +171,21 @@ def _forwarded_engine_flags(args) -> list:
         cmd += ["--no-prefill-page-native"]
     if not getattr(args, "prefill_interleave", True):
         cmd += ["--no-prefill-interleave"]
-    if not getattr(args, "scheduler", True):
-        cmd += ["--no-scheduler"]
-    else:
-        cmd += ["--sched-max-batches",
-                str(getattr(args, "sched_max_batches", 2))]
+    cmd += ["--sched-max-batches",
+            str(getattr(args, "sched_max_batches", 2))]
+    # Multi-model + multi-tenant config replicates to every child:
+    # the whole fleet serves the same registry under the same quota
+    # table (per-model replica groups come from children launched
+    # with DIFFERENT --model sets via --replica-urls).
+    for spec in getattr(args, "model", None) or ():
+        cmd += ["--model", spec]
+    for flag, key in (
+        ("--tenant-pages", "tenant_pages"),
+        ("--tenant-slots", "tenant_slots"),
+        ("--tenant-weight", "tenant_weight"),
+    ):
+        for spec in getattr(args, key, None) or ():
+            cmd += [flag, spec]
     if getattr(args, "mesh_shape", None):
         cmd += ["--mesh-shape", args.mesh_shape]
     if getattr(args, "draft_checkpoint", None):
@@ -397,8 +407,19 @@ def _supervise_router(ckpt: str | None, args) -> int:
             assume_live=False,
             roles=roles,
         )
-        server = Server(build_router_app(router), host=args.host,
-                        port=args.port)
+        # Per-model front routes mirror the replicas' own surface:
+        # every --model id plus the implicit default entry (replicas
+        # in multi-model mode serve /models/default/* too).
+        mids = [
+            spec.partition("=")[0].strip()
+            for spec in (getattr(args, "model", None) or ())
+        ]
+        server = Server(
+            build_router_app(
+                router, model_ids=(["default"] + mids) if mids else None
+            ),
+            host=args.host, port=args.port,
+        )
         stop_ev = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (_signal.SIGTERM, _signal.SIGINT):
@@ -501,6 +522,43 @@ def main(argv=None) -> None:
     parser.add_argument("--checkpoint", help="committed checkpoint dir")
     parser.add_argument(
         "--demo-iris", action="store_true", help="train Iris now and serve it"
+    )
+    parser.add_argument(
+        "--model", action="append", metavar="ID=CHECKPOINT",
+        help="multi-model serving (repeatable): ADD model ID from "
+             "CHECKPOINT to this process's registry, served at "
+             "/models/ID/{generate|predict}. --checkpoint stays the "
+             "DEFAULT model (id 'default', owns the legacy /generate "
+             "and /predict routes). Generative entries get their own "
+             "BatchRun lanes; classification/recsys entries get the "
+             "scoring fast path — formed micro-batches ride the "
+             "first generative entry's unit scheduler as typed "
+             "'score' units between decode chunks (one HBM, one "
+             "dispatch thread, one policy). Watch model.<id>.* on "
+             "/metrics",
+    )
+    parser.add_argument(
+        "--tenant-pages", action="append", metavar="TENANT=N",
+        help="per-tenant KV page quota (repeatable; paged engines): "
+             "a tenant holding reservations may not grow past N "
+             "pages — further group starts defer (counted in "
+             "generate.sched_tenant_pages_deferred and "
+             "tenant.<t>.deferrals) until its own pages free. "
+             "Unlisted tenants are unquotaed",
+    )
+    parser.add_argument(
+        "--tenant-slots", action="append", metavar="TENANT=N",
+        help="per-tenant adapter-slot quota (repeatable; with "
+             "--adapter-slots): same deferral discipline as "
+             "--tenant-pages, over device adapter slots",
+    )
+    parser.add_argument(
+        "--tenant-weight", action="append", metavar="TENANT=W",
+        help="per-tenant scheduling weight (repeatable; default "
+             "1.0): deadline slack divides by W in the unit "
+             "scheduler's pick policy, so a weight-2 tenant's "
+             "requests look twice as urgent at equal slack. "
+             "Starvation-safe: alternation floors still apply",
     )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8000)
@@ -717,30 +775,17 @@ def main(argv=None) -> None:
              "--no-prefill-interleave defers long joiners to their "
              "own batch",
     )
-    # r21: the r20 one-release deprecated aliases retired on
-    # schedule — the redundant positive `--scheduler` (the scheduler
-    # is the default; passing the old flag now errors at parse, which
-    # IS the scheduled removal) and the ignored `--fused-batch`.
-    # `--no-scheduler` stays one more release as documented.
-    parser.add_argument(
-        "--no-scheduler", dest="scheduler", action="store_false",
-        default=True,
-        help="escape hatch (one more release, then removed): pin ONE "
-             "lane — the legacy serial semantics on the same "
-             "machinery. The continuous-batching scheduler v2 is the "
-             "default: up to --sched-max-batches decode batches "
-             "CONCURRENTLY, interleaved at typed-unit granularity "
-             "(prefill chunk / decode chunk / spec round / admission "
-             "/ compaction) on one device stream, prioritized by "
-             "deadline slack with TTFT/inter-token targets fed from "
-             "the live latency reservoirs. Greedy streams are pinned "
-             "token-identical across modes. Watch "
-             "generate.sched_units_* / sched_batches_live on "
-             "/metrics. Generative checkpoints only",
-    )
+    # r22: `--no-scheduler` retired on schedule (deprecated r20,
+    # kept one release r21). The scheduler IS the execution model;
+    # the one thing the flag still did — pin a single lane — is
+    # `--sched-max-batches 1`, same machinery, same token streams.
+    # Passing the dead flag now errors at parse, which is the
+    # scheduled removal behaving exactly like the r21 retirements.
     parser.add_argument(
         "--sched-max-batches", type=int, default=2,
-        help="how many batches may be live at once (lanes). Paged "
+        help="how many batches may be live at once (lanes); 1 pins "
+             "the legacy serial semantics on the same machinery "
+             "(what --no-scheduler, retired r22, used to do). Paged "
              "engines additionally gate new lanes on the pool's "
              "free-page budget (generate.sched_pages_deferred counts "
              "waits)",
@@ -983,7 +1028,6 @@ def main(argv=None) -> None:
         replica_role=args.replica_role,
         draft_checkpoint=args.draft_checkpoint,
         spec_sample=args.spec_sample,
-        scheduler=args.scheduler,
         sched_max_batches=args.sched_max_batches,
         adapter_slots=args.adapter_slots,
         adapter_store_bytes=args.adapter_store_bytes,
@@ -1012,11 +1056,59 @@ def main(argv=None) -> None:
         _log.info(
             "preloaded adapter %r (rank %d, %d bytes)", aid, rank, nbytes
         )
+    models = None
+    if args.model:
+        # Multi-model registry: --checkpoint is the default entry;
+        # each --model ID=CHECKPOINT adds one. Extra entries load
+        # with stock engine knobs — the tuned flags (--kv-page-size,
+        # --quantize, ...) configure the DEFAULT model; per-entry
+        # tuning is a config file's job, not a flag matrix's.
+        import re as _re
+
+        from mlapi_tpu.serving.registry import ModelRegistry
+
+        engines = {"default": engine}
+        for spec in args.model:
+            mid, _, mpath = spec.partition("=")
+            mid = mid.strip()
+            if not mid or not mpath:
+                parser.error(f"--model {spec!r}: expected ID=CHECKPOINT")
+            if not _re.fullmatch(r"[A-Za-z0-9._-]+", mid):
+                parser.error(
+                    f"--model {spec!r}: id must be URL-path-safe "
+                    "([A-Za-z0-9._-]+)"
+                )
+            if mid in engines:
+                parser.error(f"--model {spec!r}: duplicate model id")
+            try:
+                engines[mid] = InferenceEngine.from_checkpoint(mpath)
+            except (OSError, ValueError) as e:
+                parser.error(f"--model {spec!r}: {e}")
+        models = ModelRegistry(engines)
+    tenants = None
+    if args.tenant_pages or args.tenant_slots or args.tenant_weight:
+        from mlapi_tpu.serving.registry import TenantLedger, parse_tenant_kv
+
+        try:
+            tenants = TenantLedger(
+                quota_pages=parse_tenant_kv(
+                    args.tenant_pages, "--tenant-pages"
+                ),
+                quota_slots=parse_tenant_kv(
+                    args.tenant_slots, "--tenant-slots"
+                ),
+                weights=parse_tenant_kv(
+                    args.tenant_weight, "--tenant-weight", cast=float
+                ),
+            )
+        except ValueError as e:
+            parser.error(str(e))
     app = build_app(
         engine, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         default_deadline_ms=args.default_deadline_ms,
         drain_timeout_s=args.drain_timeout_s,
         admission_control=args.admission_control,
+        models=models, tenants=tenants,
     )
     server = Server(app, host=args.host, port=args.port,
                     reuse_port=is_worker)

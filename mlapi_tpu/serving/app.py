@@ -37,7 +37,7 @@ from mlapi_tpu.serving.asgi import (
     json_response,
 )
 from mlapi_tpu.serving import faults
-from mlapi_tpu.serving.batcher import MicroBatcher, OverloadedError
+from mlapi_tpu.serving.scoring import OverloadedError, ScorePath
 from mlapi_tpu.serving.engine import InferenceEngine
 from mlapi_tpu.serving.requests import DeadlineExceeded, DrainCancelled
 from mlapi_tpu.utils.logging import get_logger
@@ -201,7 +201,7 @@ def feature_schema(feature_names) -> type[pydantic.BaseModel]:
 
 
 def build_app(
-    engine: InferenceEngine,
+    engine: InferenceEngine | None = None,
     *,
     max_batch: int | None = None,
     max_wait_ms: float = 0.2,
@@ -210,57 +210,113 @@ def build_app(
     default_deadline_ms: float | None = None,
     drain_timeout_s: float = 10.0,
     admission_control: bool = True,
+    models=None,
+    tenants=None,
 ) -> App:
+    """One app over one model or a whole registry.
+
+    ``models`` (a :class:`~mlapi_tpu.serving.registry.ModelRegistry`)
+    is the r22 multi-model surface: every entry serves at
+    ``/models/<id>/{predict|generate}`` and the DEFAULT entry also
+    owns the legacy ``/predict`` / ``/generate`` routes — a
+    single-model process is just a one-entry registry, bit for bit.
+    ``tenants`` (a :class:`~mlapi_tpu.serving.registry.TenantLedger`)
+    attaches per-tenant quotas/weights/brownout to every generative
+    entry."""
+    from mlapi_tpu.serving.registry import ModelRegistry
+
+    if models is None:
+        if engine is None:
+            raise ValueError("build_app needs an engine or a registry")
+        models = ModelRegistry({"default": engine})
+    engine = models.default
     app = App(title="mlapi-tpu")
     registry = registry or MetricsRegistry()
     app.state["engine"] = engine
+    app.state["models"] = models
+    app.state["tenants"] = tenants
     app.state["metrics"] = registry
     app.state["drain_timeout_s"] = float(drain_timeout_s)
 
-    if engine.kind == "generative":
-        batcher = None
-        # The generative engine owns its queue/batch limits; the
-        # app-level knobs apply to it too (engine defaults when None).
-        if max_queue is not None:
-            engine.max_queue = max_queue
-        if max_batch is not None:
-            engine.max_batch = min(max_batch, engine.max_batch)
-        engine.default_deadline_ms = default_deadline_ms
-        engine.admission_control = bool(admission_control)
-        engine.drain_timeout_s = float(drain_timeout_s)
-        _install_generate(app, engine)
-        if getattr(engine, "kv_peer", None) is not None and (
-            _is_router_replica()
-        ):
-            # Replica-gated like the hint header itself: outside a
-            # router fleet there is no trusted hinter, and the
-            # endpoint would only be a cache-presence oracle handing
-            # raw KV bytes to arbitrary direct callers.
-            _install_kv_peer(app, engine)
-        if getattr(engine, "adapter_peer", None) is not None and (
-            _is_router_replica()
-        ):
-            # Same trust model as /kv/prefix: adapter weight blobs
-            # serve replica↔replica only, inside a router fleet.
-            _install_adapter_peer(app, engine)
-        if (
-            getattr(engine, "kv_push", None) is not None
-            and getattr(engine, "replica_role", "mixed") == "decode"
-            and _is_router_replica()
-        ):
-            # The push intake exists ONLY on decode-role replicas
-            # inside a fleet (r18): a mixed topology exposes no push
-            # endpoint at all — bit-identical to r17 — and outside a
-            # fleet there is no trusted pusher.
-            _install_kv_push(app, engine)
-    else:
-        batcher = MicroBatcher(
-            engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
-            default_deadline_ms=default_deadline_ms,
-            **({"max_queue": max_queue} if max_queue is not None else {}),
-        )
-        app.state["batcher"] = batcher
-        _install_predict(app, engine, batcher)
+    multi = len(models.ids()) > 1
+    primary_gen = models.primary_generative()
+    score_paths: dict[str, ScorePath] = {}
+    batcher = None
+    for mid, eng in models.items():
+        is_default = mid == models.default_id
+        if eng.kind == "generative":
+            if tenants is not None:
+                eng.tenants = tenants
+            if is_default:
+                # The generative engine owns its queue/batch limits;
+                # the app-level knobs apply to it too (engine
+                # defaults when None). Non-default entries keep their
+                # construction-time limits.
+                if max_queue is not None:
+                    eng.max_queue = max_queue
+                if max_batch is not None:
+                    eng.max_batch = min(max_batch, eng.max_batch)
+                eng.default_deadline_ms = default_deadline_ms
+                eng.admission_control = bool(admission_control)
+                eng.drain_timeout_s = float(drain_timeout_s)
+                _install_generate(app, eng)
+                if getattr(eng, "kv_peer", None) is not None and (
+                    _is_router_replica()
+                ):
+                    # Replica-gated like the hint header itself:
+                    # outside a router fleet there is no trusted
+                    # hinter, and the endpoint would only be a
+                    # cache-presence oracle handing raw KV bytes to
+                    # arbitrary direct callers.
+                    _install_kv_peer(app, eng)
+                if getattr(eng, "adapter_peer", None) is not None and (
+                    _is_router_replica()
+                ):
+                    # Same trust model as /kv/prefix: adapter weight
+                    # blobs serve replica↔replica only, inside a
+                    # router fleet.
+                    _install_adapter_peer(app, eng)
+                if (
+                    getattr(eng, "kv_push", None) is not None
+                    and getattr(eng, "replica_role", "mixed") == "decode"
+                    and _is_router_replica()
+                ):
+                    # The push intake exists ONLY on decode-role
+                    # replicas inside a fleet (r18): a mixed topology
+                    # exposes no push endpoint at all — bit-identical
+                    # to r17 — and outside a fleet there is no
+                    # trusted pusher.
+                    _install_kv_push(app, eng)
+            if multi:
+                _install_generate(
+                    app, eng, path=f"/models/{mid}/generate"
+                )
+        else:
+            # The scoring fast path: formed batches ride the primary
+            # generative engine's unit queue when one is co-resident
+            # (typed score units between decode chunks), the folded
+            # worker-pool backend otherwise.
+            sp = ScorePath(
+                eng, model_id=mid, max_batch=max_batch,
+                max_wait_ms=max_wait_ms,
+                default_deadline_ms=default_deadline_ms,
+                sched_source=(
+                    (lambda g=primary_gen: g.sched)
+                    if primary_gen is not None else None
+                ),
+                **({"max_queue": max_queue}
+                   if max_queue is not None else {}),
+            )
+            score_paths[mid] = sp
+            if is_default:
+                batcher = sp
+                app.state["batcher"] = sp
+                _install_predict(app, eng, sp)
+            if multi:
+                _install_predict(
+                    app, eng, sp, path=f"/models/{mid}/predict"
+                )
+    app.state["score_paths"] = score_paths
 
     @app.on_startup
     async def _start():
@@ -268,37 +324,57 @@ def build_app(
         # against a real server); a no-op — zero per-seam overhead —
         # when unset.
         faults.arm_from_env()
+        loop = asyncio.get_running_loop()
         # Warm the compiled shapes off the request path, then start
-        # the collector. No request ever sees an XLA compile.
-        await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
-        if batcher is not None:
-            await batcher.start()
-        elif hasattr(engine, "start"):
-            await engine.start()  # generative: its own decode batcher
-        _log.info("serving %s (%s)", type(engine.model).__name__, engine.kind)
+        # the collectors. No request ever sees an XLA compile.
+        # Generative engines start BEFORE the scoring paths so a
+        # scoring batch formed at t=0 already finds the unit queue.
+        for mid, eng in models.items():
+            await loop.run_in_executor(None, eng.warmup)
+            if eng.kind == "generative":
+                await eng.start()
+            models.note_started(mid)
+        for sp in score_paths.values():
+            await sp.start()
+        _log.info(
+            "serving %s (%s)",
+            ", ".join(
+                f"{mid}:{type(e.model).__name__}"
+                for mid, e in models.items()
+            ),
+            "+".join(sorted({e.kind for _, e in models.items()})),
+        )
 
     @app.on_shutdown
     async def _stop():
         # Graceful drain first (new admissions shed 503 + retry-after
         # and /healthz flips to "draining" the moment this hook runs;
         # in-flight streams get the budget to finish, then proper
-        # terminal frames), THEN the hard stop.
+        # terminal frames), THEN the hard stop. Scoring paths drain
+        # and stop BEFORE the generative engines whose unit queue
+        # their in-flight batches may ride.
         budget = app.state["drain_timeout_s"]
-        if batcher is not None:
-            await batcher.drain(budget)
-            await batcher.stop()
-        elif hasattr(engine, "stop"):
-            if hasattr(engine, "drain"):
-                await engine.drain(budget)
-            await engine.stop()
+        for sp in score_paths.values():
+            await sp.drain(budget)
+            await sp.stop()
+        for mid, eng in models.items():
+            if eng.kind == "generative" and hasattr(eng, "stop"):
+                if hasattr(eng, "drain"):
+                    await eng.drain(budget)
+                await eng.stop()
+            models.note_stopped(mid)
 
     _install_common(app, engine, registry, batcher)
     app.install_docs()  # /openapi.json + /docs, like FastAPI gave free
     return app
 
 
-def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
-    """The classification surface: ``POST /predict``."""
+def _install_predict(app: App, engine: InferenceEngine, batcher,
+                     path: str = "/predict") -> None:
+    """The classification surface: ``POST /predict`` — and, in a
+    multi-model process, the same handler at
+    ``POST /models/<id>/predict`` (the registry's ids are static at
+    build time, so per-model routes register as exact paths)."""
     if engine.kind == "text":
         schema = pydantic.create_model(
             "TextRequest", text=(str, ...),
@@ -316,7 +392,7 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
 
     is_replica = _is_router_replica()
 
-    @app.post("/predict")
+    @app.post(path)
     async def predict(features: schema, request):  # type: ignore[valid-type]
         if is_replica:
             batcher.router_queue_depth = _router_depth(request)
@@ -360,8 +436,10 @@ def _install_predict(app: App, engine: InferenceEngine, batcher) -> None:
         return Response(body, content_type="application/json")
 
 
-def _install_generate(app: App, engine) -> None:
-    """The generative surface: ``POST /generate``.
+def _install_generate(app: App, engine, path: str = "/generate") -> None:
+    """The generative surface: ``POST /generate`` — and, in a
+    multi-model process, the same handler at
+    ``POST /models/<id>/generate``.
 
     Concurrent requests coalesce into one batched decode stream
     (``TextGenerationEngine``); ``"stream": true`` returns NDJSON —
@@ -392,6 +470,11 @@ def _install_generate(app: App, engine) -> None:
         # request decodes under base + this adapter's delta, batched
         # with other tenants over the one HBM-resident base.
         adapter=(str | None, None),
+        # Quota/fairness identity (serving/registry.py, r22): the
+        # tenant whose page/slot quota the request reserves against
+        # and whose weight scales its deadline slack. Defaults to the
+        # adapter id, then the anonymous tenant.
+        tenant=(str | None, None),
     )
     hard_cap = engine.model.max_positions - 1
 
@@ -420,7 +503,7 @@ def _install_generate(app: App, engine) -> None:
 
     is_replica = _is_router_replica()
 
-    @app.post("/generate")
+    @app.post(path)
     async def generate(req: schema, request):  # type: ignore[valid-type]
         # Router backpressure (r15): the gauge feeds the admission
         # estimate and brownout ladder — replica deployments only
@@ -583,6 +666,7 @@ def _install_generate(app: App, engine) -> None:
                 deadline_ms=req.deadline_ms,
                 kv_xfer=kv_xfer,
                 adapter=req.adapter,
+                tenant=req.tenant,
             )
         except OverloadedError as e:
             raise _overloaded_http(e) from None
@@ -947,6 +1031,8 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             else getattr(engine, "queue_depth", 0)
         )
         role = getattr(engine, "replica_role", "mixed")
+        models = app.state.get("models")
+        multi = models is not None and len(models.ids()) > 1
         return {
             # "draining" the moment shutdown begins: the load balancer
             # stops routing here while in-flight streams finish.
@@ -955,6 +1041,11 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             # replica plays. Absent on mixed replicas — the default
             # topology's healthz is bit-identical to r17.
             **({"role": role} if role != "mixed" else {}),
+            # Multi-model registry (r22): which model ids this process
+            # serves (the router's per-model candidate filter reads
+            # this). Absent in single-model mode — bit-identical to
+            # r21.
+            **({"models": models.describe()} if multi else {}),
             # Backpressure in the SAME poll the router/balancer already
             # makes for liveness (its threshold check still scrapes the
             # authoritative /metrics gauges on the poll cadence; this
@@ -1117,6 +1208,24 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
             )
             snap["counters"]["generate.sched_pages_deferred"] = (
                 engine.sched_pages_deferred
+            )
+            # Multi-model + multi-tenant (r22): scoring dispatches
+            # that rode this engine's unit queue, group starts
+            # deferred on a TENANT quota (pages / adapter slots —
+            # distinct from the pool-wide deferral above), and
+            # tenant-scoped brownout clamps (engages before the
+            # fleet-wide rung 1).
+            snap["counters"]["generate.sched_units_score"] = (
+                engine.sched_units_score
+            )
+            snap["counters"]["generate.sched_tenant_pages_deferred"] = (
+                engine.sched_tenant_pages_deferred
+            )
+            snap["counters"][
+                "generate.sched_tenant_adapters_deferred"
+            ] = engine.sched_tenant_adapters_deferred
+            snap["counters"]["generate.brownout_tenant_clamped"] = (
+                engine.brownout_tenant_clamped
             )
             snap.setdefault("gauges", {})
             snap["gauges"]["generate.sched_queue_depth"] = (
@@ -1376,6 +1485,65 @@ def _install_common(app: App, engine, registry: MetricsRegistry, batcher) -> Non
                 snap["gauges"]["generate.adapter_store_entries"] = (
                     engine.adapter_store_entries
                 )
+        # Per-model counter family (r22): ONLY in multi-model mode —
+        # a one-entry registry's /metrics stays bit-identical to r21.
+        # Each entry exports the small per-model dashboard row; the
+        # default model's full counter block above is unchanged.
+        models = app.state.get("models")
+        if models is not None and len(models.ids()) > 1:
+            snap.setdefault("gauges", {})
+            score_paths = app.state.get("score_paths") or {}
+            for mid, eng in models.items():
+                pfx = f"model.{mid}"
+                if eng.kind == "generative":
+                    snap["counters"][f"{pfx}.requests"] = eng.requests
+                    snap["counters"][f"{pfx}.rejected"] = eng.rejected
+                    snap["counters"][f"{pfx}.sched_units_decode"] = (
+                        eng.sched_units_decode
+                    )
+                    snap["counters"][f"{pfx}.sched_units_score"] = (
+                        eng.sched_units_score
+                    )
+                    snap["gauges"][f"{pfx}.queue_depth"] = (
+                        eng.queue_depth
+                    )
+                    for k, v in eng.latency.summary().items():
+                        snap["gauges"][f"{pfx}.{k}"] = v
+                else:
+                    sp = score_paths.get(mid)
+                    if sp is None:
+                        continue
+                    snap["counters"][f"{pfx}.requests"] = sp.requests
+                    snap["counters"][f"{pfx}.device_calls"] = (
+                        sp.device_calls
+                    )
+                    # Dispatches that rode a co-resident generative
+                    # engine's unit queue as score units (vs the pool
+                    # backend): sched_dispatches ≈ device_calls IS
+                    # the one-scheduler claim.
+                    snap["counters"][f"{pfx}.sched_dispatches"] = (
+                        sp.sched_dispatches
+                    )
+                    snap["counters"][f"{pfx}.rejected"] = sp.rejected
+                    snap["counters"][f"{pfx}.deadline_expired"] = (
+                        sp.deadline_expired
+                    )
+                    snap["gauges"][f"{pfx}.queue_depth"] = (
+                        sp.queue_depth
+                    )
+                    for k, v in sp.latency.summary().items():
+                        snap["gauges"][f"{pfx}.{k}"] = v
+        # Per-tenant pressure block (r22): live depth plus the quota
+        # deferral / brownout history — only tenants with any history
+        # appear, so an untenanted deployment's scrape is unchanged.
+        tenants = app.state.get("tenants")
+        if tenants is not None:
+            snap.setdefault("gauges", {})
+            for t, row in sorted(tenants.snapshot().items()):
+                pfx = f"tenant.{t or 'anonymous'}"
+                snap["gauges"][f"{pfx}.depth"] = row["depth"]
+                snap["counters"][f"{pfx}.deferrals"] = row["deferrals"]
+                snap["counters"][f"{pfx}.brownouts"] = row["brownouts"]
         return snap
 
     return app

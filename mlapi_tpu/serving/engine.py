@@ -152,7 +152,6 @@ class InferenceEngine:
         replica_role: str = "mixed",
         draft_checkpoint=None,
         spec_sample: bool = False,
-        scheduler: bool = True,
         sched_max_batches: int = 2,
         adapter_slots: int = 0,
         adapter_store_bytes: int = 0,
@@ -363,7 +362,6 @@ class InferenceEngine:
                 kv_tier_disk_dir=kv_tier_disk_dir,
                 kv_peer_fetch=kv_peer_fetch,
                 replica_role=replica_role,
-                scheduler=scheduler,
                 sched_max_batches=sched_max_batches,
                 adapter_slots=adapter_slots,
                 adapter_store_bytes=adapter_store_bytes,
@@ -383,7 +381,8 @@ class InferenceEngine:
                          if replica_role != "mixed" else {}),
                       **({"adapter_slots": adapter_slots}
                          if adapter_slots else {}),
-                      **({} if scheduler else {"scheduler": False}),
+                      **({"sched_max_batches": sched_max_batches}
+                         if sched_max_batches == 1 else {}),
                       **({"draft": str(draft_checkpoint)}
                          if draft_checkpoint else {})},
             )
@@ -418,9 +417,10 @@ class InferenceEngine:
                 f"tenant LoRA adapters); {type(inner).__name__} does "
                 f"not"
             )
-        # ``scheduler``/``sched_max_batches`` are generative-only
-        # knobs (they shape the decode unit queue) and default ON —
-        # classification checkpoints simply ignore them rather than
+        # ``sched_max_batches`` is a generative-only knob (it shapes
+        # the decode unit queue; ``--no-scheduler`` was retired in
+        # r22 — ``sched_max_batches=1`` IS serial mode) —
+        # classification checkpoints simply ignore it rather than
         # forcing every caller to special-case the default.
         if meta.vocab is None:
             raise ValueError(f"checkpoint {path} has no label vocab; cannot serve")
@@ -652,7 +652,6 @@ class TextGenerationEngine:
         kv_peer_fetch: bool = False,
         kv_peer_timeout_s: float = 5.0,
         replica_role: str = "mixed",
-        scheduler: bool = True,
         sched_max_batches: int = 2,
         adapter_slots: int = 0,
         adapter_store_bytes: int = 0,
@@ -1062,19 +1061,22 @@ class TextGenerationEngine:
         # Continuous-batching scheduler v2 (r15, serving/scheduler.py;
         # DEFAULT-ON since r20 — the one execution model): one
         # typed-unit queue (prefill chunk / decode chunk / spec round
-        # / admission / compaction) across up to ``sched_max_batches``
-        # CONCURRENT BatchRuns, SLO-prioritized by deadline slack
-        # with TTFT/ITL targets fed from the LatencyStats reservoirs.
-        # ``scheduler=False`` (--no-scheduler, one release's escape
-        # hatch) pins ONE lane — the legacy serial semantics (one
-        # live batch + in-lane admission) on the same machinery. The
-        # scheduler object itself is created by start() and torn down
-        # by stop().
-        self.scheduler_enabled = bool(scheduler)
-        self.sched_max_batches = (
-            max(1, int(sched_max_batches)) if scheduler else 1
-        )
+        # / admission / compaction / score) across up to
+        # ``sched_max_batches`` CONCURRENT BatchRuns, SLO-prioritized
+        # by WEIGHTED deadline slack (per-tenant weights from the
+        # ledger below) with TTFT/ITL targets fed from the
+        # LatencyStats reservoirs. ``sched_max_batches=1`` pins ONE
+        # lane — the legacy serial semantics (one live batch +
+        # in-lane admission) on the same machinery (the
+        # ``--no-scheduler`` flag was retired in r22). The scheduler
+        # object itself is created by start() and torn down by stop().
+        self.sched_max_batches = max(1, int(sched_max_batches))
         self.sched = None
+        # Per-tenant quotas/weights/pressure (serving/registry.py
+        # TenantLedger, r22), attached by the app/__main__ when any
+        # tenant flag is configured. None = single-tenant semantics,
+        # bit for bit.
+        self.tenants = None
         # Per-unit-type dispatch counters + queue observability
         # (exported on /metrics as sched_*).
         self.sched_units_prefill = 0
@@ -1082,12 +1084,24 @@ class TextGenerationEngine:
         self.sched_units_spec = 0
         self.sched_units_admit = 0
         self.sched_units_compact = 0
+        # Scoring batches from co-resident ScorePaths dispatched as
+        # typed units between this engine's decode chunks (r22).
+        self.sched_units_score = 0
         self.sched_deadline_preempts = 0
         self.sched_pages_deferred = 0
         # Group held back because its adapters could not all claim a
         # device slot right now (free + hold-free-evictable < needed)
         # — the adapter-slot term of the same reservation gate.
         self.sched_adapters_deferred = 0
+        # Per-tenant terms of the same gate (r22): the POOL had room
+        # but the group's TENANT was at its page/slot quota. The
+        # ledger counts the same deferral per tenant.
+        self.sched_tenant_pages_deferred = 0
+        self.sched_tenant_adapters_deferred = 0
+        # Tenant-scoped brownout rung (engages before the fleet-wide
+        # ladder): submits clamped because ONE tenant's live depth
+        # crossed its share of the queue.
+        self.brownout_tenant_clamped = 0
         self.sched_batches_live_max = 0
         # Largest run of consecutive units ONE lane dispatched while
         # another lane was live — the cross-lane head-of-line bound
@@ -2081,8 +2095,8 @@ class TextGenerationEngine:
         group through ``_dispatch_group`` — in-lane admission when a
         live lane can take it at a unit boundary (continuous
         batching), a new scheduler lane otherwise, a bounded wait
-        when neither has room. Serial mode (``--no-scheduler``) is
-        the SAME loop with ``sched_max_batches`` pinned to 1: one
+        when neither has room. Serial mode (``sched_max_batches=1``;
+        the ``--no-scheduler`` flag is retired) is the SAME loop: one
         live batch plus in-lane admission — the legacy collector's
         semantics on the scheduler's machinery, which is why the
         legacy scheduler-off loop could be deleted.
@@ -2343,6 +2357,7 @@ class TextGenerationEngine:
         push_to=None,
         kv_xfer: str | None = None,
         adapter: str | None = None,
+        tenant: str | None = None,
     ) -> GenRequest:
         """Queue one prompt for batched decode; consume ``req.queue``
         for ``{"token_ids": [...]}`` chunks until the ``None``
@@ -2360,8 +2375,12 @@ class TextGenerationEngine:
         (engine default when ``None``; see ``default_deadline_ms``).
         A deadlined request the admission estimate says cannot finish
         in time sheds HERE — 503 + computed retry-after — instead of
-        occupying a queue slot and timing out mid-decode."""
-        from mlapi_tpu.serving.batcher import OverloadedError
+        occupying a queue slot and timing out mid-decode.
+
+        ``tenant`` names the quota/fairness identity (r22, see
+        ``serving/registry.py``); it defaults to the adapter id, then
+        to the anonymous tenant."""
+        from mlapi_tpu.serving.scoring import OverloadedError
 
         if self._task is None:
             raise RuntimeError("generation engine not started")
@@ -2387,6 +2406,22 @@ class TextGenerationEngine:
         n_new = int(max_new_tokens or self.default_max_new_tokens)
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        tenant = tenant or adapter or ""
+        led = self.tenants
+        if led is not None and tenant:
+            # Tenant-scoped brownout rung (r22): engages BEFORE the
+            # fleet-wide ladder — one tenant's live depth crossing a
+            # QUARTER of the queue clamps that tenant's token budget
+            # at half the pressure the fleet's rung 1 needs (50%), so
+            # the hot tenant degrades itself before it degrades
+            # everyone. Same lever, same counter discipline.
+            if (
+                led.depth(tenant) * 4 >= self.max_queue
+                and n_new > self.default_max_new_tokens
+            ):
+                n_new = self.default_max_new_tokens
+                self.brownout_tenant_clamped += 1
+                led.note_brownout(tenant)
         level = self._brownout_level()
         if level >= 1 and n_new > self.default_max_new_tokens:
             # Brownout lever 1: clamp oversized budgets to the default
@@ -2465,12 +2500,21 @@ class TextGenerationEngine:
                 retry_after_s=getattr(self, "drain_timeout_s", 10.0),
                 detail="server draining: retry against another replica",
             )
+        req.tenant = tenant
         try:
             self._queue.put_nowait(req)
         except asyncio.QueueFull:
             self.rejected += 1
             self.shed_queue_full += 1
             raise OverloadedError("generate", retry_after_s=2.0) from None
+        if led is not None and tenant:
+            # Live-depth accounting: entered once here, exited once
+            # at the terminal frame (GenRequest.finish — fires on
+            # every delivery path, including cancels). No await
+            # between the put and this, so the collector cannot
+            # retire the request before its exit hook exists.
+            led.enter(tenant)
+            req.on_done = lambda t=tenant: led.exit(t)
         self.requests += 1
         return req
 

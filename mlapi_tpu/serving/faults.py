@@ -112,6 +112,16 @@ POINTS = (
     # formation, never breaks it.
     "adapter_fetch",    # before the GET /adapter/<id> wire request
     "adapter_install",  # before an adapter's slot alloc + scatter
+    # The scoring fast path (serving/scoring.py, r22): fires once
+    # BEFORE each scoring device dispatch — on the unit-scheduler
+    # dispatch thread when a generative engine is co-resident, on a
+    # pool worker otherwise, so the same spec drills both backends. A
+    # raise fails that ONE formed batch (its futures get the error as
+    # their result; queue, counters and the in-flight slot are
+    # conserved) while the next batch dispatches clean; a delay slows
+    # one scoring unit, bounding how long microsecond-scale scoring
+    # can stall an interleaved decode chunk in a drill.
+    "score_dispatch",   # before a scoring batch's device call
 )
 
 ENV_VAR = "MLAPI_FAULTS"

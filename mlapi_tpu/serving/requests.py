@@ -123,14 +123,16 @@ class GenRequest:
         "cancelled", "top_k", "top_p", "stream",
         "prefix_fp", "prefix_kv", "prefix_len", "prefix_lo",
         "prompt_tokens", "stats", "t0", "t_last", "deadline",
-        "push_to", "pushed", "staged", "adapter",
+        "push_to", "pushed", "staged", "adapter", "tenant",
+        "on_done", "_done_fired",
     )
 
     def __init__(self, row, used, n_new, temperature, seed, loop,
                  top_k=0, top_p=1.0, prefix=None, stream=False,
                  stats: LatencyStats | None = None,
                  deadline_ms: float | None = None,
-                 push_to=None, pushed=None, adapter=None):
+                 push_to=None, pushed=None, adapter=None,
+                 tenant: str = ""):
         self.row = row            # [bucketed] int32 ids, left-padded
         self.used = used          # real prompt tokens in the row
         self.n_new = n_new
@@ -179,6 +181,17 @@ class GenRequest:
         # it into a resident device slot. Requests with different
         # adapters still co-batch (the gathered BGMV path).
         self.adapter = adapter
+        # Quota/fairness identity (serving/registry.py TenantLedger,
+        # r22): the tenant whose page/slot quota this request reserves
+        # against and whose weight scales its deadline slack. Empty =
+        # the anonymous tenant (unquotaed, weight 1.0).
+        self.tenant = tenant
+        # Fired EXACTLY ONCE at this request's terminal frame — normal
+        # end, error, deadline, drain, or scheduler stop — so the
+        # tenant ledger's live-depth accounting balances on every
+        # delivery path. Set by engine.submit; None elsewhere.
+        self.on_done = None
+        self._done_fired = False
         self.queue: asyncio.Queue = asyncio.Queue()
         self.cancelled = False    # set when the consumer disconnects
         # Staged-for-admission ONCE marker (collector dispatch): a
@@ -204,13 +217,36 @@ class GenRequest:
         """Thread-safe enqueue from the decode thread."""
         faults.fire("stream_push")
         _record_push(self, item)
+        if item is None or isinstance(item, BaseException):
+            self.finish()  # terminal frame: balance the ledger
         self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
+
+    def finish(self) -> None:
+        """Terminal-frame hook, idempotent: fires ``on_done`` exactly
+        once no matter which delivery path ends the request (normal
+        sentinel, error frame, deadline, drain sweep, scheduler stop,
+        or a disconnect's :meth:`cancel`). Mutated from the decode
+        thread and the event loop, but only ever False→True — a rare
+        double-fire race would double-exit the ledger, which ``exit``
+        clamps at zero."""
+        if self._done_fired:
+            return
+        self._done_fired = True
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — bookkeeping must not kill delivery
+                pass
 
     def cancel(self) -> None:
         """Consumer is gone: tell the decode loop to stop spending
         device time on this row (a plain bool — read cross-thread,
-        worst case one extra chunk decodes)."""
+        worst case one extra chunk decodes). The tenant ledger exits
+        here too — a disconnected row may retire without a terminal
+        push."""
         self.cancelled = True
+        self.finish()
 
 
 class _PrefixEntry:
@@ -244,10 +280,16 @@ class _SyncSink:
         self.deadline = req.deadline
         self.push_to, self.pushed = req.push_to, req.pushed
         self.adapter = req.adapter
+        self.tenant = req.tenant
         self._out = out_ids
         self.error: Exception | None = None
         self.cancelled = False
         self.staged = False
+
+    def finish(self) -> None:
+        """Parity no-op: the sync path never enters the tenant
+        ledger (``engine.submit`` owns enter/exit), but shared
+        terminal seams call ``finish`` on every sink type."""
 
     def push(self, item) -> None:
         faults.fire("stream_push")

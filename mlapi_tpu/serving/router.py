@@ -177,7 +177,7 @@ class ReplicaState:
     __slots__ = (
         "host", "port", "name", "state", "queue_depth", "inflight",
         "shed_until", "poll_failures", "last_poll", "healthz",
-        "metrics", "role",
+        "metrics", "role", "models",
     )
 
     def __init__(self, host: str, port: int, *, assume_live: bool = True,
@@ -195,6 +195,14 @@ class ReplicaState:
         # engine never sees traffic; True is the embedded/unit default
         # where the caller controls replica lifetime itself.
         self.state = LIVE if assume_live else DOWN
+        # Model ids this replica advertises on /healthz (r22 multi-
+        # model fleets): None until a poll says otherwise — an
+        # unpolled or single-model replica serves the default model
+        # only, and the model filter treats it that way. The r18
+        # role generalized: a fleet whose replicas advertise
+        # different model sets IS the per-model replica-group
+        # topology, discovered, not configured.
+        self.models: frozenset | None = None
         self.queue_depth = 0
         self.inflight = 0        # router-side in-flight forwards
         self.shed_until = 0.0    # monotonic: shedding until then
@@ -378,6 +386,12 @@ class Router:
         self.role_disagg_forwards = 0
         self.role_fallback_mixed = 0
         self.role_push_incomplete = 0
+        # Multi-model fleets (r22): forwards that found NO replica
+        # advertising the requested model and degraded to the whole
+        # routable set (the replica then 404s an id it truly lacks —
+        # an honest error beats a router-synthesized one during a
+        # rolling deploy where the next poll may find the model).
+        self.model_fallbacks = 0
 
     # -- discovery/keys ---------------------------------------------------
     @staticmethod
@@ -487,21 +501,39 @@ class Router:
         order = hrw_order(key, [r.name for r in self.replicas])
         return next(r for r in self.replicas if r.name == order[0])
 
+    def _serves(self, r: ReplicaState, model: str | None) -> bool:
+        """Does this replica serve ``model``? The default model is
+        everywhere (every process has one); a named model needs the
+        replica's advertised set — a replica that never advertised
+        one (single-model build, unpolled) serves the default only."""
+        if model is None or model == "default":
+            return True
+        return r.models is not None and model in r.models
+
     def choose(
         self,
         key: bytes | None,
         exclude: ReplicaState | None = None,
         count: bool = True,
+        model: str | None = None,
     ) -> ReplicaState:
         """Pick the replica for one request. Affinity first: the HRW
         top choice over ALL configured replicas (states excluded — the
         preference map must stay stable while a replica drains and
         comes back, or its cache investment is lost on every blip);
         the fallback ladder below it is power-of-two-choices over the
-        routable set. Raises :class:`NoReplicaAvailable` when that set
-        is empty."""
+        routable set. ``model`` narrows every rung to the replica
+        group advertising that id — an empty group degrades to the
+        whole fleet, counted (``router.model_fallbacks``). Raises
+        :class:`NoReplicaAvailable` when the routable set is empty."""
         now = time.monotonic()
         cands = [r for r in self.replicas if r is not exclude]
+        if model is not None:
+            group = [r for r in cands if self._serves(r, model)]
+            if group:
+                cands = group
+            elif count:
+                self.model_fallbacks += 1
         routable = [
             r for r in cands if r.routable(now, self.queue_depth_limit)
         ]
@@ -602,6 +634,8 @@ class Router:
             _log.info("replica %s: %s -> %s", r.name, prev, r.state)
         r.queue_depth = int(depth or 0)
         r.healthz = health
+        m = health.get("models")
+        r.models = frozenset(m) if isinstance(m, dict) else None
         r.last_poll = time.monotonic()
 
     def _note_conn_failure(self, r: ReplicaState) -> None:
@@ -665,6 +699,9 @@ class Router:
                 # body's validated adapter id — a client-sent copy is
                 # an impersonation/header-injection vector.
                 b"x-mlapi-adapter",
+                # The model marker is router-authored from the
+                # registered route (r22) — same rule.
+                b"x-mlapi-model",
             ):
                 head += k + b": " + v + b"\r\n"
         head += b"content-length: %d\r\n" % len(request.body)
@@ -868,14 +905,22 @@ class Router:
 
     async def forward(
         self, request: Request, key: bytes | None = None,
-        adapter: str | None = None,
+        adapter: str | None = None, model: str | None = None,
     ) -> Response:
         """Route + forward one request, with the failover-once rule:
         at most one extra hop, and only for submits that provably
         never started work (connect failure, pre-submit injected
-        fault, a whole-response 503)."""
+        fault, a whole-response 503). ``model`` routes within that
+        model's replica group (r22) and stamps the router-authored
+        ``x-mlapi-model`` marker on the hop."""
         self.forwarded += 1
         extra = None
+        if model is not None:
+            # Router-authored like x-mlapi-adapter below (client
+            # copies are stripped in _build_upstream); the id charset
+            # was validated at route-registration time, so no header
+            # injection is possible through it.
+            extra = {"x-mlapi-model": model}
         if adapter:
             from mlapi_tpu.serving.adapter_store import ADAPTER_ID_RE
 
@@ -885,7 +930,7 @@ class Router:
             # CR/LF or other junk would be header injection; such a
             # body forwards unmarked and the replica rejects it.
             if ADAPTER_ID_RE.match(adapter):
-                extra = {"x-mlapi-adapter": adapter}
+                extra = {**(extra or {}), "x-mlapi-adapter": adapter}
         # The key's HRW head, computed ONCE over all replicas and
         # threaded through BOTH attempts: the failover's second
         # choose() has no memory of the preferred replica (it
@@ -894,7 +939,7 @@ class Router:
         # retry hop — exactly the hop that needs it most.
         pref = self.preferred_for(key)
         try:
-            first = self.choose(key)
+            first = self.choose(key, model=model)
         except NoReplicaAvailable as e:
             self.shed_no_replica += 1
             return json_response(
@@ -914,7 +959,9 @@ class Router:
                     # failover hop landing on the HRW runner-up is
                     # not a second "hit" (it missed its real
                     # preferred replica; failovers counts it).
-                    second = self.choose(key, exclude=first, count=False)
+                    second = self.choose(
+                        key, exclude=first, count=False, model=model
+                    )
                 except NoReplicaAvailable:
                     second = None
                 if second is not None:
@@ -1057,8 +1104,21 @@ class Router:
         routable = sum(
             r.routable(now, self.queue_depth_limit) for r in self.replicas
         )
+        # Per-model replica groups (r22): the health rollup of each
+        # advertised model id — routable members vs total advertisers.
+        # Discovered from the polls, so an all-single-model fleet has
+        # no groups and the block is absent (bit-identical to r21).
+        groups: dict = {}
+        for r in self.replicas:
+            for mid in r.models or ():
+                g = groups.setdefault(mid, {"routable": 0, "total": 0})
+                g["total"] += 1
+                g["routable"] += int(
+                    r.routable(now, self.queue_depth_limit)
+                )
         return {
             "status": "ok" if routable else "degraded",
+            **({"model_groups": groups} if groups else {}),
             "router": True,
             "policy": self.policy,
             "affinity_prefix_bytes": self.affinity_prefix_bytes,
@@ -1144,6 +1204,12 @@ class Router:
             counters["router.role_push_incomplete"] = (
                 self.role_push_incomplete
             )
+        if any(r.models is not None for r in self.replicas) or (
+            self.model_fallbacks
+        ):
+            # Multi-model fleets only — same bit-identity rule as the
+            # role-split block above.
+            counters["router.model_fallbacks"] = self.model_fallbacks
         state_counts = self._state_counts()
         gauges["router.replicas_live"] = state_counts[LIVE]
         gauges["router.replicas_draining"] = state_counts[DRAINING]
@@ -1158,13 +1224,18 @@ class Router:
         }
 
 
-def build_router_app(router: Router) -> App:
+def build_router_app(router: Router, model_ids=None) -> App:
     """The router as an ASGI app on the framework's own server: the
     replica API surface forwarded (``/generate`` with affinity,
     ``/predict`` and ``/files/`` by load), plus the router-level
-    ``/healthz`` and aggregated ``/metrics``. Handlers take the raw
-    request — the REPLICA owns validation, so a 422 relays with the
-    exact byte shape a direct client would have seen."""
+    ``/healthz`` and aggregated ``/metrics``. ``model_ids`` (the
+    supervisor's ``--model`` ids, r22) additionally fronts
+    ``/models/<id>/{generate,predict}``, each routed within that
+    model's replica group. Handlers take the raw request — the
+    REPLICA owns validation, so a 422 relays with the exact byte
+    shape a direct client would have seen."""
+    import re as _re
+
     app = App(title="mlapi-tpu-router")
     app.state["router"] = router
 
@@ -1216,6 +1287,29 @@ def build_router_app(router: Router) -> App:
                 adapter=aid,
             )
         return await router.forward(request)
+
+    def _install_model_routes(mid: str) -> None:
+        # Closure-per-id, like app.py's per-model install loop: the
+        # route table is static (exact-path match, no params), built
+        # once from the same --model set the replicas serve.
+        @app.post(f"/models/{mid}/generate")
+        async def model_generate(request: Request, _mid=mid):
+            obj = router.parse_body(request.body)
+            aid = obj.get("adapter") if obj else None
+            return await router.forward(
+                request, key=router.routing_key_of(obj),
+                adapter=aid if isinstance(aid, str) else None,
+                model=_mid,
+            )
+
+        @app.post(f"/models/{mid}/predict")
+        async def model_predict(request: Request, _mid=mid):
+            return await router.forward(request, model=_mid)
+
+    for mid in model_ids or ():
+        if not _re.fullmatch(r"[A-Za-z0-9._-]+", mid):
+            raise ValueError(f"model id {mid!r} is not URL-path-safe")
+        _install_model_routes(mid)
 
     @app.post("/files/")
     async def files(request: Request):

@@ -17,11 +17,14 @@ Design:
   generator. The generator yields one of the five typed units —
   ``prefill`` chunk, ``decode`` chunk, ``spec`` round/phase, ``admit``
   (joiner install), ``compact`` (batch resize) — after each unit of
-  device work. Since r20 this is the ONE execution model (default-on):
-  serial mode (``--no-scheduler``) is the same machinery pinned to one
+  device work. Since r20 this is the ONE execution model (default-on;
+  the ``--no-scheduler`` escape hatch was retired in r22): serial
+  mode (``sched_max_batches=1``) is the same machinery pinned to one
   lane, so the two modes execute identical code and greedy streams are
   token-identical by construction (pinned across the config matrix
-  in ``tests/test_scheduler.py``). Fused-eligible batches dispatch
+  in ``tests/test_scheduler.py``). A sixth unit kind, ``score``
+  (r22), carries a co-resident scoring model's formed batch through
+  the same queue — see ``serving/scoring.py``. Fused-eligible batches dispatch
   tier-wide decode chunks through the same generator (one schedulable
   unit per fused chunk — ``serving/fused_single.py``), so a concurrent
   lane's head-of-line stall behind fused traffic is bounded at one
@@ -102,7 +105,7 @@ from mlapi_tpu.utils.logging import get_logger
 
 _log = get_logger("serving.scheduler")
 
-UNIT_KINDS = ("prefill", "decode", "spec", "admit", "compact")
+UNIT_KINDS = ("prefill", "decode", "spec", "admit", "compact", "score")
 
 # Urgency (seconds) of work nobody is waiting on with a deadline and
 # the reservoirs don't yet flag as SLO-risky: large enough that ANY
@@ -129,10 +132,12 @@ class _Lane:
 
     __slots__ = (
         "lane_id", "run", "gen", "last_pick", "pool_epoch", "reserved",
+        "tenant_pages", "tenant_adapters",
     )
 
     def __init__(self, lane_id: int, run, gen, pick_seq: int,
-                 reserved: int = 0):
+                 reserved: int = 0, tenant_pages: dict | None = None,
+                 tenant_adapters: dict | None = None):
         self.lane_id = lane_id
         self.run = run
         self.gen = gen
@@ -141,10 +146,50 @@ class _Lane:
         # Worst-case page footprint (ceil((bucket + n_new)/page) per
         # row), fixed at lane start — the arbitration unit.
         self.reserved = reserved
+        # The same reservation SPLIT BY TENANT (tenant → pages,
+        # tenant → adapter-id set), fixed at lane start: the per-
+        # tenant quota gate sums these instead of re-deriving from
+        # live rows, so a tenant's held footprint can only shrink
+        # (rows finish) — never grow past what the gate admitted.
+        self.tenant_pages = tenant_pages or {}
+        self.tenant_adapters = tenant_adapters or {}
 
     @property
     def reqs(self) -> list:
         return self.run.reqs
+
+
+class _ScoreUnit:
+    """One formed scoring batch (serving/scoring.py), queued as a
+    first-class typed unit: ``fn`` runs the device call on the
+    dispatch thread and resolves the batch's futures thread-safely;
+    ``fail`` delivers the stop-path error without running the call.
+    Microsecond-scale by construction — the padded-shape jit program
+    is cached — so interleaving one between decode chunks costs a
+    decode lane at most one unit of head-of-line wait (the same bound
+    fused chunks carry, pinned by ``sched_lane_stall_max``)."""
+
+    __slots__ = ("fn", "fail", "n_rows", "deadline", "stats", "weight",
+                 "t_submit", "target")
+
+    def __init__(self, fn, fail, n_rows: int, deadline: float | None,
+                 stats, weight: float):
+        self.fn = fn
+        self.fail = fail
+        self.n_rows = n_rows
+        self.deadline = deadline   # perf_counter domain, or None
+        self.stats = stats         # the SCORING model's LatencyStats
+        self.weight = max(float(weight), 1e-6)
+        self.t_submit = time.perf_counter()
+        # Deadline-less aging target: THIS model's observed first-
+        # result p95 (floor 5 ms cold) — computed once per unit (one
+        # bounded-reservoir sort, trivial next to the device call it
+        # schedules), frozen so the dispatch thread never sorts.
+        self.target = 0.005
+        if stats is not None:
+            t95 = stats.summary()["ttft_p95_ms"]
+            if t95:
+                self.target = max(t95 / 1e3, 0.005)
 
 
 def _min_slack(reqs, now: float) -> float | None:
@@ -166,8 +211,10 @@ class UnitScheduler:
 
     Owned by :class:`~mlapi_tpu.serving.engine.TextGenerationEngine`
     — ALWAYS (r20): ``engine.start()`` creates one unconditionally
-    (``--no-scheduler`` pins ``max_batches=1``), ``engine.stop()``
-    tears it down.
+    (``sched_max_batches=1`` pins the serial shape; the
+    ``--no-scheduler`` flag was retired in r22), ``engine.stop()``
+    tears it down. In a multi-model process the registry's scoring
+    paths feed this queue too (``submit_score``).
     """
 
     def __init__(self, eng, max_batches: int = 2):
@@ -183,6 +230,16 @@ class UnitScheduler:
         # queue_depth and drain's sweep must see it, or drain can
         # declare the engine idle with a batch mid-formation.
         self._forming_group: _Group | None = None
+        # Typed score units from co-resident ScorePaths (r22): FIFO —
+        # scoring batches are homogeneous microsecond work, so arrival
+        # order IS deadline order within the queue; the policy decides
+        # score-vs-lane, not score-vs-score.
+        self._score: collections.deque = collections.deque()
+        # Strict alternation state for the deadline-less case: when
+        # neither the score head nor any lane carries real slack, the
+        # dispatcher alternates score/lane so neither direction can
+        # starve the other by construction.
+        self._last_was_score = False
         self._stopped = False
         self._pick_seq = 0
         self._lane_seq = 0
@@ -215,6 +272,21 @@ class UnitScheduler:
             self._pending.append(_Group(reqs))
             self._work.notify_all()
 
+    def submit_score(self, fn, fail, *, n_rows: int = 0,
+                     deadline: float | None = None, stats=None,
+                     weight: float = 1.0) -> None:
+        """Hand one formed scoring batch to the unit queue (event-loop
+        side, via ScorePath). ``fn`` runs the device call on the
+        dispatch thread; ``fail`` is the stop-path terminal. Raises
+        once stopped so the caller falls back to its pool backend."""
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("scheduler stopped")
+            self._score.append(
+                _ScoreUnit(fn, fail, n_rows, deadline, stats, weight)
+            )
+            self._work.notify_all()
+
     def stop(self, timeout_s: float = 10.0) -> None:
         """Stop the dispatch thread; anything still pending or live
         gets the engine-stopped error as its terminal frame (parity
@@ -242,11 +314,13 @@ class UnitScheduler:
     @property
     def queue_depth(self) -> int:
         """Typed-unit queue depth: one runnable unit per live lane
-        plus one formation unit per pending/forming group."""
+        plus one formation unit per pending/forming group plus every
+        queued score unit."""
         with self._lock:
             return (
                 len(self._pending) + len(self._lanes)
                 + (1 if self._forming_group is not None else 0)
+                + len(self._score)
             )
 
     @property
@@ -272,6 +346,7 @@ class UnitScheduler:
                 not self._pending
                 and not self._lanes
                 and self._forming_group is None
+                and not self._score
             )
 
     def sweep_requests(self) -> list:
@@ -303,19 +378,25 @@ class UnitScheduler:
                     not self._stopped
                     and not self._lanes
                     and not self._pending
+                    and not self._score
                 ):
                     self._work.wait(timeout=0.1)
                 if self._stopped:
                     break
             try:
                 started = self._maybe_start()
-                lane = self._pick()
-                if lane is not None:
-                    self._advance(lane)
-                elif not started:
-                    # Pending work blocked on the page budget with
-                    # every lane idle-free: wait for a release tick.
-                    time.sleep(0.002)
+                su = self._claim_score()
+                if su is not None:
+                    self._dispatch_score(su)
+                else:
+                    lane = self._pick()
+                    if lane is not None:
+                        self._advance(lane)
+                        self._last_was_score = False
+                    elif not started:
+                        # Pending work blocked on the page budget with
+                        # every lane idle-free: wait for a release tick.
+                        time.sleep(0.002)
             except BaseException:  # noqa: BLE001 — scheduler must survive
                 _log.exception("unit scheduler internal error")
                 time.sleep(0.01)
@@ -326,6 +407,13 @@ class UnitScheduler:
         with self._lock:
             pending, self._pending = self._pending, []
             lanes, self._lanes = self._lanes, []
+            score = list(self._score)
+            self._score.clear()
+        for su in score:
+            try:
+                su.fail(err)  # the batch's futures get the stop error
+            except BaseException:
+                _log.exception("score-unit fail delivery failed")
         for lane in lanes:
             try:
                 # close() throws GeneratorExit into a STARTED
@@ -362,6 +450,12 @@ class UnitScheduler:
     def _deliver_error(reqs, err) -> None:
         for r in reqs:
             if getattr(r, "cancelled", False):
+                # No consumer to deliver to, but the terminal hook
+                # still fires (idempotent) so tenant-ledger depth
+                # balances on the cancel path too.
+                fin = getattr(r, "finish", None)
+                if fin is not None:
+                    fin()
                 continue
             try:
                 r.push(err)
@@ -370,28 +464,132 @@ class UnitScheduler:
 
     # -- policy --------------------------------------------------------
 
+    def _weight_of(self, reqs) -> float:
+        """Max tenant weight among a candidate's live requests (1.0
+        with no ledger or only anonymous tenants). Weighted deadline
+        slack divides by this: a weight-2 tenant's 100 ms of slack
+        competes like 50 ms — it wins ties against weight-1 traffic
+        but cannot starve it (every urgency stays finite, and the
+        deadline-less alternation below ignores weights)."""
+        led = getattr(self.eng, "tenants", None)
+        if led is None:
+            return 1.0
+        w = 1.0
+        for r in reqs:
+            t = getattr(r, "tenant", "") or ""
+            if t:
+                w = max(w, led.weight(t))
+        return w
+
     def _urgency_group(self, g: _Group, now: float, summary) -> float:
+        w = self._weight_of(g.reqs)
         slack = _min_slack(g.reqs, now)
         if slack is not None:
-            return slack
+            return slack / w
         # TTFT feed (r10 reservoirs): a deadline-less group that has
         # queued past ~2x the observed TTFT p95 starts competing like
         # a near-due deadline; cold reservoirs keep it relaxed.
         ttft = (summary["ttft_p95_ms"] or 0.0) / 1e3
         if ttft > 0.0 and (now - g.t_submit) > 2.0 * ttft:
-            return ttft
-        return _RELAXED_S
+            return ttft / w
+        return _RELAXED_S / w
 
     def _urgency_lane(self, lane: _Lane, now: float, summary) -> float:
+        w = self._weight_of(lane.run.reqs)
         slack = _min_slack(lane.run.reqs, now)
         if slack is not None:
-            return slack
+            return slack / w
         # ITL feed: a deadline-less RUNNING lane competes at the
         # inter-token p50 scale (its consumers are waiting a token
         # gap, not a TTFT) — equal for all such lanes, so the
         # least-recently-picked tie-break alternates them strictly.
         itl = (summary["intertoken_p50_ms"] or 0.0) / 1e3
-        return itl if itl > 0.0 else _RELAXED_S
+        return (itl if itl > 0.0 else _RELAXED_S) / w
+
+    # -- score units (the scoring fast path's backend) -----------------
+
+    @staticmethod
+    def _urgency_score(su: _ScoreUnit, now: float) -> float:
+        """Weighted urgency of one queued scoring batch. Deadlined:
+        weighted slack, same currency as lanes. Deadline-less: linear
+        aging from the SCORING model's observed TTFT p95 target
+        (floor 5 ms cold) down to zero — a waiting score unit always
+        reaches urgency 0 within its own latency target, so decode
+        traffic can delay it at most one target's worth, never
+        starve it."""
+        if su.deadline is not None:
+            return (su.deadline - now) / su.weight
+        return max(su.target - (now - su.t_submit), 0.0) / su.weight
+
+    def _claim_score(self) -> _ScoreUnit | None:
+        """Decide score-vs-lane for this dispatch slot and pop the
+        head score unit when scoring wins. Real deadline slack on
+        either side decides by weighted minimum (a deadline override
+        of the alternation counts as a preemption, the same
+        ``sched_deadline_preempts`` currency lanes use); with no
+        deadlines anywhere the dispatcher strictly ALTERNATES
+        score/lane, so neither generation nor scoring can starve the
+        other by construction — the no-starvation half of the
+        acceptance bar, pinned from counters."""
+        with self._lock:
+            if not self._score:
+                return None
+            su = self._score[0]
+            lanes = list(self._lanes)
+        if not lanes:
+            with self._lock:
+                return self._score.popleft() if self._score else None
+        now = time.perf_counter()
+        u_score = self._urgency_score(su, now)
+        summary = self._cached_summary()
+        u_lane = min(
+            self._urgency_lane(ln, now, summary) for ln in lanes
+        )
+        score_deadlined = su.deadline is not None
+        lane_deadlined = any(
+            _min_slack(ln.run.reqs, now) is not None for ln in lanes
+        )
+        alternation = not self._last_was_score
+        if score_deadlined or lane_deadlined:
+            take = u_score <= u_lane
+            if take != alternation and (
+                score_deadlined if take else lane_deadlined
+            ):
+                self.eng.sched_deadline_preempts += 1
+        else:
+            take = alternation
+        if not take:
+            return None
+        with self._lock:
+            return self._score.popleft() if self._score else None
+
+    def _dispatch_score(self, su: _ScoreUnit) -> None:
+        """One score unit on the dispatch thread: the device call runs
+        inline (``fn`` resolves the batch's futures thread-safely) and
+        the unit enters the SAME accounting lanes get — kind counter,
+        trace, head-of-line streak under pseudo-lane id 0, so the
+        stall bound covers scoring-behind-decode and decode-behind-
+        scoring symmetrically."""
+        eng = self.eng
+        with self._lock:
+            n_live = len(self._lanes)
+        try:
+            faults.fire("sched_unit")
+            su.fn()
+        except BaseException as e:  # noqa: BLE001 — unit-scoped failure
+            _log.error("score unit of %d rows failed: %s", su.n_rows, e)
+            try:
+                su.fail(e)
+            except BaseException:
+                _log.exception("score-unit fail delivery failed")
+        eng.sched_units_score += 1
+        self.trace.append((0, "score"))
+        # Score units count as one extra live party: consecutive
+        # score dispatches while lanes wait (and vice versa) feed the
+        # same streak gauge.
+        self._note_dispatch(0, n_live + 1)
+        self._last_was_score = True
+        self._pick_seq += 1
 
     def _pick(self) -> _Lane | None:
         """Minimum-urgency lane; exact ties go least-recently-picked
@@ -435,6 +633,12 @@ class UnitScheduler:
         sharing and early finishes only make the real usage smaller
         (over-reservation costs a deferred start, never a mid-decode
         exhaustion)."""
+        return len(reqs) * self._row_pages(reqs)
+
+    def _row_pages(self, reqs) -> int:
+        """Per-row worst-case page count of a group — one number for
+        every row, because rows re-pack to the GROUP's geometry. The
+        per-tenant split multiplies this by each tenant's row count."""
         eng = self.eng
         page = eng.pool.page
         span = eng._cache_len(
@@ -442,7 +646,57 @@ class UnitScheduler:
             + max(len(r.row) for r in reqs),
             max(r.n_new for r in reqs),
         ) + (eng.spec_k + 1 if eng.draft_model is not None else 0)
-        return len(reqs) * -(-span // page)
+        return -(-span // page)
+
+    @staticmethod
+    def _tenant_split(reqs, row_pages: int) -> tuple[dict, dict]:
+        """(tenant → worst-case pages, tenant → adapter-id set) of a
+        group, anonymous tenants excluded — they are unquotaed."""
+        pages: dict = {}
+        adapters: dict = {}
+        for r in reqs:
+            t = getattr(r, "tenant", "") or ""
+            if not t:
+                continue
+            pages[t] = pages.get(t, 0) + row_pages
+            a = getattr(r, "adapter", None)
+            if a is not None:
+                adapters.setdefault(t, set()).add(a)
+        return pages, adapters
+
+    def _tenant_block(self, g: _Group, row_pages: int):
+        """Per-tenant term of the reservation gate (caller holds the
+        lock, lanes are live). A tenant already HOLDING reservations
+        may not grow past its quota — need + held must fit; a tenant
+        holding nothing starts unconditionally (quota smaller than
+        one group must reject loudly downstream, not starve silently
+        — the same escape the fleet-wide gate gives an empty pool).
+        Returns the blocking (kind, tenant) or None. Never touches
+        other tenants' reservations: a deferral leaves every live
+        lane's pages exactly where they were."""
+        led = getattr(self.eng, "tenants", None)
+        if led is None or not self._lanes:
+            return None
+        need_pages, need_adapters = self._tenant_split(g.reqs, row_pages)
+        for t, need in need_pages.items():
+            quota = led.quota_pages_of(t)
+            if quota is None:
+                continue
+            held = sum(
+                ln.tenant_pages.get(t, 0) for ln in self._lanes
+            )
+            if held and need + held > quota:
+                return ("pages", t)
+        for t, ads in need_adapters.items():
+            quota = led.quota_slots_of(t)
+            if quota is None:
+                continue
+            held = set()
+            for ln in self._lanes:
+                held |= ln.tenant_adapters.get(t, set())
+            if held and len(held | ads) > quota:
+                return ("slots", t)
+        return None
 
     def _claim_next_group(self) -> _Group | None:
         """Pop the most-urgent pending group that passes the
@@ -506,16 +760,36 @@ class UnitScheduler:
                         if getattr(r, "adapter", None) is not None
                     })
                 )
+                t_block = None
                 if pages_ok and slots_ok:
-                    self._pending.remove(g)
-                    # Claimed: visible to idle/backlog/sweep via the
-                    # forming slot until the lane exists.
-                    self._forming_group = g
-                    return g
+                    # Per-tenant term, checked only once the fleet-
+                    # wide terms pass — a tenant deferral means the
+                    # POOL had room and this tenant's quota alone
+                    # said no (the quota-pin test's distinction).
+                    t_block = self._tenant_block(
+                        g,
+                        self._row_pages(g.reqs)
+                        if pool is not None else 0,
+                    )
+                    if t_block is None:
+                        self._pending.remove(g)
+                        # Claimed: visible to idle/backlog/sweep via
+                        # the forming slot until the lane exists.
+                        self._forming_group = g
+                        return g
                 if not g.deferred_counted:
                     # Once per deferral episode, not per re-check.
                     g.deferred_counted = True
-                    if pages_ok:
+                    if t_block is not None:
+                        kind, tenant = t_block
+                        led = getattr(self.eng, "tenants", None)
+                        if led is not None:
+                            led.note_deferral(tenant)
+                        if kind == "pages":
+                            self.eng.sched_tenant_pages_deferred += 1
+                        else:
+                            self.eng.sched_tenant_adapters_deferred += 1
+                    elif pages_ok:
                         self.eng.sched_adapters_deferred += 1
                     else:
                         self.eng.sched_pages_deferred += 1
@@ -611,14 +885,17 @@ class UnitScheduler:
             return
         eng.sched_units_prefill += 1  # formation IS the prefill unit
         self._writeback_pool(run)
+        row_pages = (
+            self._row_pages(reqs) if eng.pool is not None else 0
+        )
+        t_pages, t_adapters = self._tenant_split(reqs, row_pages)
         with self._lock:
             self._lane_seq += 1
             lane = _Lane(
                 self._lane_seq, run, run.units(), self._pick_seq,
-                reserved=(
-                    self._page_need(reqs)
-                    if eng.pool is not None else 0
-                ),
+                reserved=len(reqs) * row_pages,
+                tenant_pages=t_pages,
+                tenant_adapters=t_adapters,
             )
             lane.pool_epoch = (
                 eng.pool.epoch if eng.pool is not None else -1

@@ -1,24 +1,35 @@
-"""Asyncio micro-batcher: coalesce concurrent requests into one
-device call.
+"""The scoring fast path: bucketed micro-batch formation feeding the
+engine's lru-cached padded-shape jit programs, each formed batch one
+first-class typed unit.
+
+This module is the ONE batching implementation for classification and
+recsys models (r22; ROADMAP item 1). It folds the legacy ``/predict``
+``MicroBatcher`` (r2) onto the multi-model registry: same slot-first
+collection loop, same straggler window, same deadline sweep, same
+drain/shed contract, same counters — plus two things the single-model
+batcher never had:
+
+- **A scheduler backend.** When a generative engine is co-resident
+  (the multi-model process), every formed scoring batch is submitted
+  to its :class:`~mlapi_tpu.serving.scheduler.UnitScheduler` as a
+  ``score`` unit instead of a private worker thread: the dispatch
+  thread runs the device call between decode chunks, so
+  microsecond-scale scoring interleaves with generation under ONE
+  policy (weighted deadline slack) and one head-of-line stall bound
+  (``sched_lane_stall_max`` counts score units like any lane's).
+  Without a co-resident scheduler the folded worker-pool path runs
+  exactly as before — one implementation, two execution backends.
+- **Per-model identity.** Each path carries its ``model_id`` and its
+  own :class:`~mlapi_tpu.serving.requests.LatencyStats` reservoir, so
+  ``/metrics`` exports a per-model counter family and the scheduler's
+  score-unit urgency ages against THIS model's observed latency, not
+  the generative engine's.
 
 The throughput half of the north-star metric (requests/sec/chip,
-``BASELINE.json:2``) is won here: N concurrent ``/predict`` requests
-become ≤ ceil(N / max_batch) TPU dispatches instead of N. Mechanism:
-
-- ``submit(row)`` parks a future on an asyncio queue.
-- A collector task takes the first queued item, then drains up to
-  ``max_batch`` items, waiting at most ``max_wait_ms`` for stragglers
-  (the window trades a bounded p50 hit for batching win; 0 disables
-  waiting for the latency-critical case).
-- Batches run on a small executor pool with up to ``max_inflight``
-  batches in flight at once. Device dispatch never blocks the event
-  loop, and — crucially when the chip sits behind a network tunnel
-  where one call's latency is dominated by the wire — round trips
-  overlap, so throughput is ``max_inflight × max_batch`` per
-  round-trip time instead of one batch per round trip.
-
-The reference has no batching — each request does its own
-pickle-load + two matmuls inline on the event loop (``main.py:19-22``).
+``BASELINE.json:2``) is still won here: N concurrent requests become
+≤ ceil(N / max_batch) TPU dispatches instead of N. The reference has
+no batching — each request does its own pickle-load + two matmuls
+inline on the event loop (``main.py:19-22``).
 """
 
 from __future__ import annotations
@@ -26,12 +37,15 @@ from __future__ import annotations
 import asyncio
 import queue
 import threading
+import time
 
 import numpy as np
 
+from mlapi_tpu.serving import faults
+from mlapi_tpu.serving.requests import LatencyStats
 from mlapi_tpu.utils.logging import get_logger
 
-_log = get_logger("serving.batcher")
+_log = get_logger("serving.scoring")
 
 
 class _WorkerPool:
@@ -43,7 +57,7 @@ class _WorkerPool:
     the original per-batch-thread recovery property without paying a
     thread start per batch (~50 µs each, ~20% of event-loop time at
     full load). Steady-state thread count equals peak concurrent
-    batches (≤ the batcher's max_inflight)."""
+    batches (≤ the path's max_inflight)."""
 
     def __init__(self, name: str):
         self._name = name
@@ -115,21 +129,26 @@ class OverloadedError(Exception):
         self.retry_after_s = retry_after_s
 
 
-class MicroBatcher:
-    """Coalesces single-row predict requests into batched engine calls."""
+class ScorePath:
+    """Coalesces single-row scoring requests into batched device
+    dispatches — typed ``score`` units when a generative scheduler is
+    co-resident, pool-worker calls otherwise."""
 
     def __init__(
         self,
         engine,
         *,
+        model_id: str = "default",
         max_batch: int | None = None,
         max_wait_ms: float = 0.2,
         max_queue: int = 8192,
         max_inflight: int = 16,
         dispatch_timeout_s: float = 30.0,
         default_deadline_ms: float | None = None,
+        sched_source=None,
     ):
         self.engine = engine
+        self.model_id = model_id
         self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
         self.max_wait_s = max_wait_ms / 1e3
         self.max_inflight = max_inflight
@@ -139,6 +158,16 @@ class MicroBatcher:
         # queue→batch handoff, where expired entries fail with
         # DeadlineExceeded (504) instead of burning device time.
         self.default_deadline_ms = default_deadline_ms
+        # Zero-arg callable resolving to the co-resident generative
+        # engine's UnitScheduler (or None). A callable, not the
+        # scheduler itself: the scheduler is created by
+        # ``engine.start()`` AFTER the app wires the registry, and a
+        # restarted engine gets a fresh one.
+        self._sched_source = sched_source
+        # Per-model reservoir: /metrics latency family and the
+        # scheduler's score-unit aging target (its TTFT p95) read
+        # THIS model's observations.
+        self.latency = LatencyStats()
         # Graceful drain: submit sheds while True; in-flight batches
         # finish (their resolvers set results), the queue empties.
         self.draining = False
@@ -146,7 +175,7 @@ class MicroBatcher:
         # True while the collect loop holds popped rows it has not
         # yet dispatched (the straggler window): those rows are in
         # neither the queue nor ``inflight``, and drain() must treat
-        # the window as live work or it can declare the batcher idle
+        # the window as live work or it can declare the path idle
         # with a batch still forming.
         self._collecting = False
         self._inflight: asyncio.Semaphore | None = None
@@ -161,6 +190,10 @@ class MicroBatcher:
         self.inflight = 0
         self.shed_draining = 0
         self.deadline_expired = 0
+        # Batches routed through the co-resident UnitScheduler as
+        # typed score units (vs the pool-worker backend) — the
+        # counters-not-wall-clock evidence that interleaving happened.
+        self.sched_dispatches = 0
         # Fleet backlog a fronting router last stamped on a forwarded
         # request (x-mlapi-router-depth; 0 direct) — classification
         # replicas surface the same backpressure gauge the generative
@@ -171,10 +204,20 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def _sched(self):
+        if self._sched_source is None:
+            return None
+        try:
+            return self._sched_source()
+        except Exception:  # noqa: BLE001 — a dead source means no sched
+            return None
+
     async def start(self) -> None:
         if self._task is None:
             self._inflight = asyncio.Semaphore(self.max_inflight)
-            self._task = asyncio.create_task(self._collect_loop(), name="microbatcher")
+            self._task = asyncio.create_task(
+                self._collect_loop(), name=f"scorepath-{self.model_id}"
+            )
 
     async def stop(self) -> None:
         """Graceful shutdown: no awaiting ``submit()`` may hang.
@@ -193,9 +236,9 @@ class MicroBatcher:
             await asyncio.gather(*list(self._resolvers), return_exceptions=True)
         self._pool.close()  # release idle dispatch workers
         while not self._queue.empty():
-            _, fut, _ = self._queue.get_nowait()
+            _, fut, _, _ = self._queue.get_nowait()
             if not fut.done():
-                fut.set_exception(RuntimeError("batcher stopped"))
+                fut.set_exception(RuntimeError("scoring path stopped"))
 
     async def drain(self, timeout_s: float = 10.0) -> None:
         """Graceful drain: shed new submits (503 + retry-after), let
@@ -216,7 +259,7 @@ class MicroBatcher:
                 return
             await asyncio.sleep(0.05)
         while not self._queue.empty():
-            _, fut, _ = self._queue.get_nowait()
+            _, fut, _, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(OverloadedError(
                     "predict", retry_after_s=5.0,
@@ -234,7 +277,7 @@ class MicroBatcher:
         ``put`` here would grow latency without bound while every
         queued request eventually times out anyway."""
         if self._task is None:
-            raise RuntimeError("batcher not started")
+            raise RuntimeError("scoring path not started")
         loop = asyncio.get_running_loop()
         if self.draining:
             self.shed_draining += 1
@@ -251,7 +294,8 @@ class MicroBatcher:
         fut: asyncio.Future = loop.create_future()
         try:
             self._queue.put_nowait(
-                (np.asarray(row, np.float32), fut, deadline)
+                (np.asarray(row, np.float32), fut, deadline,
+                 time.perf_counter())
             )
         except asyncio.QueueFull:
             self.rejected += 1
@@ -309,9 +353,11 @@ class MicroBatcher:
                 # can't see them — fail their futures here or their
                 # submit() callers hang forever.
                 self._collecting = False
-                for _, fut, _ in rows:
+                for _, fut, _, _ in rows:
                     if not fut.done():
-                        fut.set_exception(RuntimeError("batcher stopped"))
+                        fut.set_exception(
+                            RuntimeError("scoring path stopped")
+                        )
                 raise
 
             # Deadline check at the ONE dispatch boundary this path
@@ -320,7 +366,7 @@ class MicroBatcher:
             # (504) instead of occupying batch rows.
             now = loop.time()
             expired = [
-                f for _, f, d in rows if d is not None and now > d
+                f for _, f, d, _ in rows if d is not None and now > d
             ]
             if expired:
                 from mlapi_tpu.serving.requests import DeadlineExceeded
@@ -338,36 +384,84 @@ class MicroBatcher:
                     self._collecting = False
                     continue
 
-            batch = np.stack([r for r, _, _ in rows])
-            futures = [f for _, f, _ in rows]
+            batch = np.stack([r for r, _, _, _ in rows])
+            futures = [f for _, f, _, _ in rows]
+            t_oldest = min(t for _, _, _, t in rows)
+            slack = min(
+                (d for _, _, d, _ in rows if d is not None),
+                default=None,
+            )
             # Fire the batch without awaiting its completion: up to
             # max_inflight device round trips overlap, while this loop
             # goes straight back to collecting the next batch.
             self.inflight += 1
             self._collecting = False  # rows now covered by inflight
-            work = self._dispatch_thread(loop, batch)
+            work = self._dispatch(loop, batch, t_oldest, slack, now)
             resolver = asyncio.create_task(self._resolve(work, futures))
             self._resolvers.add(resolver)
             resolver.add_done_callback(self._resolvers.discard)
 
-    def _dispatch_thread(self, loop, batch: np.ndarray) -> asyncio.Future:
-        """Run one device call on a pool worker thread. The pool heals
-        around wedged calls (see :class:`_WorkerPool`): a stranded
-        worker stays stranded, and fresh batches get fresh threads —
-        the batcher recovers instead of exhausting a fixed pool whose
-        every worker is stuck."""
+    def _dispatch(self, loop, batch: np.ndarray, t_oldest: float,
+                  loop_deadline: float | None,
+                  loop_now: float) -> asyncio.Future:
+        """Run one device call — as a typed ``score`` unit on the
+        co-resident UnitScheduler's dispatch thread when one is live
+        (interleaving between decode chunks under the weighted-slack
+        policy), else on a pool worker thread. The pool heals around
+        wedged calls (see :class:`_WorkerPool`): a stranded worker
+        stays stranded, and fresh batches get fresh threads — the
+        path recovers instead of exhausting a fixed pool whose every
+        worker is stuck."""
         fut: asyncio.Future = loop.create_future()
         self.device_calls += 1
 
         def runner():
+            t0 = time.perf_counter()
             try:
+                faults.fire("score_dispatch")
                 out = self.engine.predict_labels(batch)
             except Exception as e:  # noqa: BLE001
                 loop.call_soon_threadsafe(self._finish_future, fut, None, e)
             else:
+                t1 = time.perf_counter()
+                # Queue wait + device time of the batch's OLDEST row:
+                # the per-model first-result latency the score-unit
+                # urgency ages against.
+                self.latency.record_first((t1 - t_oldest) * 1e3)
                 loop.call_soon_threadsafe(self._finish_future, fut, out, None)
 
-        self._pool.submit(runner)
+        def fail(err: BaseException) -> None:
+            # Scheduler stopped with this unit still queued: the
+            # batch's futures get the engine-stopped error — the same
+            # terminal contract lanes get.
+            try:
+                loop.call_soon_threadsafe(self._finish_future, fut, None, err)
+            except RuntimeError:
+                pass  # loop already closed; nobody is waiting
+
+        sched = self._sched()
+        if sched is not None:
+            # The loop-clock deadline converts to the dispatch
+            # thread's perf_counter domain through "seconds from now"
+            # — both clocks are monotonic, only the epoch differs.
+            deadline = (
+                time.perf_counter() + (loop_deadline - loop_now)
+                if loop_deadline is not None else None
+            )
+            try:
+                sched.submit_score(
+                    runner, fail, n_rows=int(batch.shape[0]),
+                    deadline=deadline, stats=self.latency,
+                )
+            except RuntimeError:
+                # Stopped between the liveness check and the submit:
+                # fall back to the pool backend for this batch.
+                self._pool.submit(runner)
+            else:
+                self.sched_dispatches += 1
+                return fut
+        else:
+            self._pool.submit(runner)
         return fut
 
     @staticmethod
@@ -385,7 +479,7 @@ class MicroBatcher:
         try:
             # The watchdog is a failure detector, not flow control: a
             # wedged device call fails its own requests and frees the
-            # in-flight slot instead of deadlocking the whole batcher.
+            # in-flight slot instead of deadlocking the whole path.
             labels, probs = await asyncio.wait_for(
                 asyncio.shield(work), self.dispatch_timeout_s
             )
@@ -408,3 +502,9 @@ class MicroBatcher:
         for f, label, prob in zip(futures, labels, probs):
             if not f.done():
                 f.set_result((label, float(prob)))
+
+
+# r22 fold: the single-model ``MicroBatcher`` became the multi-model
+# ScorePath (serving/batcher.py is gone). The alias keeps external
+# imports working one release; new code names ScorePath.
+MicroBatcher = ScorePath

@@ -140,12 +140,18 @@ _CACHE_FAMILIES = {
     # only the lora-augmented trace variants (grouped scalar-slot and
     # gathered rows) are new, and they compile once in the shared
     # window instead of re-paying the whole ladder.
+    # + the multi-model module (r22): same CFG and engine shapes at
+    # page 8 / chunk 2 — a registry's generative entries drive the
+    # family's prefill/decode programs unchanged (score units change
+    # dispatch ORDER, never shapes), and the scoring fast path's
+    # padded-shape jit programs are tiny tabular predicts.
     "paged-family": frozenset({
         "test_serving_fused",
         "test_kv_peer",
         "test_kv_push",
         "test_lock_witness",
         "test_lora_serving",
+        "test_multi_model",
         "test_paged_kv",
         "test_paged_kv_tier",
         "test_scheduler",

@@ -9,7 +9,7 @@ import threading
 import numpy as np
 import pytest
 
-from mlapi_tpu.serving.batcher import MicroBatcher
+from mlapi_tpu.serving.scoring import MicroBatcher
 
 pytestmark = pytest.mark.anyio
 

@@ -213,7 +213,7 @@ async def test_armed_paged_tier_scheduler_smoke(gpt_model, gpt_params):
         eng = TextGenerationEngine(
             gpt_model, gpt_params, tokenizer=ByteTokenizer(),
             chunk=2, fused_single=False, kv_page_size=8,
-            kv_tier_bytes=1 << 24, scheduler=True,
+            kv_tier_bytes=1 << 24,
             sched_max_batches=2, max_wait_ms=0.0,
         )
         # Tight entry cap: the THIRD distinct prefix evicts the first

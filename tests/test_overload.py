@@ -18,7 +18,7 @@ import pytest
 
 from mlapi_tpu.models import get_model
 from mlapi_tpu.serving import InferenceEngine, build_app
-from mlapi_tpu.serving.batcher import MicroBatcher, OverloadedError
+from mlapi_tpu.serving.scoring import MicroBatcher, OverloadedError
 from mlapi_tpu.serving.engine import TextGenerationEngine
 from mlapi_tpu.text import ByteTokenizer
 from mlapi_tpu.utils.vocab import LabelVocab

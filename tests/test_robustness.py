@@ -32,7 +32,7 @@ import pytest
 
 from mlapi_tpu.models import get_model
 from mlapi_tpu.serving import build_app, faults
-from mlapi_tpu.serving.batcher import MicroBatcher, OverloadedError
+from mlapi_tpu.serving.scoring import MicroBatcher, OverloadedError
 from mlapi_tpu.serving.engine import TextGenerationEngine, _SyncSink
 from mlapi_tpu.serving.paged_pool import PagePoolExhausted
 from mlapi_tpu.serving.requests import DeadlineExceeded, DrainCancelled
@@ -424,7 +424,7 @@ async def test_submit_sheds_when_drain_completes_mid_encode():
     stop() flushed the queue — the late enqueue would land in a queue
     no collector will ever pop: a stream with no terminal frame. The
     post-encode re-check sheds it exactly like the front door."""
-    from mlapi_tpu.serving.batcher import OverloadedError
+    from mlapi_tpu.serving.scoring import OverloadedError
 
     eng = _engine()
     await eng.start()

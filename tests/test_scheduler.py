@@ -1,7 +1,9 @@
 """Continuous-batching scheduler v2 (``serving/scheduler.py``):
 one typed-unit queue across concurrent BatchRuns — DEFAULT-ON since
-r20, with ``scheduler=False`` (``--no-scheduler``) the one-release
-serial escape hatch pinning the same machinery to ONE lane.
+r20. ``--no-scheduler`` was retired in r22; ``sched_max_batches=1``
+is the serial mode, pinning the same machinery to ONE lane (the
+``scheduler=`` parameter of the ``_engine`` helper below maps to
+exactly that).
 
 The contract these tests pin, layer by layer — all interleaving and
 priority claims are asserted from DISPATCH COUNTERS and the bounded
@@ -11,7 +13,7 @@ unit trace, never wall-clock:
   together run as two live lanes with their units interleaved
   (``sched_batches_live_max == 2``; the trace alternates lane ids).
 - **Identity**: greedy streams are byte-identical concurrent
-  (default) vs serial (``scheduler=False``) across {gpt-MHA,
+  (default) vs serial (``sched_max_batches=1``) across {gpt-MHA,
   llama-GQA} x {none, int8} x {einsum, flash} x {paged, contiguous} —
   the structural consequence of both modes draining the same
   ``BatchRun.units()`` generator.
@@ -95,9 +97,15 @@ def _engine(model, params, paged=True, scheduler=True, **kw):
     kw.setdefault("max_wait_ms", 0.0)
     if paged:
         kw.setdefault("kv_page_size", 8)
+    # scheduler=False maps to the r22 serial mode: ONE lane on the
+    # same machinery (--no-scheduler retired; sched_max_batches=1 IS
+    # serial). Forced, not defaulted — the old kwarg clamped to one
+    # lane no matter what the lane budget said, and the identity
+    # matrix passes both together.
+    if not scheduler:
+        kw["sched_max_batches"] = 1
     return TextGenerationEngine(
-        model, params, tokenizer=ByteTokenizer(),
-        scheduler=scheduler, **kw,
+        model, params, tokenizer=ByteTokenizer(), **kw,
     )
 
 

@@ -203,11 +203,11 @@ async def test_deadlined_request_rides_fused_chunks(gpt_params):
 
 async def test_streams_identical_across_execution_modes(gpt_params):
     """The identity matrix cell this module owns: fused default
-    (scheduler on), fused serial (--no-scheduler) and plain chunked
-    produce byte-identical streams for the same traffic."""
+    (scheduler on), fused serial (sched_max_batches=1) and plain
+    chunked produce byte-identical streams for the same traffic."""
     engines = [
         _engine(gpt_params),                        # fused, scheduler on
-        _engine(gpt_params, scheduler=False),       # fused, serial
+        _engine(gpt_params, sched_max_batches=1),   # fused, serial
         _engine(gpt_params, fused=False),           # plain chunks
     ]
     outs = []

@@ -61,11 +61,31 @@ LOCK_REGISTRY: dict[str, LockSpec] = {
             "restore_failures", "evictions",
         }),
     ),
+    # Deliberately NOT listed: ``_last_was_score`` — the alternation
+    # bit is read/written only on the dispatch thread (the same
+    # one-writer contract as _pick_seq).
     "UnitScheduler": LockSpec(
         locks=frozenset({"_lock", "_work"}),  # _work wraps _lock
         attrs=frozenset({
             "_pending", "_lanes", "_forming_group", "_stopped",
+            # r22 scoring fast path: formed batches enqueue from the
+            # event loop (submit_score) while the dispatch thread
+            # claims/drains — same cross-thread shape as _pending.
+            "_score",
         }),
+    ),
+    # r22 multi-model/multi-tenant state. ModelRegistry's engine map
+    # is frozen at build_app time; only the started-set mutates
+    # (startup/shutdown hooks vs /healthz reads). TenantLedger is
+    # crossed by the event loop (enter/brownout), the dispatch thread
+    # (quota deferrals, terminal exits), and /metrics reads.
+    "ModelRegistry": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({"_started"}),
+    ),
+    "TenantLedger": LockSpec(
+        locks=frozenset({"_lock"}),
+        attrs=frozenset({"_depth", "_deferrals", "_brownouts"}),
     ),
     # r17 peer-fetch state: hints arrive from the event loop, fetch
     # counters from encode executor threads, serve counters from the
@@ -220,10 +240,13 @@ INSTANCE_BINDINGS: dict[str, str] = {
     "latency": "LatencyStats",
     "eng": "TextGenerationEngine",
     "engine": "TextGenerationEngine",
-    "batcher": "MicroBatcher",
+    "batcher": "ScorePath",
     "adapter_store": "AdapterStore",
     "adapters": "AdapterSlots",
     "adapter_peer": "AdapterPeer",
+    "models": "ModelRegistry",
+    "tenants": "TenantLedger",
+    "led": "TenantLedger",
 }
 # Where the machine-readable partial order is committed (the rule
 # recomputes it every run; the tier-1 test pins the committed file to
@@ -308,12 +331,15 @@ BLOCKING_BUILTINS = frozenset({"open"})
 # (``generate.shed_{queue_full,...}``) stops the match at the brace,
 # leaving a prefix the satisfiability check handles; file-path
 # lookalikes (``batcher.py::...``) are filtered in the rule.
-METRIC_NAME_RE = r"(?:generate|batcher|router|replica|http)\.[A-Za-z0-9_]+(?:\.[A-Za-z0-9_]+)*"
+METRIC_NAME_RE = r"(?:generate|batcher|router|replica|http|model|tenant)\.[A-Za-z0-9_]+(?:\.[A-Za-z0-9_]+)*"
 # Families whose exported names are constructed dynamically (router
 # relabels replica gauges, sums arbitrary replica counters; http
-# route labels are f-strings). A scraped/doc name under these
-# prefixes is satisfiable by construction.
-DYNAMIC_METRIC_PREFIXES = ("replica.", "router.", "http.")
+# route labels are f-strings; the r22 per-model and per-tenant
+# families key on registry ids / tenant names). A scraped/doc name
+# under these prefixes is satisfiable by construction.
+DYNAMIC_METRIC_PREFIXES = (
+    "replica.", "router.", "http.", "model.", "tenant.",
+)
 
 # -- default scan set ------------------------------------------------------
 DEFAULT_PY_GLOBS = (
